@@ -1,0 +1,10 @@
+"""distrifuser_tpu: TPU-native displaced patch parallelism for diffusion models.
+
+A from-scratch JAX/XLA/Pallas re-design of DistriFusion (mit-han-lab/distrifuser,
+CVPR 2024): training-free distributed inference for SDXL / SD that splits the
+latent image into spatial patches across TPU chips and hides cross-patch
+communication behind compute by reusing one-step-stale activations.
+"""
+
+from .__version__ import __version__
+from .utils.config import DistriConfig, init_multihost

@@ -1,0 +1,73 @@
+"""Named-axis collective helpers over the ICI mesh.
+
+TPU-native replacements for the reference's NCCL collective surface
+(SURVEY.md §2.2; /root/reference/distrifuser/utils.py:170-179 and the module
+files): sync/async `dist.all_gather` -> `lax.all_gather` over a named mesh
+axis, `dist.all_reduce(SUM)` -> `lax.psum`, and — new here, because ICI makes
+neighbor exchange first-class — the conv halo exchange uses `lax.ppermute`
+with a *non-wrapping* permutation instead of gathering every peer's boundary
+to every device (the reference allocates an n-peer buffer per conv,
+pp/conv2d.py:58-67, but only ever reads the two neighbors' rows,
+pp/conv2d.py:72-88).
+
+All helpers must be called inside `shard_map` with the axis bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.config import SP_AXIS
+
+
+def all_gather(x, axis: str = SP_AXIS):
+    """Gather per-device blocks along `axis` into a new leading dim [n, ...]."""
+    return lax.all_gather(x, axis)
+
+
+def all_gather_seq(x, axis: str = SP_AXIS):
+    """Gather sequence-sharded [B, L_local, C] into full [B, n*L_local, C]."""
+    return lax.all_gather(x, axis, axis=1, tiled=True)
+
+
+def psum_mean(x, n: int, axis: str = SP_AXIS):
+    """Average over the axis (reference all_reduce(SUM)/n, pp/groupnorm.py:79-80)."""
+    del n
+    return lax.pmean(x, axis)
+
+
+def halo_exchange(x, halo: int, n: int, axis: str = SP_AXIS):
+    """Exchange boundary rows with spatial neighbors along the patch axis.
+
+    ``x`` is the local row-patch [B, h, W, C] (NHWC).  Returns
+    ``(from_prev, from_next)``: the previous device's *bottom* `halo` rows and
+    the next device's *top* `halo` rows, each [B, halo, W, C].  Edge devices
+    receive zeros, which coincides exactly with the zero row-padding a global
+    conv would apply at the image border — the reference reproduces this with
+    explicit F.pad at ranks 0 / n-1 (pp/conv2d.py:73-78).
+    """
+    if halo == 0 or n == 1:
+        zeros = jnp.zeros(x.shape[:1] + (halo,) + x.shape[2:], x.dtype)
+        return zeros, zeros
+    down = [(i, i + 1) for i in range(n - 1)]  # send to next device
+    up = [(i + 1, i) for i in range(n - 1)]  # send to previous device
+    from_prev = lax.ppermute(x[:, -halo:], axis, perm=down)
+    from_next = lax.ppermute(x[:, :halo], axis, perm=up)
+    return from_prev, from_next
+
+
+def gather_rows(patch, axis: str = SP_AXIS):
+    """Reassemble row-sharded [B, h, W, C] patches into the full [B, H, W, C].
+
+    The per-step output gather of the reference models
+    (distri_sdxl_unet_pp.py:162-169: world all_gather + torch.cat on dim 2).
+    """
+    return lax.all_gather(patch, axis, axis=1, tiled=True)
+
+
+def gather_cols(patch, axis: str = SP_AXIS):
+    """Column-split variant used by naive patch parallelism (split_scheme='col',
+    naive_patch_sdxl.py:119-122)."""
+    return lax.all_gather(patch, axis, axis=2, tiled=True)

@@ -1,0 +1,2 @@
+from .config import CFG_AXIS, SP_AXIS, DistriConfig, init_multihost
+from .env import check_env, default_backend, is_power_of_2

@@ -1,0 +1,47 @@
+"""Environment checks for the TPU runtime.
+
+TPU-native analog of the reference's CUDA/NCCL environment gate
+(/root/reference/distrifuser/utils.py:6-16, `check_env`): instead of asserting
+CUDA >= 11.3 and torch >= 2.2 (NCCL-inside-CUDA-graph support), we assert a JAX
+new enough for `shard_map` + compiled collectives, and report which backend
+(tpu / cpu) the mesh will be built on.  There is no CUDA-graph prerequisite on
+TPU: a single `jax.jit`-compiled step already gives static-shape replay with
+collectives fused into the program.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# jax.shard_map with `check_vma` (the API this framework is written against)
+# first shipped in the 0.7 line; the mesh/collective code assumes it.
+_MIN_JAX = (0, 8, 0)
+
+
+def _version_tuple(v: str) -> tuple[int, ...]:
+    parts = []
+    for piece in v.split(".")[:3]:
+        digits = "".join(ch for ch in piece if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def check_env() -> None:
+    """Raise if the JAX runtime is too old for the collective machinery we use."""
+    if _version_tuple(jax.__version__) < _MIN_JAX:
+        raise RuntimeError(
+            f"distrifuser_tpu requires jax >= {'.'.join(map(str, _MIN_JAX))} "
+            f"(shard_map + async collective scheduling); found {jax.__version__}"
+        )
+
+
+def default_backend() -> str:
+    """Best available platform name ('tpu' when chips are attached, else 'cpu')."""
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return "cpu"
+
+
+def is_power_of_2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0

@@ -1,0 +1,34 @@
+"""Test bootstrap: fake 8-device CPU mesh.
+
+Must run before `jax` is first imported anywhere in the test process.  This is
+JAX's standard fake-multi-device mechanism (SURVEY.md §4): the TPU-world
+equivalent of a fake distributed backend, letting every sharding/collective
+path compile and execute on CI hardware.  The real-chip path is exercised by
+`bench.py` and the driver's `__graft_entry__.py` checks.
+"""
+
+import os
+
+# Force CPU even when the shell pins a TPU platform (e.g. JAX_PLATFORMS=axon):
+# unit tests always run on the fake 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers a TPU backend and force-prepends it to
+# jax_platforms regardless of the env var; override the config directly
+# (effective as long as no backend has been initialized yet).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest failed to fake 8 CPU devices"
+    return devs[:8]
