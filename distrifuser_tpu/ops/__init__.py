@@ -1,0 +1,4 @@
+from .attention import attention, cross_attention, patch_self_attention, sdpa, split_kv
+from .conv import conv2d, patch_conv2d, sliced_conv2d
+from .linear import feed_forward, geglu, linear
+from .normalization import group_norm, patch_group_norm

@@ -1,0 +1,119 @@
+"""Attention: dense core + displaced-patch self-attention + cached cross-attention.
+
+TPU-native re-design of the reference's PP attention modules
+(/root/reference/distrifuser/modules/pp/attn.py):
+
+* K and V projections are fused into one ``to_kv`` matmul (attn.py:23-39) —
+  one bigger MXU op instead of two.
+* `patch_self_attention` (attn.py:107-195): Q from the local row-patch only;
+  KV over the *full* sequence, assembled in sync phase by a fresh all-gather
+  (warmup, attn.py:132-134) and in stale phase from the carried gathered KV
+  with this device's slot overwritten by its fresh KV (attn.py:135-140).
+* `cross_attention` (attn.py:42-104): text KV is constant across denoising
+  steps, so it is computed once per generation (`precompute_text_kv` at the
+  pipeline level — the reference caches at counter==0) and fed in; no
+  communication, sequence dim of Q is sharded for free.
+
+The attention core computes softmax in fp32 and feeds the MXU with the model
+dtype.  A Pallas flash-attention kernel can swap in under the same signature
+for long sequences (ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.context import PatchContext
+from .linear import linear
+
+
+def sdpa(q, k, v, *, heads: int):
+    """Scaled dot-product attention over [B, L, C] tensors with H heads.
+
+    The XLA analog of F.scaled_dot_product_attention (attn.py:87,153):
+    jnp-level einsums that XLA fuses and tiles onto the MXU.
+    """
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    d = c // heads
+    q = q.reshape(b, lq, heads, d)
+    k = k.reshape(b, lk, heads, d)
+    v = v.reshape(b, lk, heads, d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / d**0.5)
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return out.reshape(b, lq, c)
+
+
+def split_kv(kv):
+    """Split a fused [..., 2C] KV into (K, V) (attn.py:78,142)."""
+    return jnp.split(kv, 2, axis=-1)
+
+
+def attention(p, x, *, heads: int, encoder_hidden_states=None):
+    """Dense (single-device) attention block: q/kv projections + sdpa + out proj.
+
+    Residual connections live in the transformer block, matching diffusers'
+    BasicTransformerBlock (the reference's Attention has
+    residual_connection=False there).
+    """
+    enc = x if encoder_hidden_states is None else encoder_hidden_states
+    q = linear(p["to_q"], x)
+    k, v = split_kv(linear(p["to_kv"], enc))
+    return linear(p["to_out"], sdpa(q, k, v, heads=heads))
+
+
+def patch_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
+    """Sequence-parallel self-attention with one-step-stale remote KV.
+
+    ``x``: local row-patch tokens [B, L_local, C].  Carry state per layer:
+    the gathered per-peer KV [n, B, L_local, 2C].
+    """
+    q = linear(p["to_q"], x)
+    kv = linear(p["to_kv"], x)  # [B, L, 2C] fresh local
+    if ctx.n == 1:
+        full_kv = kv
+    elif ctx.is_sync:
+        gathered = lax.all_gather(kv, ctx.axis)  # [n, B, L, 2C]
+        ctx.emit(name, gathered)
+        full_kv = _flatten_seq(gathered)
+    else:
+        gathered = ctx.stale(name)
+        # fresh local slot + stale peer slots (attn.py:135-138)
+        gathered = lax.dynamic_update_index_in_dim(gathered, kv, ctx.split_idx(), 0)
+        full_kv = _flatten_seq(gathered)
+        if ctx.refresh:
+            ctx.emit(name, lax.all_gather(kv, ctx.axis))
+    k, v = split_kv(full_kv)
+    return linear(p["to_out"], sdpa(q, k, v, heads=heads))
+
+
+def _flatten_seq(gathered):
+    """[n, B, L, C] -> [B, n*L, C] preserving patch order."""
+    n, b, l, c = gathered.shape
+    return jnp.moveaxis(gathered, 0, 1).reshape(b, n * l, c)
+
+
+def cross_attention(
+    p,
+    x,
+    *,
+    heads: int,
+    encoder_hidden_states=None,
+    cached_kv: Optional[jnp.ndarray] = None,
+):
+    """Cross-attention over text tokens; KV cached across steps (attn.py:42-104).
+
+    Works identically dense and patch-parallel: Q rows are local, text KV is
+    replicated, so no communication is ever needed.
+    """
+    q = linear(p["to_q"], x)
+    if cached_kv is None:
+        assert encoder_hidden_states is not None
+        cached_kv = linear(p["to_kv"], encoder_hidden_states)
+    k, v = split_kv(cached_kv)
+    return linear(p["to_out"], sdpa(q, k, v, heads=heads))

@@ -1,0 +1,36 @@
+"""Dense / MLP primitives.
+
+Params are plain pytrees: ``{"kernel": [in, out], "bias": [out]?}`` (JAX
+layout; the torch->JAX converter in models/weights.py transposes).  Matmuls
+hit the MXU; inputs stay in the model dtype (bf16 on TPU) with XLA's native
+fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(p, x):
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def geglu(p, x):
+    """GEGLU gate: diffusers `GEGLU` (hidden, gate = proj(x).chunk(2); hidden*gelu(gate)).
+
+    The reference's TP shard of this op is tp/feed_forward.py:20-36; here the
+    dense version.  Exact (erf) GeLU to match torch's default.
+    """
+    h = linear(p["proj"], x)
+    a, g = jnp.split(h, 2, axis=-1)
+    return a * jax.nn.gelu(g, approximate=False)
+
+
+def feed_forward(p, x):
+    """diffusers `FeedForward` with GEGLU activation: net.0 = GEGLU, net.2 = Linear
+    (reference shards it in tp/feed_forward.py; dense path here)."""
+    return linear(p["net_2"], geglu(p["net_0"], x))
