@@ -1,0 +1,8 @@
+from .scheduling import (
+    SCHEDULERS,
+    BaseScheduler,
+    DDIMScheduler,
+    DPMSolverMultistepScheduler,
+    EulerDiscreteScheduler,
+    get_scheduler,
+)

@@ -1,0 +1,107 @@
+"""Scheduler property tests.
+
+With an oracle model that predicts the *true* noise, each sampler must follow
+the exact diffusion trajectory: from x_t = a_t x0 + s_t n the step must land
+on x_{t_prev} = a_prev x0 + s_prev n (DDIM / DPM++), or the sigma-space
+equivalent for Euler.  This pins the coefficient tables without needing
+diffusers on the box.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu.schedulers import (
+    DDIMScheduler,
+    DPMSolverMultistepScheduler,
+    EulerDiscreteScheduler,
+    get_scheduler,
+)
+
+
+def test_factory_and_timesteps_leading_spacing():
+    s = get_scheduler("ddim").set_timesteps(50)
+    ts = np.asarray(s.timesteps())
+    # diffusers leading spacing, 1000 train steps, offset 1: 981, 961, ..., 1
+    assert ts[0] == 981 and ts[1] == 961 and ts[-1] == 1
+    assert len(ts) == 50
+    with pytest.raises(ValueError):
+        get_scheduler("plms")
+
+
+def test_ddim_exact_trajectory():
+    s = DDIMScheduler().set_timesteps(20)
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    a = np.asarray(s._alpha_t)
+    ap = np.asarray(s._alpha_prev)
+    state = s.init_state(x0.shape)
+    for i in range(20):
+        x_t = np.sqrt(a[i]) * x0 + np.sqrt(1 - a[i]) * n
+        x_prev, state = s.step(jnp.asarray(x_t), n, i, state)
+        want = np.sqrt(ap[i]) * x0 + np.sqrt(1 - ap[i]) * n
+        np.testing.assert_allclose(np.asarray(x_prev), np.asarray(want), atol=1e-5)
+
+
+def test_euler_exact_trajectory():
+    s = EulerDiscreteScheduler().set_timesteps(20)
+    key = jax.random.PRNGKey(2)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    sig = np.asarray(s._sigmas)
+    state = {}
+    for i in range(20):
+        x_t = x0 + sig[i] * n  # sigma-space latent
+        # model sees the descaled input; with epsilon oracle output = n
+        scaled = s.scale_model_input(jnp.asarray(x_t), i)
+        assert np.isfinite(np.asarray(scaled)).all()
+        x_next, state = s.step(jnp.asarray(x_t), n, i, state)
+        want = x0 + sig[i + 1] * n
+        np.testing.assert_allclose(np.asarray(x_next), np.asarray(want), atol=1e-4)
+    # last sigma is 0: trajectory ends at x0
+    np.testing.assert_allclose(np.asarray(x_next), np.asarray(x0), atol=1e-4)
+
+
+def test_euler_init_noise_sigma_large():
+    s = EulerDiscreteScheduler().set_timesteps(30)
+    # leading spacing starts at t=981 where sigma ~ 11.5 (t=999 would be ~157)
+    assert 10 < s.init_noise_sigma < 13
+
+
+def test_dpmsolver_exact_trajectory():
+    s = DPMSolverMultistepScheduler().set_timesteps(20)
+    key = jax.random.PRNGKey(3)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    a = np.asarray(s._alpha)
+    sg = np.asarray(s._sigma)
+    state = s.init_state(x0.shape)
+    x = a[0] * x0 + sg[0] * n
+    for i in range(20):
+        # oracle epsilon at the current point of the exact trajectory
+        eps = (np.asarray(x) - a[i] * np.asarray(x0)) / max(sg[i], 1e-12)
+        x, state = s.step(jnp.asarray(x), jnp.asarray(eps), i, state)
+        want = a[i + 1] * x0 + sg[i + 1] * n
+        np.testing.assert_allclose(np.asarray(x), np.asarray(want), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x0), atol=1e-3)
+
+
+def test_steps_inside_scan():
+    """Schedulers must compose with lax.scan (static shapes, traced indices)."""
+    for name in ["ddim", "euler", "dpm-solver"]:
+        s = get_scheduler(name).set_timesteps(10)
+        x = jnp.ones((1, 2, 2, 1)) * s.init_noise_sigma
+        state = s.init_state(x.shape)
+
+        def body(carry, i):
+            x, st = carry
+            eps = jnp.zeros_like(x)
+            x, st = s.step(x, eps, i, st)
+            return (x, st), None
+
+        (xf, _), _ = jax.jit(
+            lambda x0, st: jax.lax.scan(body, (x0, st), jnp.arange(10))
+        )(x, state)
+        assert np.isfinite(np.asarray(xf)).all()
