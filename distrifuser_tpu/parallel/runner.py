@@ -1,0 +1,334 @@
+"""The compiled denoising loop: displaced patch parallelism as one XLA program.
+
+This is the TPU-native replacement for the reference's hot path
+(SURVEY.md §3.3): where the reference replays three CUDA graphs per
+counter phase (pipelines.py:147-165, distri_sdxl_unet_pp.py:74-116) around a
+replicated diffusers scheduler loop, here the *entire* generation — warmup
+steps, stale steps, CFG combination, scheduler — is a single `jax.jit`
+program over the ("cfg", "sp") mesh:
+
+* step 0 runs the synchronous path and *creates* the stale-activation state
+  pytree (the reference needs two recording passes + buffer allocation,
+  pipelines.py:131-145; here the state is just the step's return value);
+* steps 1..warmup run the sync path in `lax.fori_loop` (reference: counter <=
+  warmup_steps selects sync everywhere, §2.3);
+* the remaining steps run the displaced path in `lax.scan`, carrying
+  (latents, patch-state, scheduler-state).  Each step's refresh collectives
+  produce values consumed only by the *next* iteration, so XLA's latency-
+  hiding scheduler overlaps them with compute — the role of the reference's
+  async NCCL all-gathers (utils.py:170-190);
+* every device computes the full gathered output and runs the scheduler
+  replicated, matching the reference contract (distri_sdxl_unet_pp.py:162-169).
+
+`use_compiled_step=False` (the reference's --no_cuda_graph) swaps the single
+fused program for per-step jitted calls driven from Python — same numerics,
+visible per-step latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.unet import (
+    DenseDispatch,
+    PatchDispatch,
+    UNetConfig,
+    precompute_text_kv,
+    unet_forward,
+)
+from ..schedulers import BaseScheduler
+from ..utils.config import CFG_AXIS, SP_AXIS, DistriConfig
+from .collectives import gather_cols, gather_rows
+from .context import PHASE_STALE, PHASE_SYNC, PatchContext
+
+
+def _check_geometry(cfg: DistriConfig, ucfg: UNetConfig) -> None:
+    if not cfg.is_sp:
+        return
+    depth = len(ucfg.block_out_channels) - 1  # number of downsamples
+    n = cfg.n_device_per_batch
+    h = cfg.latent_height
+    if cfg.parallelism == "patch" or cfg.split_scheme in ("row", "alternate"):
+        if h % (n * (1 << depth)) != 0:
+            raise ValueError(
+                f"latent height {h} must be divisible by n_devices*2^depth = "
+                f"{n * (1 << depth)} for row patching"
+            )
+    if cfg.parallelism == "naive_patch" and cfg.split_scheme in ("col", "alternate"):
+        w = cfg.latent_width
+        if w % (n * (1 << depth)) != 0:
+            raise ValueError(
+                f"latent width {w} must be divisible by n_devices*2^depth = "
+                f"{n * (1 << depth)} for column patching"
+            )
+
+
+class DenoiseRunner:
+    """Builds and runs the compiled generation loop for one (config, model).
+
+    Functional analog of the reference's model wrappers + pipeline prepare():
+    `DistriUNetPP` / `NaivePatchUNet` behavior is selected by
+    ``distri_config.parallelism`` ("patch" | "naive_patch"); tensor
+    parallelism has its own dispatch (models/unet_tp.py) wired through
+    ``tp_dispatch_factory``.
+    """
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        unet_config: UNetConfig,
+        params,
+        scheduler: BaseScheduler,
+        tp_dispatch_factory=None,
+    ):
+        self.cfg = distri_config
+        self.ucfg = unet_config
+        self.params = params
+        self.scheduler = scheduler
+        self.tp_dispatch_factory = tp_dispatch_factory
+        if distri_config.parallelism == "tensor" and tp_dispatch_factory is None:
+            raise ValueError("tensor parallelism needs a tp_dispatch_factory")
+        _check_geometry(distri_config, unet_config)
+        self._compiled: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    # per-device pieces (run inside shard_map)
+    # ------------------------------------------------------------------
+
+    def _branch_inputs(self, enc, added):
+        """Select this device's CFG branch (cfg_split) or fold branches into
+        the batch dim (single-device CFG, reference world_size==1 path)."""
+        cfg = self.cfg
+        if cfg.cfg_split:
+            br = lax.axis_index(CFG_AXIS)
+            my_enc = jnp.take(enc, br, axis=0)
+            my_added = (
+                {k: jnp.take(v, br, axis=0) for k, v in added.items()}
+                if added is not None
+                else None
+            )
+            batch_mult = 1
+        elif cfg.do_classifier_free_guidance:
+            my_enc = enc.reshape(-1, *enc.shape[2:])
+            my_added = (
+                {k: v.reshape(-1, *v.shape[2:]) for k, v in added.items()}
+                if added is not None
+                else None
+            )
+            batch_mult = enc.shape[0]
+        else:
+            my_enc = enc[0]
+            my_added = {k: v[0] for k, v in added.items()} if added is not None else None
+            batch_mult = 1
+        return my_enc, my_added, batch_mult
+
+    def _unet_local(self, params, x_in, t, my_enc, my_added, text_kv, phase, pstate):
+        """One UNet evaluation on this device; returns (full-latent output
+        for this branch-batch, new patch state)."""
+        cfg, ucfg = self.cfg, self.ucfg
+        if cfg.parallelism == "patch":
+            ctx = PatchContext(
+                n=cfg.n_device_per_batch,
+                mode=cfg.mode,
+                phase=phase,
+                state_in=pstate,
+                text_kv=text_kv,
+            )
+            out_local = unet_forward(
+                params, ucfg, x_in, t, my_enc,
+                dispatch=PatchDispatch(ctx), added_cond=my_added,
+            )
+            out = gather_rows(out_local) if cfg.is_sp else out_local
+            new_state = ctx.state_out if ctx.state_out else pstate
+            return out, new_state
+        if cfg.parallelism == "naive_patch":
+            return self._naive_patch_unet(params, x_in, t, my_enc, my_added, text_kv, pstate)
+        # tensor parallelism: activations stay full-size, no patch state
+        d = self.tp_dispatch_factory(text_kv)
+        out = unet_forward(
+            params, ucfg, x_in, t, my_enc, dispatch=d, added_cond=my_added
+        )
+        return out, pstate
+
+    def _naive_patch_unet(self, params, x_in, t, my_enc, my_added, text_kv, step_or_state):
+        """Naive patch parallelism (models/naive_patch_sdxl.py): slice the
+        latent, run the *unmodified* UNet on the slice, gather.  No cross-
+        patch ops, no state; `alternate` flips row/col by step parity
+        (naive_patch_sdxl.py:157-174)."""
+        cfg = self.cfg
+        n = cfg.n_device_per_batch
+        d = DenseDispatch(text_kv=text_kv)
+        idx = lax.axis_index(SP_AXIS)
+
+        def run_rows(x):
+            h_loc = x.shape[1] // n
+            xs = lax.dynamic_slice_in_dim(x, idx * h_loc, h_loc, axis=1)
+            y = unet_forward(params, self.ucfg, xs, t, my_enc, dispatch=d,
+                             added_cond=my_added)
+            return gather_rows(y)
+
+        def run_cols(x):
+            w_loc = x.shape[2] // n
+            xs = lax.dynamic_slice_in_dim(x, idx * w_loc, w_loc, axis=2)
+            y = unet_forward(params, self.ucfg, xs, t, my_enc, dispatch=d,
+                             added_cond=my_added)
+            return gather_cols(y)
+
+        if not cfg.is_sp:
+            out = unet_forward(params, self.ucfg, x_in, t, my_enc, dispatch=d,
+                               added_cond=my_added)
+        elif cfg.split_scheme == "row":
+            out = run_rows(x_in)
+        elif cfg.split_scheme == "col":
+            out = run_cols(x_in)
+        else:  # alternate
+            step_idx = step_or_state["step"]
+            out = lax.cond(step_idx % 2 == 0, run_rows, run_cols, x_in)
+        return out, step_or_state
+
+    def _cfg_combine(self, out, gs, batch):
+        cfg = self.cfg
+        if cfg.cfg_split:
+            both = lax.all_gather(out, CFG_AXIS)  # [2, B, H, W, C]
+            u, c = both[0], both[1]
+            return u + gs * (c - u)
+        if cfg.do_classifier_free_guidance:
+            u, c = out[:batch], out[batch:]
+            return u + gs * (c - u)
+        return out
+
+    def _make_step(self, phase):
+        sched = self.scheduler
+
+        def step(params, i, x, pstate, sstate, my_enc, my_added, text_kv, gs):
+            cfg = self.cfg
+            batch = x.shape[0]
+            t = sched.timesteps()[i]
+            x_in = sched.scale_model_input(x, i)
+            if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+                x_in = jnp.concatenate([x_in, x_in], axis=0)
+            if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate":
+                pstate = {"step": i}
+            out, new_pstate = self._unet_local(
+                params, x_in, t, my_enc, my_added, text_kv, phase, pstate
+            )
+            guided = self._cfg_combine(out, gs, batch)
+            x_next, sstate = sched.step(x, guided.astype(jnp.float32), i, sstate)
+            return x_next, new_pstate, sstate
+
+        return step
+
+    # ------------------------------------------------------------------
+    # the full loop (traced once per num_steps)
+    # ------------------------------------------------------------------
+
+    def _device_loop(self, params, latents, enc, added, gs, num_steps):
+        cfg = self.cfg
+        sched = self.scheduler
+        my_enc, my_added, _ = self._branch_inputs(enc, added)
+        # Text KV computed once per generation (reference kv_cache at
+        # counter==0, pp/attn.py:56).
+        text_kv = precompute_text_kv(params, my_enc)
+
+        step_sync = self._make_step(PHASE_SYNC)
+        step_stale = self._make_step(PHASE_STALE)
+
+        x = latents.astype(jnp.float32)
+        sstate = sched.init_state(x.shape)
+
+        if cfg.parallelism != "patch" or cfg.mode == "full_sync":
+            # one phase for everything: naive_patch / tensor / full_sync
+            pstate0: Any = {"step": jnp.asarray(0)} if (
+                cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate"
+            ) else {}
+            x, pstate, sstate = step_sync(
+                params, 0, x, pstate0, sstate, my_enc, my_added, text_kv, gs
+            )
+
+            def body(i, carry):
+                x, ps, ss = carry
+                return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
+
+            x, _, _ = lax.fori_loop(1, num_steps, body, (x, pstate, sstate))
+            return x
+
+        # displaced patch parallelism: sync warmup then stale steady state.
+        # counter <= warmup_steps selects sync (reference §2.3), so steps
+        # 0..warmup inclusive are synchronous.
+        n_sync = min(cfg.warmup_steps + 1, num_steps)
+        x, pstate, sstate = step_sync(
+            params, 0, x, None, sstate, my_enc, my_added, text_kv, gs
+        )
+
+        def sync_body(i, carry):
+            x, ps, ss = carry
+            return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
+
+        x, pstate, sstate = lax.fori_loop(1, n_sync, sync_body, (x, pstate, sstate))
+
+        def stale_body(carry, i):
+            x, ps, ss = carry
+            x, ps, ss = step_stale(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
+            return (x, ps, ss), None
+
+        (x, _, _), _ = lax.scan(
+            stale_body, (x, pstate, sstate), jnp.arange(n_sync, num_steps)
+        )
+        return x
+
+    def _build(self, num_steps: int):
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+
+        device_loop = partial(self._device_loop, num_steps=num_steps)
+
+        def loop(params, latents, enc, added, gs):
+            return shard_map(
+                device_loop,
+                mesh=cfg.mesh,
+                in_specs=(P(), P(), P(), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(params, latents, enc, added, gs)
+
+        return jax.jit(loop)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        latents,
+        prompt_embeds,
+        *,
+        guidance_scale: float = 5.0,
+        num_inference_steps: int = 50,
+        added_cond: Optional[Dict[str, Any]] = None,
+    ):
+        """Run the denoising loop.
+
+        ``latents``: [B, H/8, W/8, C] initial noise **already scaled** by
+        ``scheduler.init_noise_sigma``.  ``prompt_embeds``: [n_branches, B,
+        L, C] with branch 0 = unconditional (reference rank layout,
+        utils.py:98-104).  Returns the denoised latent [B, H/8, W/8, C].
+        """
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(num_inference_steps)
+        fn = self._compiled[num_inference_steps]
+        added = added_cond if added_cond is not None else None
+        return fn(
+            self.params,
+            jnp.asarray(latents),
+            jnp.asarray(prompt_embeds),
+            added,
+            jnp.asarray(guidance_scale, jnp.float32),
+        )
