@@ -169,6 +169,9 @@ class DenseDispatch:
     def feed_forward(self, p, x, name):
         return feed_forward(p, x)
 
+    def resnet(self, p, x, temb, name, *, groups):
+        return resnet_block(self, p, x, temb, name, groups=groups)
+
 
 class PatchDispatch:
     """Displaced patch parallelism over the sp mesh axis (must run in shard_map)."""
@@ -197,6 +200,9 @@ class PatchDispatch:
 
     def feed_forward(self, p, x, name):
         return feed_forward(p, x)  # purely local over tokens
+
+    def resnet(self, p, x, temb, name, *, groups):
+        return resnet_block(self, p, x, temb, name, groups=groups)
 
 
 # ---------------------------------------------------------------------------
@@ -329,7 +335,7 @@ def unet_forward(
         bp = params["down_blocks"][i]
         for j in range(cfg.layers_per_block):
             name = f"down_blocks.{i}.resnets.{j}"
-            x = resnet_block(d, bp["resnets"][j], x, temb, name, groups=groups)
+            x = d.resnet(bp["resnets"][j], x, temb, name, groups=groups)
             if btype == "CrossAttnDownBlock2D":
                 x = transformer_2d(
                     d, bp["attentions"][j], x, enc, f"down_blocks.{i}.attentions.{j}",
@@ -345,13 +351,13 @@ def unet_forward(
 
     # --- mid ---
     mp = params["mid_block"]
-    x = resnet_block(d, mp["resnets"][0], x, temb, "mid_block.resnets.0", groups=groups)
+    x = d.resnet(mp["resnets"][0], x, temb, "mid_block.resnets.0", groups=groups)
     x = transformer_2d(
         d, mp["attentions"][0], x, enc, "mid_block.attentions.0",
         heads=cfg.heads_for_block(len(cfg.block_out_channels) - 1),
         use_linear_projection=cfg.use_linear_projection, norm_groups=groups,
     )
-    x = resnet_block(d, mp["resnets"][1], x, temb, "mid_block.resnets.1", groups=groups)
+    x = d.resnet(mp["resnets"][1], x, temb, "mid_block.resnets.1", groups=groups)
 
     # --- up path ---
     n_blocks = len(cfg.block_out_channels)
@@ -361,7 +367,7 @@ def unet_forward(
             skip = skips.pop()
             x = jnp.concatenate([x, skip], axis=-1)
             name = f"up_blocks.{i}.resnets.{j}"
-            x = resnet_block(d, bp["resnets"][j], x, temb, name, groups=groups)
+            x = d.resnet(bp["resnets"][j], x, temb, name, groups=groups)
             if btype == "CrossAttnUpBlock2D":
                 x = transformer_2d(
                     d, bp["attentions"][j], x, enc, f"up_blocks.{i}.attentions.{j}",

@@ -88,12 +88,17 @@ class DenoiseRunner:
         params,
         scheduler: BaseScheduler,
         tp_dispatch_factory=None,
+        param_specs=None,
     ):
         self.cfg = distri_config
         self.ucfg = unet_config
         self.params = params
         self.scheduler = scheduler
         self.tp_dispatch_factory = tp_dispatch_factory
+        # Weight sharding layout: P() (replicated) for patch/naive modes —
+        # the reference also replicates weights in PP mode (§2.1) — and the
+        # per-leaf TP spec tree for tensor parallelism.
+        self.param_specs = param_specs if param_specs is not None else P()
         if distri_config.parallelism == "tensor" and tp_dispatch_factory is None:
             raise ValueError("tensor parallelism needs a tp_dispatch_factory")
         _check_geometry(distri_config, unet_config)
@@ -235,8 +240,11 @@ class DenoiseRunner:
         sched = self.scheduler
         my_enc, my_added, _ = self._branch_inputs(enc, added)
         # Text KV computed once per generation (reference kv_cache at
-        # counter==0, pp/attn.py:56).
-        text_kv = precompute_text_kv(params, my_enc)
+        # counter==0, pp/attn.py:56).  TP recomputes per step with sharded
+        # kernels, like the reference's TP attention (no cache there).
+        text_kv = (
+            {} if cfg.parallelism == "tensor" else precompute_text_kv(params, my_enc)
+        )
 
         step_sync = self._make_step(PHASE_SYNC)
         step_stale = self._make_step(PHASE_STALE)
@@ -294,7 +302,7 @@ class DenoiseRunner:
             return shard_map(
                 device_loop,
                 mesh=cfg.mesh,
-                in_specs=(P(), P(), P(), P(), P()),
+                in_specs=(self.param_specs, P(), P(), P(), P()),
                 out_specs=P(),
                 check_vma=False,
             )(params, latents, enc, added, gs)
@@ -332,3 +340,37 @@ class DenoiseRunner:
             added,
             jnp.asarray(guidance_scale, jnp.float32),
         )
+
+
+def make_runner(
+    distri_config: DistriConfig,
+    unet_config: UNetConfig,
+    params,
+    scheduler: BaseScheduler,
+) -> DenoiseRunner:
+    """Wire the right parallelism for ``distri_config.parallelism``.
+
+    The analog of the reference's model selection in from_pretrained
+    (pipelines.py:30-37): patch -> DistriUNetPP, naive_patch ->
+    NaivePatchUNet, tensor -> DistriUNetTP (weights sharded in place).
+    """
+    if distri_config.parallelism == "tensor" and distri_config.n_device_per_batch > 1:
+        from ..models.unet_tp import TPDispatch, head_dim_table, prepare_tp_params
+
+        n = distri_config.n_device_per_batch
+        tp_params, specs = prepare_tp_params(params, unet_config, n)
+        head_dims = head_dim_table(unet_config)
+        factory = lambda text_kv: TPDispatch(n, head_dims)  # noqa: E731
+        return DenoiseRunner(
+            distri_config, unet_config, tp_params, scheduler,
+            tp_dispatch_factory=factory, param_specs=specs,
+        )
+    if distri_config.parallelism == "tensor":
+        # single device: TP degenerates to dense
+        from ..models.unet import DenseDispatch
+
+        return DenoiseRunner(
+            distri_config, unet_config, params, scheduler,
+            tp_dispatch_factory=lambda text_kv: DenseDispatch(text_kv=text_kv),
+        )
+    return DenoiseRunner(distri_config, unet_config, params, scheduler)
