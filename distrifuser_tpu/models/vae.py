@@ -1,0 +1,265 @@
+"""AutoencoderKL (the SD/SDXL VAE) in JAX.
+
+The reference uses the diffusers VAE unchanged and runs the decode replicated
+on the full gathered latent on every rank (SURVEY.md §1,
+/root/reference/distrifuser/pipelines.py:39-42); we do the same — the VAE is
+not parallelism-aware, it just has to exist for the pipelines to emit pixels.
+Decoder + encoder, diffusers-0.24 architecture: resnets without time
+embedding, a single-head mid-block attention, nearest-2x upsampling.
+
+For very large images the decoder's O(L^2) mid attention and activation
+footprint dominate; `decode(..., tile=N)` decodes in latent-space row tiles
+with overlap blending (the diffusers enable_tiling analog) so 3840x3840
+outputs fit on one chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import sdpa
+from ..ops.conv import conv2d
+from ..ops.linear import linear
+from ..ops.normalization import group_norm
+
+silu = jax.nn.silu
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.13025  # SDXL; SD 1.x uses 0.18215
+
+
+def sdxl_vae_config() -> VAEConfig:
+    return VAEConfig()
+
+
+def sd_vae_config() -> VAEConfig:
+    return VAEConfig(scaling_factor=0.18215)
+
+
+def tiny_vae_config() -> VAEConfig:
+    return VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                     norm_num_groups=8, scaling_factor=0.18215)
+
+
+def _vae_resnet(p, x, groups):
+    h = conv2d(p["conv1"], silu(group_norm(p["norm1"], x, groups=groups, eps=1e-6)))
+    h = conv2d(p["conv2"], silu(group_norm(p["norm2"], h, groups=groups, eps=1e-6)))
+    if "conv_shortcut" in p:
+        x = conv2d(p["conv_shortcut"], x)
+    return x + h
+
+
+def _vae_attention(p, x, groups):
+    b, h, w, c = x.shape
+    hs = group_norm(p["group_norm"], x, groups=groups, eps=1e-6).reshape(b, h * w, c)
+    q = linear(p["to_q"], hs)
+    k = linear(p["to_k"], hs)
+    v = linear(p["to_v"], hs)
+    out = sdpa(q, k, v, heads=1)
+    out = linear(p["to_out"], out).reshape(b, h, w, c)
+    return x + out
+
+
+def _mid_block(p, x, groups):
+    x = _vae_resnet(p["resnets"][0], x, groups)
+    x = _vae_attention(p["attentions"][0], x, groups)
+    return _vae_resnet(p["resnets"][1], x, groups)
+
+
+def decode(params, cfg: VAEConfig, latents, *, tile: int = 0):
+    """Latent [B, h, w, 4] (already divided by scaling_factor) -> image
+    [B, 8h, 8w, 3] in [-1, 1].  ``tile``: latent rows per tile (0 = whole)."""
+    if tile and latents.shape[1] > tile:
+        return _decode_tiled(params, cfg, latents, tile)
+    p = params["decoder"]
+    groups = cfg.norm_num_groups
+    x = conv2d(params["post_quant_conv"], latents)
+    x = conv2d(p["conv_in"], x)
+    x = _mid_block(p["mid_block"], x, groups)
+    for up in p["up_blocks"]:
+        for rp in up["resnets"]:
+            x = _vae_resnet(rp, x, groups)
+        if "upsamplers" in up:
+            x = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+            x = conv2d(up["upsamplers"][0]["conv"], x)
+    x = silu(group_norm(p["conv_norm_out"], x, groups=groups, eps=1e-6))
+    return conv2d(p["conv_out"], x)
+
+
+def _decode_tiled(params, cfg, latents, tile: int, overlap: int = 8):
+    """Row-tiled decode with linear blending in the overlaps — the
+    diffusers enable_tiling analog for single-chip 4K decodes.  All tiles
+    share one shape so XLA compiles the decoder once."""
+    b, h, w, c = latents.shape
+    scale = 1 << (len(cfg.block_out_channels) - 1)  # latent row -> pixel rows
+    overlap = min(overlap, tile // 2)
+    stride = tile - overlap
+    starts = list(range(0, h - tile, stride)) + [h - tile]
+    pieces = [decode(params, cfg, latents[:, s : s + tile], tile=0) for s in starts]
+
+    rows = []
+    for i, s in enumerate(starts):
+        piece = pieces[i]
+        if i > 0:
+            ov = (starts[i - 1] + tile - s) * scale  # pixel rows shared w/ prev
+            blend = jnp.linspace(0.0, 1.0, ov)[None, :, None, None]
+            prev_tail = pieces[i - 1][:, -ov:]
+            piece = piece.at[:, :ov].set(prev_tail * (1 - blend) + piece[:, :ov] * blend)
+        keep_rows = (
+            (starts[i + 1] - s) * scale if i + 1 < len(starts) else tile * scale
+        )
+        rows.append(piece[:, :keep_rows])
+    return jnp.concatenate(rows, axis=1)
+
+
+def encode(params, cfg: VAEConfig, images, *, rng=None):
+    """Image [B, H, W, 3] in [-1,1] -> latent sample [B, H/8, W/8, 4]
+    (multiply by scaling_factor for the diffusion space)."""
+    p = params["encoder"]
+    groups = cfg.norm_num_groups
+    x = conv2d(p["conv_in"], images)
+    for down in p["down_blocks"]:
+        for rp in down["resnets"]:
+            x = _vae_resnet(rp, x, groups)
+        if "downsamplers" in down:
+            # diffusers pads (0,1,0,1) then strides 2 with VALID padding
+            x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            x = jax.lax.conv_general_dilated(
+                x, down["downsamplers"][0]["conv"]["kernel"], (2, 2),
+                ((0, 0), (0, 0)), dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + down["downsamplers"][0]["conv"]["bias"]
+    x = _mid_block(p["mid_block"], x, groups)
+    x = silu(group_norm(p["conv_norm_out"], x, groups=groups, eps=1e-6))
+    x = conv2d(p["conv_out"], x)  # [B, h, w, 8]
+    moments = conv2d(params["quant_conv"], x)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    if rng is None:
+        return mean
+    std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
+    return mean + std * jax.random.normal(rng, mean.shape, mean.dtype)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _init_conv(key, kh, kw, cin, cout):
+    return {
+        "kernel": jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+        / (cin * kh * kw) ** 0.5,
+        "bias": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def _init_norm(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _init_vae_resnet(key, cin, cout):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "norm1": _init_norm(cin),
+        "conv1": _init_conv(k1, 3, 3, cin, cout),
+        "norm2": _init_norm(cout),
+        "conv2": _init_conv(k2, 3, 3, cout, cout),
+    }
+    if cin != cout:
+        p["conv_shortcut"] = _init_conv(k3, 1, 1, cin, cout)
+    return p
+
+
+def _init_vae_attn(key, c):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def lin(k, cin, cout):
+        return {
+            "kernel": jax.random.normal(k, (cin, cout), jnp.float32) / cin**0.5,
+            "bias": jnp.zeros((cout,), jnp.float32),
+        }
+
+    return {
+        "group_norm": _init_norm(c),
+        "to_q": lin(k1, c, c),
+        "to_k": lin(k2, c, c),
+        "to_v": lin(k3, c, c),
+        "to_out": lin(k4, c, c),
+    }
+
+
+def init_vae_params(key, cfg: VAEConfig, dtype=jnp.float32):
+    keys = iter(jax.random.split(key, 128))
+    nxt = lambda: next(keys)  # noqa: E731
+    chs = cfg.block_out_channels
+    top = chs[-1]
+
+    def mid(c):
+        return {
+            "resnets": [_init_vae_resnet(nxt(), c, c), _init_vae_resnet(nxt(), c, c)],
+            "attentions": [_init_vae_attn(nxt(), c)],
+        }
+
+    # encoder: chs ascending with downsample between
+    down_blocks = []
+    c_prev = chs[0]
+    for i, c in enumerate(chs):
+        block = {
+            "resnets": [
+                _init_vae_resnet(nxt(), c_prev if j == 0 else c, c)
+                for j in range(cfg.layers_per_block)
+            ]
+        }
+        if i < len(chs) - 1:
+            block["downsamplers"] = [{"conv": _init_conv(nxt(), 3, 3, c, c)}]
+        down_blocks.append(block)
+        c_prev = c
+    encoder = {
+        "conv_in": _init_conv(nxt(), 3, 3, cfg.in_channels, chs[0]),
+        "down_blocks": down_blocks,
+        "mid_block": mid(top),
+        "conv_norm_out": _init_norm(top),
+        "conv_out": _init_conv(nxt(), 3, 3, top, 2 * cfg.latent_channels),
+    }
+
+    # decoder: reversed channels, layers_per_block+1 resnets per block
+    rev = list(reversed(chs))
+    up_blocks = []
+    c_prev = rev[0]
+    for i, c in enumerate(rev):
+        block = {
+            "resnets": [
+                _init_vae_resnet(nxt(), c_prev if j == 0 else c, c)
+                for j in range(cfg.layers_per_block + 1)
+            ]
+        }
+        if i < len(rev) - 1:
+            block["upsamplers"] = [{"conv": _init_conv(nxt(), 3, 3, c, c)}]
+        up_blocks.append(block)
+        c_prev = c
+    decoder = {
+        "conv_in": _init_conv(nxt(), 3, 3, cfg.latent_channels, top),
+        "mid_block": mid(top),
+        "up_blocks": up_blocks,
+        "conv_norm_out": _init_norm(rev[-1]),
+        "conv_out": _init_conv(nxt(), 3, 3, rev[-1], cfg.out_channels),
+    }
+
+    params = {
+        "encoder": encoder,
+        "decoder": decoder,
+        "quant_conv": _init_conv(nxt(), 1, 1, 2 * cfg.latent_channels, 2 * cfg.latent_channels),
+        "post_quant_conv": _init_conv(nxt(), 1, 1, cfg.latent_channels, cfg.latent_channels),
+    }
+    return jax.tree.map(lambda a: a.astype(dtype), params)

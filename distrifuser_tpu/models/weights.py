@@ -1,0 +1,200 @@
+"""HuggingFace torch checkpoints -> JAX param pytrees.
+
+The reference loads weights through diffusers `from_pretrained`
+(/root/reference/distrifuser/pipelines.py:26-28); the TPU equivalent is a
+one-time mechanical conversion of the safetensors state_dicts into the param
+trees the models in this package consume:
+
+* conv kernels  [O, I, kh, kw] -> HWIO [kh, kw, I, O]
+* linear kernels [O, I] -> [I, O]
+* norm ``weight`` -> ``scale``
+* diffusers quirks normalized: ``to_out.0`` -> ``to_out``, ``ff.net.0.proj``
+  -> ``ff.net_0.proj``, ``ff.net.2`` -> ``ff.net_2``
+* UNet attention ``to_k``/``to_v`` fused into one ``to_kv`` kernel — the
+  layout the displaced-patch attention computes with (reference fuses the
+  same way at wrap time, modules/pp/attn.py:23-39)
+
+Converted trees can be cached to disk with `save_params` / `load_params`
+(msgpack-free: a flat .npz) so the torch -> JAX conversion runs once.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+_NORM_HINTS = ("norm", "ln_", "layer_norm", "layernorm")
+
+
+def load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    return load_file(path)
+
+
+def load_sharded_safetensors(model_dir: str, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Load all *.safetensors shards in a directory into one state dict."""
+    sd: Dict[str, np.ndarray] = {}
+    for fname in sorted(os.listdir(model_dir)):
+        if fname.endswith(".safetensors"):
+            sd.update(load_safetensors(os.path.join(model_dir, fname)))
+    if prefix:
+        sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+    return sd
+
+
+def _rename(parts: List[str]) -> List[str]:
+    out: List[str] = []
+    i = 0
+    while i < len(parts):
+        p = parts[i]
+        if p == "net" and i + 1 < len(parts) and parts[i + 1] in ("0", "2"):
+            out.append(f"net_{parts[i + 1]}")
+            i += 2
+            continue
+        if p == "to_out" and i + 1 < len(parts) and parts[i + 1] == "0":
+            out.append("to_out")
+            i += 2
+            continue
+        out.append(p)
+        i += 1
+    return out
+
+
+def _convert_leaf(parts: List[str], value: np.ndarray):
+    leaf = parts[-1]
+    v = np.asarray(value)
+    if leaf == "weight":
+        if "embedding" in parts[-2] or parts[-2] in ("token_embedding", "position_embedding"):
+            return parts[:-1] + ["__direct__"], v
+        if v.ndim == 4:
+            return parts[:-1] + ["kernel"], v.transpose(2, 3, 1, 0)
+        if v.ndim == 2:
+            return parts[:-1] + ["kernel"], v.T
+        return parts[:-1] + ["scale"], v
+    if leaf == "bias":
+        return parts[:-1] + ["bias"], v
+    return parts, v
+
+
+def _assign(tree: Dict[str, Any], parts: List[str], value) -> None:
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    if parts[-1] == "__direct__":
+        # whole-tensor param (embeddings): collapse into the parent key
+        raise AssertionError("handled by caller")
+    node[parts[-1]] = value
+
+
+def _listify(tree):
+    """Turn dicts whose keys are all digits into lists."""
+    if not isinstance(tree, dict):
+        return tree
+    tree = {k: _listify(v) for k, v in tree.items()}
+    if tree and all(k.isdigit() for k in tree):
+        return [tree[str(i)] for i in range(len(tree))]
+    return tree
+
+
+def _fuse_kv(tree):
+    """Fuse to_k + to_v into to_kv wherever both exist (UNet attention)."""
+    if isinstance(tree, list):
+        return [_fuse_kv(v) for v in tree]
+    if not isinstance(tree, dict):
+        return tree
+    tree = {k: _fuse_kv(v) for k, v in tree.items()}
+    if "to_k" in tree and "to_v" in tree and "to_q" in tree and "group_norm" not in tree:
+        k, v = tree.pop("to_k"), tree.pop("to_v")
+        fused = {"kernel": np.concatenate([k["kernel"], v["kernel"]], axis=1)}
+        if "bias" in k:
+            fused["bias"] = np.concatenate([k["bias"], v["bias"]])
+        tree["to_kv"] = fused
+    return tree
+
+
+def _convert(sd: Dict[str, np.ndarray], *, skip=()) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for key, val in sd.items():
+        if any(s in key for s in skip):
+            continue
+        parts = _rename(key.split("."))
+        parts, v = _convert_leaf(parts, val)
+        if parts[-1] == "__direct__":
+            node = tree
+            for p in parts[:-2]:
+                node = node.setdefault(p, {})
+            node[parts[-2]] = v
+        else:
+            _assign(tree, parts, v)
+    return _listify(tree)
+
+
+def _cast(tree, dtype):
+    import jax
+
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+
+
+def convert_unet_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
+    """diffusers UNet2DConditionModel state_dict -> unet.py param tree."""
+    tree = _convert(sd, skip=("position_ids",))
+    tree = _fuse_kv(tree)
+    return _cast(tree, dtype)
+
+
+def convert_vae_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
+    """diffusers AutoencoderKL state_dict -> vae.py param tree (to_k/to_v kept
+    separate — the VAE mid attention uses them unfused)."""
+    renames = {"query": "to_q", "key": "to_k", "value": "to_v", "proj_attn": "to_out"}
+    sd = {
+        ".".join(renames.get(p, p) for p in k.split(".")): v for k, v in sd.items()
+    }
+    return _cast(_convert(sd), dtype)
+
+
+def convert_clip_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
+    """transformers CLIPTextModel(-WithProjection) state_dict -> clip.py tree."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        if k.endswith("position_ids"):
+            continue
+        k = k.replace("text_model.", "")
+        k = k.replace("embeddings.token_embedding", "token_embedding")
+        k = k.replace("embeddings.position_embedding", "position_embedding")
+        k = k.replace("encoder.layers", "layers")
+        out[k] = v
+    return _cast(_convert(out), dtype)
+
+
+# ---------------------------------------------------------------------------
+# on-disk cache of converted trees
+# ---------------------------------------------------------------------------
+
+
+def _flatten(tree, prefix=""):
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            flat.update(_flatten(v, f"{prefix}{k}."))
+    elif isinstance(tree, list):
+        for i, v in enumerate(tree):
+            flat.update(_flatten(v, f"{prefix}{i}."))
+    else:
+        flat[prefix[:-1]] = np.asarray(tree)
+    return flat
+
+
+def save_params(path: str, tree) -> None:
+    np.savez(path, **_flatten(tree))
+
+
+def load_params(path: str, dtype=jnp.float32):
+    data = np.load(path)
+    tree: Dict[str, Any] = {}
+    for key in data.files:
+        _assign(tree, key.split("."), data[key])
+    return _cast(_listify(tree), dtype)
