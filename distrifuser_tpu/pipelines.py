@@ -1,0 +1,356 @@
+"""User-facing pipelines: DistriSDXLPipeline and DistriSDPipeline.
+
+API parity with the reference (/root/reference/distrifuser/pipelines.py):
+``from_pretrained(distri_config, pretrained_model_name_or_path, ...)`` then
+``pipeline(prompt=..., seed=...)`` returning an object with ``.images``.
+Differences are the TPU-native ones:
+
+* The reference wraps a diffusers pipeline and swaps the UNet
+  (pipelines.py:26-42); here the whole stack (text encoders, UNet, VAE,
+  scheduler, denoise loop) is native JAX, and the denoise loop is one
+  compiled program (parallel/runner.py) instead of CUDA-graph replay.
+* ``prepare()`` (pipelines.py:60-165: record passes, buffer allocation,
+  graph capture) reduces to ahead-of-time compilation of the loop — state
+  buffers are created *by* the first traced step.
+* Weights come from a local HuggingFace snapshot directory (safetensors),
+  converted once via models/weights.py; ``from_params`` builds a pipeline
+  from in-memory pytrees (tests, random weights).
+
+Height/width are fixed at DistriConfig time exactly like the reference
+(pipelines.py:47-55 forbids per-call height/width); guidance_scale is forced
+to 1 when CFG is disabled (pipelines.py:52-58 — with its double-negation bug
+fixed, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import clip as clip_mod
+from .models import unet as unet_mod
+from .models import vae as vae_mod
+from .models.weights import (
+    convert_clip_state_dict,
+    convert_unet_state_dict,
+    convert_vae_state_dict,
+    load_sharded_safetensors,
+)
+from .parallel.runner import make_runner
+from .schedulers import BaseScheduler, get_scheduler
+from .utils.config import DistriConfig
+
+
+class SimpleTokenizer:
+    """Deterministic hash fallback tokenizer.
+
+    Real generation quality needs the CLIP BPE vocab (pass a HF tokenizer or
+    a snapshot dir to from_pretrained); this fallback keeps every pipeline
+    path runnable — tests, benchmarks, random-weight smoke runs — on a box
+    with no vocab files.
+    """
+
+    model_max_length = 77
+
+    def __init__(self, vocab_size: int = 49408, eos: int = 49407, bos: int = 49406):
+        self.vocab_size = vocab_size
+        self.eos = eos
+        self.bos = bos
+
+    def __call__(self, texts: List[str], max_length: int = 77):
+        ids = np.full((len(texts), max_length), self.eos, np.int64)
+        for i, t in enumerate(texts):
+            toks = [self.bos] + [
+                (hash(w) % (self.vocab_size - 2)) for w in t.lower().split()
+            ][: max_length - 2]
+            toks.append(self.eos)
+            ids[i, : len(toks)] = toks
+        return ids
+
+
+def _hf_tokenizer(path: str):
+    from transformers import CLIPTokenizer
+
+    return CLIPTokenizer.from_pretrained(path)
+
+
+def _tokenize(tok, texts: List[str]) -> np.ndarray:
+    if isinstance(tok, SimpleTokenizer):
+        return tok(texts)
+    out = tok(
+        texts, padding="max_length", max_length=tok.model_max_length,
+        truncation=True, return_tensors="np",
+    )
+    return np.asarray(out["input_ids"])
+
+
+@dataclasses.dataclass
+class PipelineOutput:
+    images: List[Any]
+
+
+class _DistriPipelineBase:
+    """Shared machinery; subclasses define the text-encoding recipe."""
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        unet_config: unet_mod.UNetConfig,
+        unet_params,
+        vae_config: vae_mod.VAEConfig,
+        vae_params,
+        scheduler: BaseScheduler,
+        tokenizers,
+        text_encoders,  # list of (CLIPTextConfig, params)
+    ):
+        self.distri_config = distri_config
+        self.unet_config = unet_config
+        self.vae_config = vae_config
+        self.vae_params = vae_params
+        self.scheduler = scheduler
+        self.tokenizers = tokenizers
+        self.text_encoders = text_encoders
+        self.runner = make_runner(distri_config, unet_config, unet_params, scheduler)
+        self._decode = jax.jit(
+            lambda p, l: vae_mod.decode(p, self.vae_config, l)
+        )
+
+    # -- reference API ---------------------------------------------------
+    def set_progress_bar_config(self, **kwargs):  # parity no-op (rank gating)
+        pass
+
+    def prepare(self, num_inference_steps: int = 50, **kwargs) -> None:
+        """AOT-compile the denoise loop (the reference's record/capture phase,
+        pipelines.py:60-165)."""
+        if num_inference_steps not in self.runner._compiled:
+            self.runner._compiled[num_inference_steps] = self.runner._build(
+                num_inference_steps
+            )
+
+    def __call__(
+        self,
+        prompt: str | List[str],
+        negative_prompt: str | List[str] = "",
+        num_inference_steps: int = 50,
+        guidance_scale: float = 5.0,
+        seed: int = 0,
+        output_type: str = "pil",
+        **kwargs,
+    ) -> PipelineOutput:
+        cfg = self.distri_config
+        if "height" in kwargs or "width" in kwargs:
+            raise ValueError(
+                "height and width are fixed in DistriConfig (reference "
+                "pipelines.py:47-55)"
+            )
+        if not cfg.do_classifier_free_guidance:
+            guidance_scale = 1.0
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        negs = (
+            [negative_prompt] * len(prompts)
+            if isinstance(negative_prompt, str)
+            else list(negative_prompt)
+        )
+        assert len(prompts) == cfg.batch_size, (
+            f"config batch_size={cfg.batch_size}, got {len(prompts)} prompts"
+        )
+
+        embeds, added = self._encode(prompts, negs)
+
+        key = jax.random.PRNGKey(seed)
+        latents = jax.random.normal(
+            key,
+            (len(prompts), cfg.latent_height, cfg.latent_width,
+             self.unet_config.in_channels),
+            jnp.float32,
+        )
+        self.scheduler.set_timesteps(num_inference_steps)
+        latents = latents * self.scheduler.init_noise_sigma
+
+        latent = self.runner.generate(
+            latents, embeds,
+            guidance_scale=guidance_scale,
+            num_inference_steps=num_inference_steps,
+            added_cond=added,
+        )
+        if output_type == "latent":
+            return PipelineOutput(images=[np.asarray(latent)])
+        image = self._decode(
+            self.vae_params, latent / self.vae_config.scaling_factor
+        )
+        image = np.asarray(image, np.float32)
+        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
+        if output_type == "np":
+            return PipelineOutput(images=list(image))
+        from PIL import Image
+
+        return PipelineOutput(
+            images=[Image.fromarray((im * 255).round().astype(np.uint8)) for im in image]
+        )
+
+    # -- helpers ----------------------------------------------------------
+    def _clip(self, which: int, ids):
+        ccfg, cparams = self.text_encoders[which]
+        return clip_mod.clip_text_forward(cparams, ccfg, ids)
+
+    def _encode(self, prompts, negs):
+        raise NotImplementedError
+
+
+class DistriSDXLPipeline(_DistriPipelineBase):
+    """SDXL: two text encoders, penultimate hidden states concatenated, pooled
+    embeds + micro-conditioning time_ids (reference pipelines.py:10-167)."""
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        distri_config: DistriConfig,
+        pretrained_model_name_or_path: str,
+        scheduler: str | BaseScheduler = "ddim",
+        dtype=None,
+        **kwargs,
+    ) -> "DistriSDXLPipeline":
+        root = pretrained_model_name_or_path
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root!r} is not a local model directory. This box has no "
+                "network egress; download a HF snapshot (unet/, vae/, "
+                "text_encoder/, text_encoder_2/, tokenizer/) first."
+            )
+        dtype = dtype or distri_config.dtype
+        unet_params = convert_unet_state_dict(
+            load_sharded_safetensors(os.path.join(root, "unet")), dtype
+        )
+        vae_params = convert_vae_state_dict(
+            load_sharded_safetensors(os.path.join(root, "vae")), dtype
+        )
+        te1 = convert_clip_state_dict(
+            load_sharded_safetensors(os.path.join(root, "text_encoder")), dtype
+        )
+        te2 = convert_clip_state_dict(
+            load_sharded_safetensors(os.path.join(root, "text_encoder_2")), dtype
+        )
+        try:
+            tok1 = _hf_tokenizer(os.path.join(root, "tokenizer"))
+            tok2 = _hf_tokenizer(os.path.join(root, "tokenizer_2"))
+        except Exception:
+            tok1 = tok2 = SimpleTokenizer()
+        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        return cls(
+            distri_config,
+            unet_mod.sdxl_config(),
+            unet_params,
+            vae_mod.sdxl_vae_config(),
+            vae_params,
+            sched,
+            [tok1, tok2],
+            [
+                (clip_mod.clip_vit_l_config(), te1),
+                (clip_mod.open_clip_bigg_config(), te2),
+            ],
+        )
+
+    @classmethod
+    def from_params(cls, distri_config, unet_config, unet_params, vae_config,
+                    vae_params, text_configs, text_params, scheduler="ddim",
+                    tokenizers=None):
+        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        toks = tokenizers or [SimpleTokenizer(tc.vocab_size) for tc in text_configs]
+        return cls(
+            distri_config, unet_config, unet_params, vae_config, vae_params,
+            sched, toks, list(zip(text_configs, text_params)),
+        )
+
+    def _encode(self, prompts, negs):
+        cfg = self.distri_config
+        texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        b = len(prompts)
+
+        ids1 = _tokenize(self.tokenizers[0], texts)
+        ids2 = _tokenize(self.tokenizers[1], texts)
+        out1 = self._clip(0, ids1)
+        out2 = self._clip(1, ids2)
+        # SDXL conditioning: concat penultimate hidden states of both encoders
+        emb = jnp.concatenate(
+            [out1["hidden_states"][-2], out2["hidden_states"][-2]], axis=-1
+        )
+        emb = emb.reshape(n_br, b, *emb.shape[1:])
+        pooled = out2["text_embeds"].reshape(n_br, b, -1)
+        time_ids = jnp.asarray(
+            [cfg.height, cfg.width, 0, 0, cfg.height, cfg.width], jnp.float32
+        )
+        time_ids = jnp.tile(time_ids[None, None], (n_br, b, 1))
+        added = {"text_embeds": pooled, "time_ids": time_ids}
+        return emb, added
+
+
+class DistriSDPipeline(_DistriPipelineBase):
+    """SD 1.4/1.5/2.x: single text encoder, final hidden state
+    (reference pipelines.py:170-299)."""
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        distri_config: DistriConfig,
+        pretrained_model_name_or_path: str,
+        scheduler: str | BaseScheduler = "ddim",
+        dtype=None,
+        **kwargs,
+    ) -> "DistriSDPipeline":
+        root = pretrained_model_name_or_path
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root!r} is not a local model directory (no network egress)."
+            )
+        dtype = dtype or distri_config.dtype
+        unet_params = convert_unet_state_dict(
+            load_sharded_safetensors(os.path.join(root, "unet")), dtype
+        )
+        vae_params = convert_vae_state_dict(
+            load_sharded_safetensors(os.path.join(root, "vae")), dtype
+        )
+        te = convert_clip_state_dict(
+            load_sharded_safetensors(os.path.join(root, "text_encoder")), dtype
+        )
+        try:
+            tok = _hf_tokenizer(os.path.join(root, "tokenizer"))
+        except Exception:
+            tok = SimpleTokenizer()
+        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        return cls(
+            distri_config,
+            unet_mod.sd15_config(),
+            unet_params,
+            vae_mod.sd_vae_config(),
+            vae_params,
+            sched,
+            [tok],
+            [(clip_mod.clip_vit_l_config(), te)],
+        )
+
+    @classmethod
+    def from_params(cls, distri_config, unet_config, unet_params, vae_config,
+                    vae_params, text_configs, text_params, scheduler="ddim",
+                    tokenizers=None):
+        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        toks = tokenizers or [SimpleTokenizer(tc.vocab_size) for tc in text_configs]
+        return cls(
+            distri_config, unet_config, unet_params, vae_config, vae_params,
+            sched, toks, list(zip(text_configs, text_params)),
+        )
+
+    def _encode(self, prompts, negs):
+        cfg = self.distri_config
+        texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        b = len(prompts)
+        ids = _tokenize(self.tokenizers[0], texts)
+        out = self._clip(0, ids)
+        emb = out["last_hidden_state"]
+        return emb.reshape(n_br, b, *emb.shape[1:]), None

@@ -1,0 +1,134 @@
+"""Shared CLI helpers for the example / benchmark / eval scripts.
+
+The reference scripts build a DistriConfig from flags and call
+from_pretrained with a HF hub id (/root/reference/scripts/run_sdxl.py:84-111).
+This box has zero egress, so every script takes ``--model_path`` (a local HF
+snapshot dir) or ``--random_weights`` (architecture-faithful random params —
+useful for latency benchmarks, which don't depend on weight values).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models import clip as clip_mod
+from distrifuser_tpu.models import unet as unet_mod
+from distrifuser_tpu.models import vae as vae_mod
+from distrifuser_tpu.pipelines import DistriSDPipeline, DistriSDXLPipeline
+
+
+def add_distri_args(parser: argparse.ArgumentParser) -> None:
+    """The reference's full flag surface (run_sdxl.py:13-71, SURVEY.md §2.9)."""
+    parser.add_argument("--model_path", type=str, default=None,
+                        help="local HF snapshot dir (unet/, vae/, text_encoder*/)")
+    parser.add_argument("--random_weights", action="store_true",
+                        help="run with architecture-faithful random weights")
+    parser.add_argument("--prompt", type=str,
+                        default="Astronaut in a jungle, cold color palette, "
+                        "muted colors, detailed, 8k")
+    parser.add_argument("--output_path", type=str, default="output.png")
+    parser.add_argument("--num_inference_steps", type=int, default=50)
+    parser.add_argument("--image_size", type=int, nargs="*", default=[1024, 1024])
+    parser.add_argument("--guidance_scale", type=float, default=5.0)
+    parser.add_argument("--scheduler", type=str, default="ddim",
+                        choices=["ddim", "euler", "dpm-solver"])
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--no_split_batch", action="store_true",
+                        help="disable CFG batch splitting")
+    parser.add_argument("--warmup_steps", type=int, default=4)
+    parser.add_argument("--sync_mode", type=str, default="corrected_async_gn",
+                        choices=["separate_gn", "stale_gn", "corrected_async_gn",
+                                 "sync_gn", "full_sync", "no_sync"])
+    parser.add_argument("--parallelism", type=str, default="patch",
+                        choices=["patch", "tensor", "naive_patch"])
+    parser.add_argument("--no_cuda_graph", action="store_true",
+                        help="parity alias: disable the fused compiled loop")
+    parser.add_argument("--split_scheme", type=str, default="row",
+                        choices=["row", "col", "alternate"])
+    parser.add_argument("--output_type", type=str, default="pil",
+                        choices=["latent", "pil"])
+
+
+def config_from_args(args) -> DistriConfig:
+    size = args.image_size
+    if isinstance(size, int):
+        h = w = size
+    elif len(size) == 1:
+        h = w = size[0]
+    else:
+        h, w = size
+    return DistriConfig(
+        height=h,
+        width=w,
+        split_batch=not args.no_split_batch,
+        warmup_steps=args.warmup_steps,
+        mode=args.sync_mode,
+        use_cuda_graph=not args.no_cuda_graph,
+        parallelism=args.parallelism,
+        split_scheme=args.split_scheme,
+    )
+
+
+def _random_sdxl_pipeline(distri_config: DistriConfig) -> DistriSDXLPipeline:
+    ucfg = unet_mod.sdxl_config()
+    vcfg = vae_mod.sdxl_vae_config()
+    tc1 = clip_mod.clip_vit_l_config()
+    tc2 = clip_mod.open_clip_bigg_config()
+    dt = distri_config.dtype
+    return DistriSDXLPipeline.from_params(
+        distri_config, ucfg,
+        unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dt),
+        vcfg, vae_mod.init_vae_params(jax.random.PRNGKey(1), vcfg, dt),
+        [tc1, tc2],
+        [clip_mod.init_clip_params(jax.random.PRNGKey(2), tc1, dt),
+         clip_mod.init_clip_params(jax.random.PRNGKey(3), tc2, dt)],
+    )
+
+
+def _random_sd_pipeline(distri_config: DistriConfig) -> DistriSDPipeline:
+    ucfg = unet_mod.sd15_config()
+    vcfg = vae_mod.sd_vae_config()
+    tc = clip_mod.clip_vit_l_config()
+    dt = distri_config.dtype
+    return DistriSDPipeline.from_params(
+        distri_config, ucfg,
+        unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dt),
+        vcfg, vae_mod.init_vae_params(jax.random.PRNGKey(1), vcfg, dt),
+        [tc], [clip_mod.init_clip_params(jax.random.PRNGKey(2), tc, dt)],
+    )
+
+
+def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> DistriSDXLPipeline:
+    scheduler = scheduler or args.scheduler
+    if args.model_path:
+        return DistriSDXLPipeline.from_pretrained(
+            distri_config, args.model_path, scheduler=scheduler
+        )
+    if args.random_weights:
+        pipe = _random_sdxl_pipeline(distri_config)
+        pipe.scheduler.__init__()  # keep defaults
+        return pipe
+    raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
+
+
+def load_sd_pipeline(args, distri_config: DistriConfig, scheduler=None) -> DistriSDPipeline:
+    scheduler = scheduler or args.scheduler
+    if args.model_path:
+        return DistriSDPipeline.from_pretrained(
+            distri_config, args.model_path, scheduler=scheduler
+        )
+    if args.random_weights:
+        return _random_sd_pipeline(distri_config)
+    raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
+
+
+def is_main_process() -> bool:
+    """Rank-0 gating parity (reference: distri_config.rank == 0)."""
+    return jax.process_index() == 0

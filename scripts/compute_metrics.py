@@ -1,0 +1,89 @@
+"""Pairwise image-quality metrics between two result directories
+(parity: /root/reference/scripts/compute_metrics.py).
+
+PSNR is computed natively (no extra deps).  LPIPS (pretrained AlexNet/VGG)
+and FID (pretrained InceptionV3) need weights this zero-egress box cannot
+fetch; they run when `lpips` / `cleanfid` + their caches are present and are
+reported as unavailable otherwise — same metrics surface as the reference
+(compute_metrics.py:62-79), degraded gracefully.
+"""
+
+import argparse
+import os
+
+import numpy as np
+from PIL import Image
+
+
+class MultiImageDataset:
+    """Paired iteration over two image directories
+    (reference compute_metrics.py:26-50)."""
+
+    def __init__(self, root0: str, root1: str, is_gt: bool = False):
+        self.roots = [root0, root1]
+        self.is_gt = is_gt
+        self.names = []
+        names0 = {f for f in os.listdir(root0) if f.lower().endswith((".png", ".jpg"))}
+        names1 = {f for f in os.listdir(root1) if f.lower().endswith((".png", ".jpg"))}
+        self.names = sorted(names0 & names1)
+        if not self.names:
+            raise SystemExit("no paired images between the two directories")
+
+    def __len__(self):
+        return len(self.names)
+
+    def __getitem__(self, i):
+        imgs = []
+        for j, root in enumerate(self.roots):
+            img = Image.open(os.path.join(root, self.names[i])).convert("RGB")
+            if self.is_gt and j == 0:
+                # reference resizes GT to the generated resolution (:44-46)
+                other = Image.open(os.path.join(self.roots[1], self.names[i]))
+                img = img.resize(other.size, Image.LANCZOS)
+            imgs.append(np.asarray(img, np.float64) / 255.0)
+        return imgs
+
+
+def psnr(a: np.ndarray, b: np.ndarray) -> float:
+    mse = float(np.mean((a - b) ** 2))
+    return 10 * np.log10(1.0 / max(mse, 1e-12))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input_root0", type=str, required=True)
+    parser.add_argument("--input_root1", type=str, required=True)
+    parser.add_argument("--is_gt", action="store_true")
+    parser.add_argument("--batch_size", type=int, default=64)  # parity flag
+    args = parser.parse_args()
+
+    ds = MultiImageDataset(args.input_root0, args.input_root1, is_gt=args.is_gt)
+    psnrs = [psnr(*ds[i]) for i in range(len(ds))]
+    print(f"PSNR: {np.mean(psnrs):.4f} dB over {len(ds)} pairs")
+
+    try:
+        import lpips  # type: ignore
+        import torch
+
+        net = lpips.LPIPS(net="alex")
+        vals = []
+        for i in range(len(ds)):
+            a, b = ds[i]
+            ta = torch.tensor(a * 2 - 1, dtype=torch.float32).permute(2, 0, 1)[None]
+            tb = torch.tensor(b * 2 - 1, dtype=torch.float32).permute(2, 0, 1)[None]
+            vals.append(float(net(ta, tb)))
+        print(f"LPIPS: {np.mean(vals):.4f}")
+    except Exception as e:
+        print(f"LPIPS: unavailable ({type(e).__name__}: pretrained weights need network)")
+
+    try:
+        from cleanfid import fid  # type: ignore
+
+        score = fid.compute_fid(args.input_root0, args.input_root1)
+        print(f"FID: {score:.4f}")
+    except Exception as e:
+        print(f"FID: unavailable ({type(e).__name__}: pretrained weights need network)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Dump COCO validation captions + ground-truth images to disk
+(parity: /root/reference/scripts/dump_coco.py).
+
+Needs HF datasets with network or a local cache; on the zero-egress box this
+documents the expected artifact format for generate_coco.py --caption_file.
+"""
+import argparse
+import json
+import os
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output_root", type=str, default="coco")
+    parser.add_argument("--num_images", type=int, default=5000)
+    args = parser.parse_args()
+
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("HuggingFaceM4/COCO", "2014_captions", split="validation")
+    except Exception as e:
+        raise SystemExit(
+            f"HF datasets unavailable ({e}). Run on a networked machine; it "
+            f"writes {args.output_root}/captions.json (list of strings) and "
+            f"{args.output_root}/images/NNNN.png ground truths."
+        )
+
+    os.makedirs(os.path.join(args.output_root, "images"), exist_ok=True)
+    captions = []
+    for i, row in enumerate(ds):
+        if i >= args.num_images:
+            break
+        captions.append(row["sentences_raw"][0])
+        row["image"].save(os.path.join(args.output_root, "images", f"{i:04d}.png"))
+    with open(os.path.join(args.output_root, "captions.json"), "w") as f:
+        json.dump(captions, f, indent=1)
+    print(f"dumped {len(captions)} captions + images to {args.output_root}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Side-by-side HTML image grid across result directories
+(parity: /root/reference/scripts/export_html.py, minus the `dominate`
+dependency — plain string templating, same artifact)."""
+
+import argparse
+import html
+import os
+import shutil
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input_roots", type=str, nargs="+", required=True)
+    parser.add_argument("--output_root", type=str, default="html")
+    parser.add_argument("--max_images", type=int, default=100)
+    parser.add_argument("--copy", action="store_true",
+                        help="copy images instead of symlinking")
+    args = parser.parse_args()
+
+    os.makedirs(args.output_root, exist_ok=True)
+    names = None
+    for root in args.input_roots:
+        files = {f for f in os.listdir(root) if f.lower().endswith((".png", ".jpg"))}
+        names = files if names is None else (names & files)
+    names = sorted(names or [])[: args.max_images]
+    if not names:
+        raise SystemExit("no common images across the input roots")
+
+    rows = []
+    header = "".join(f"<th>{html.escape(r)}</th>" for r in args.input_roots)
+    for name in names:
+        cells = []
+        for i, root in enumerate(args.input_roots):
+            sub = os.path.join(args.output_root, f"col{i}")
+            os.makedirs(sub, exist_ok=True)
+            dst = os.path.join(sub, name)
+            src = os.path.abspath(os.path.join(root, name))
+            if not os.path.exists(dst):
+                shutil.copy(src, dst) if args.copy else os.symlink(src, dst)
+            cells.append(f'<td><img src="col{i}/{name}" width="384"></td>')
+        rows.append(f"<tr><td>{html.escape(name)}</td>{''.join(cells)}</tr>")
+
+    page = (
+        "<html><head><style>td,th{padding:4px;text-align:center;"
+        "font-family:sans-serif}</style></head><body><table>"
+        f"<tr><th>image</th>{header}</tr>{''.join(rows)}</table></body></html>"
+    )
+    out = os.path.join(args.output_root, "index.html")
+    with open(out, "w") as f:
+        f.write(page)
+    print(f"wrote {out} with {len(names)} rows x {len(args.input_roots)} columns")
+
+
+if __name__ == "__main__":
+    main()

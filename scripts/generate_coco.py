@@ -1,0 +1,87 @@
+"""COCO caption generation for quality evaluation
+(parity: /root/reference/scripts/generate_coco.py).
+
+Generates images for the COCO 2014-captions validation prompts with a
+deterministic per-index seed (generate_coco.py:120), into an auto-named
+directory encoding scheduler/steps/devices/warmup/sync-mode
+(generate_coco.py:96-103).  ``--split k n`` chunks the 5000 prompts for
+sharded sweeps (generate_coco.py:109-116).
+
+Prompt sources (zero-egress box): ``--caption_file`` (JSON list of strings,
+e.g. produced by dump_coco.py on a networked machine) or HF datasets if a
+local cache exists.
+"""
+
+import argparse
+import json
+import os
+
+import jax
+
+from common import add_distri_args, config_from_args, is_main_process, load_sdxl_pipeline
+
+
+def load_captions(args):
+    if args.caption_file:
+        with open(args.caption_file) as f:
+            data = json.load(f)
+        return [d["caption"] if isinstance(d, dict) else d for d in data]
+    try:
+        from datasets import load_dataset
+
+        ds = load_dataset("HuggingFaceM4/COCO", "2014_captions", split="validation")
+        return [row["sentences_raw"][0] for row in ds]
+    except Exception as e:
+        raise SystemExit(
+            f"no --caption_file and HF datasets unavailable offline ({e}); "
+            "run dump_coco.py on a networked machine first"
+        )
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    add_distri_args(parser)
+    parser.add_argument("--caption_file", type=str, default=None)
+    parser.add_argument("--num_images", type=int, default=5000)
+    parser.add_argument("--split", type=int, nargs=2, default=None,
+                        metavar=("K", "N"), help="process chunk k of n")
+    parser.add_argument("--results_dir", type=str, default="results/coco")
+    args = parser.parse_args()
+
+    distri_config = config_from_args(args)
+    pipeline = load_sdxl_pipeline(args, distri_config)
+    pipeline.set_progress_bar_config(disable=not is_main_process())
+
+    # auto-named output dir (generate_coco.py:96-103)
+    folder = (
+        f"{args.scheduler}-{args.num_inference_steps}"
+        f"/devices{distri_config.world_size}-warmup{args.warmup_steps}"
+        f"-{args.sync_mode}-{args.parallelism}"
+    )
+    out_dir = os.path.join(args.results_dir, folder)
+    os.makedirs(out_dir, exist_ok=True)
+
+    captions = load_captions(args)[: args.num_images]
+    start, end = 0, len(captions)
+    if args.split is not None:
+        k, n = args.split
+        per = (len(captions) + n - 1) // n
+        start, end = k * per, min((k + 1) * per, len(captions))
+
+    for i in range(start, end):
+        path = os.path.join(out_dir, f"{i:04d}.png")
+        if os.path.exists(path):
+            continue
+        output = pipeline(
+            prompt=captions[i],
+            num_inference_steps=args.num_inference_steps,
+            guidance_scale=args.guidance_scale,
+            seed=i,  # deterministic per-index seed (generate_coco.py:120)
+        )
+        if is_main_process():
+            output.images[0].save(path)
+            print(f"[{i}] {path}")
+
+
+if __name__ == "__main__":
+    main()

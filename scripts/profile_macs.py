@@ -1,0 +1,67 @@
+"""UNet cost profile via XLA HLO analysis
+(parity: /root/reference/scripts/profile_macs.py, which uses
+torchprofile.profile_macs on one stock UNet forward).
+
+The TPU-native equivalent: lower one jitted UNet forward and read XLA's own
+cost analysis (FLOPs / bytes accessed) — the numbers the compiler schedules
+by, not an external estimator.  Reports per-resolution like the reference
+(profile_macs.py:33-46).
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from common import add_distri_args  # noqa: F401 (repo path setup)
+from distrifuser_tpu.models import unet as unet_mod
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", type=str, default="sdxl", choices=["sdxl", "sd15", "tiny"])
+    parser.add_argument("--image_size", type=int, nargs="*", default=[1024])
+    parser.add_argument("--batch_size", type=int, default=1)
+    parser.add_argument("--dtype", type=str, default="bfloat16")
+    args = parser.parse_args()
+
+    cfgs = {
+        "sdxl": unet_mod.sdxl_config,
+        "sd15": unet_mod.sd15_config,
+        "tiny": unet_mod.tiny_config,
+    }
+    ucfg = cfgs[args.model]()
+    dtype = jnp.dtype(args.dtype)
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dtype)
+
+    sizes = args.image_size if len(args.image_size) > 0 else [1024]
+    for size in sizes:
+        h = w = size // 8
+        sample = jnp.zeros((args.batch_size, h, w, ucfg.in_channels), dtype)
+        enc = jnp.zeros((args.batch_size, 77, ucfg.cross_attention_dim), dtype)
+        added = None
+        if ucfg.addition_embed_type == "text_time":
+            added = {
+                "text_embeds": jnp.zeros((args.batch_size, 1280), dtype),
+                "time_ids": jnp.zeros((args.batch_size, 6), dtype),
+            }
+
+        fn = jax.jit(
+            lambda p, s, e: unet_mod.unet_forward(
+                p, ucfg, s, jnp.asarray([500.0] * args.batch_size), e, added_cond=added
+            )
+        )
+        lowered = fn.lower(params, sample, enc)
+        cost = lowered.cost_analysis()
+        flops = cost.get("flops", float("nan"))
+        bytes_ = cost.get("bytes accessed", float("nan"))
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        print(
+            f"{args.model} @ {size}x{size}: {flops / 1e9:.2f} GFLOPs "
+            f"(~{flops / 2e9:.2f} GMACs), {bytes_ / 1e9:.2f} GB accessed, "
+            f"{n_params / 1e6:.1f}M params"
+        )
+
+
+if __name__ == "__main__":
+    main()

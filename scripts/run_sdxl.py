@@ -1,0 +1,79 @@
+"""Generation / benchmark CLI (parity: /root/reference/scripts/run_sdxl.py).
+
+benchmark mode reproduces the reference's protocol (run_sdxl.py:124-153):
+``--warmup_times`` untimed runs, ``--test_times`` timed runs, latencies
+sorted, ``--ignore_ratio`` trimmed off the extremes, mean reported.
+``--output_type latent`` excludes the VAE decode, matching the reference's
+benchmark setting.
+"""
+
+import argparse
+import time
+
+import jax
+
+from common import (
+    add_distri_args,
+    config_from_args,
+    is_main_process,
+    load_sdxl_pipeline,
+)
+
+
+def get_args() -> argparse.Namespace:
+    parser = argparse.ArgumentParser()
+    add_distri_args(parser)
+    parser.add_argument("--mode", type=str, default="generation",
+                        choices=["generation", "benchmark"])
+    parser.add_argument("--warmup_times", type=int, default=5)
+    parser.add_argument("--test_times", type=int, default=20)
+    parser.add_argument("--ignore_ratio", type=float, default=0.2)
+    return parser.parse_args()
+
+
+def main():
+    args = get_args()
+    distri_config = config_from_args(args)
+    pipeline = load_sdxl_pipeline(args, distri_config)
+    pipeline.set_progress_bar_config(disable=not is_main_process())
+
+    def run(seed: int):
+        return pipeline(
+            prompt=args.prompt,
+            num_inference_steps=args.num_inference_steps,
+            guidance_scale=args.guidance_scale,
+            seed=seed,
+            output_type=args.output_type,
+        )
+
+    if args.mode == "generation":
+        output = run(args.seed)
+        if is_main_process() and args.output_type == "pil":
+            output.images[0].save(args.output_path)
+            print(f"saved {args.output_path}")
+        return
+
+    # benchmark (reference run_sdxl.py:124-153)
+    for _ in range(args.warmup_times):
+        out = run(args.seed)
+        jax.block_until_ready(out.images[0]) if args.output_type == "latent" else None
+
+    latencies = []
+    for i in range(args.test_times):
+        t0 = time.perf_counter()
+        out = run(args.seed + i)
+        # device sync (the reference's torch.cuda.synchronize)
+        if args.output_type == "latent":
+            jax.block_until_ready(out.images[0])
+        latencies.append(time.perf_counter() - t0)
+
+    latencies.sort()
+    trim = int(args.test_times * args.ignore_ratio / 2)
+    kept = latencies[trim : len(latencies) - trim] or latencies
+    if is_main_process():
+        print(f"Latency: {sum(kept) / len(kept):.5f} s "
+              f"(trimmed mean of {len(kept)}/{len(latencies)} runs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Minimal SD 1.5 usage example (parity: /root/reference/scripts/sd_example.py,
+which uses mode="stale_gn" — sd_example.py:6)."""
+import argparse
+
+from common import add_distri_args, config_from_args, is_main_process, load_sd_pipeline
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    add_distri_args(parser)
+    parser.set_defaults(sync_mode="stale_gn", image_size=[512, 512], guidance_scale=7.5)
+    args = parser.parse_args()
+
+    distri_config = config_from_args(args)
+    pipeline = load_sd_pipeline(args, distri_config)
+    pipeline.set_progress_bar_config(disable=not is_main_process())
+
+    output = pipeline(
+        prompt=args.prompt,
+        num_inference_steps=args.num_inference_steps,
+        guidance_scale=args.guidance_scale,
+        seed=args.seed,
+        output_type=args.output_type,
+    )
+    if is_main_process() and args.output_type == "pil":
+        output.images[0].save(args.output_path)
+        print(f"saved {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Pipeline-level tests with tiny random-weight models on the fake mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models.clip import init_clip_params, tiny_clip_config
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+from distrifuser_tpu.pipelines import (
+    DistriSDPipeline,
+    DistriSDXLPipeline,
+    SimpleTokenizer,
+)
+
+
+def build_sdxl_pipeline(devices, n_dev, **cfg_kw):
+    cfg_kw.setdefault("height", 128)
+    cfg_kw.setdefault("width", 128)
+    cfg_kw.setdefault("warmup_steps", 1)
+    dcfg = DistriConfig(devices=devices[:n_dev], **cfg_kw)
+    # SDXL-shaped tiny stack: the two encoders' hidden widths concat to the
+    # UNet cross_attention_dim (16+16=32); pooled embeds use encoder 2's
+    # projection, which must match ucfg's text_embeds width (32)
+    from distrifuser_tpu.models.clip import CLIPTextConfig
+
+    tc1 = tiny_clip_config(hidden=16)
+    tc2 = CLIPTextConfig(
+        vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=32, projection_dim=32,
+    )
+    ucfg = tiny_config(cross_attention_dim=32, sdxl=True)
+    vcfg = tiny_vae_config()
+    pipe = DistriSDXLPipeline.from_params(
+        dcfg,
+        ucfg,
+        init_unet_params(jax.random.PRNGKey(0), ucfg),
+        vcfg,
+        init_vae_params(jax.random.PRNGKey(1), vcfg),
+        [tc1, tc2],
+        [
+            init_clip_params(jax.random.PRNGKey(2), tc1),
+            init_clip_params(jax.random.PRNGKey(3), tc2),
+        ],
+    )
+    return pipe, dcfg
+
+
+def build_sd_pipeline(devices, n_dev, **cfg_kw):
+    cfg_kw.setdefault("height", 128)
+    cfg_kw.setdefault("width", 128)
+    cfg_kw.setdefault("warmup_steps", 1)
+    dcfg = DistriConfig(devices=devices[:n_dev], **cfg_kw)
+    tc = tiny_clip_config(hidden=32)
+    ucfg = tiny_config(cross_attention_dim=32, sdxl=False)
+    vcfg = tiny_vae_config()
+    pipe = DistriSDPipeline.from_params(
+        dcfg, ucfg,
+        init_unet_params(jax.random.PRNGKey(0), ucfg),
+        vcfg, init_vae_params(jax.random.PRNGKey(1), vcfg),
+        [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+    )
+    return pipe, dcfg
+
+
+def test_sdxl_pipeline_generates_pil(devices8):
+    pipe, _ = build_sdxl_pipeline(devices8, 8)
+    out = pipe("a photo of an astronaut riding a horse", num_inference_steps=3, seed=7)
+    img = out.images[0]
+    # tiny VAE has 2 blocks -> one 2x upsample: 16x16 latent -> 32x32 pixels
+    assert img.size == (32, 32)
+    arr = np.asarray(img)
+    assert arr.dtype == np.uint8 and arr.shape == (32, 32, 3)
+
+
+def test_sdxl_deterministic_per_seed(devices8):
+    pipe, _ = build_sdxl_pipeline(devices8, 4)
+    a = pipe("a corgi", num_inference_steps=2, seed=1, output_type="np").images[0]
+    b = pipe("a corgi", num_inference_steps=2, seed=1, output_type="np").images[0]
+    c = pipe("a corgi", num_inference_steps=2, seed=2, output_type="np").images[0]
+    np.testing.assert_array_equal(a, b)
+    assert np.abs(a - c).max() > 0
+
+
+def test_sdxl_multi_device_matches_single(devices8):
+    """Pipeline-level golden test (the reference's §4 protocol as a unit test)."""
+    pipe1, _ = build_sdxl_pipeline(devices8, 1)
+    pipe8, _ = build_sdxl_pipeline(devices8, 8, mode="full_sync")
+    kw = dict(num_inference_steps=3, seed=11, output_type="np")
+    img1 = pipe1("a lighthouse at dusk", **kw).images[0]
+    img8 = pipe8("a lighthouse at dusk", **kw).images[0]
+    # uint8-scale agreement: PSNR > 30 dB (the reference's quality bar)
+    mse = float(np.mean((img1 - img8) ** 2))
+    psnr = 10 * np.log10(1.0 / max(mse, 1e-12))
+    assert psnr > 30, f"PSNR {psnr:.1f} dB"
+
+
+def test_sd_pipeline_latent_output(devices8):
+    pipe, dcfg = build_sd_pipeline(devices8, 4)
+    out = pipe("a cat", num_inference_steps=2, seed=3, output_type="latent")
+    lat = out.images[0]
+    assert lat.shape == (1, dcfg.latent_height, dcfg.latent_width, 4)
+    assert np.isfinite(lat).all()
+
+
+def test_pipeline_rejects_runtime_size(devices8):
+    pipe, _ = build_sd_pipeline(devices8, 2)
+    with pytest.raises(ValueError, match="fixed in DistriConfig"):
+        pipe("a cat", height=512)
+
+
+def test_guidance_forced_off_without_cfg(devices8):
+    pipe, _ = build_sd_pipeline(devices8, 4, do_classifier_free_guidance=False)
+    out = pipe("a cat", num_inference_steps=2, guidance_scale=9.0, output_type="latent")
+    assert np.isfinite(out.images[0]).all()
+
+
+def test_simple_tokenizer_shapes():
+    tok = SimpleTokenizer()
+    ids = tok(["hello world", ""])
+    assert ids.shape == (2, 77)
+    assert ids[0, 0] == tok.bos
+    assert (ids[1] == tok.eos).sum() >= 76
