@@ -1,0 +1,109 @@
+"""Headline benchmark: SDXL 50-step UNet denoise latency on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference's benchmark mode
+(/root/reference/scripts/run_sdxl.py:124-153): untimed warmup (includes
+compilation), timed runs, trimmed mean, VAE decode excluded
+(--output_type latent equivalent).  The full real-architecture SDXL UNet runs
+with random bf16 weights — latency is weight-value-independent.
+
+vs_baseline: the reference's single-A100 SDXL 1024x1024 50-step DDIM latency
+(PyTorch 2.2, fp16, CFG batch 2) is ~6.6 s/image (DistriFusion paper,
+arXiv 2402.19481, Table 4's 1-GPU column; README.md:30 hardware).
+vs_baseline = 6.6 / measured_seconds, i.e. >1 means faster than the
+reference's single-GPU baseline at the same workload shape.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+A100_SDXL_1024_50STEP_S = 6.6
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--image_size", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--test_times", type=int, default=3)
+    parser.add_argument("--preset", type=str, default=None,
+                        choices=[None, "sdxl", "tiny"], nargs="?")
+    args = parser.parse_args()
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.parallel.runner import make_runner
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    preset = args.preset or ("sdxl" if on_tpu else "tiny")
+    if preset == "sdxl":
+        ucfg = unet_mod.sdxl_config()
+        size = args.image_size
+        metric = f"sdxl_unet_{args.steps}step_{size}px_latency"
+    else:
+        ucfg = unet_mod.tiny_config(sdxl=True)
+        size = 256
+        metric = f"tiny_unet_{args.steps}step_{size}px_latency"
+
+    cfg = DistriConfig(
+        devices=jax.devices()[:1],  # single-chip headline number
+        height=size,
+        width=size,
+        warmup_steps=4,
+        parallelism="patch",
+    )
+    dtype = cfg.dtype
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dtype)
+    runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
+
+    b = 1
+    lat = jax.random.normal(
+        jax.random.PRNGKey(1), (b, size // 8, size // 8, ucfg.in_channels), jnp.float32
+    )
+    enc = jax.random.normal(
+        jax.random.PRNGKey(2), (2, b, 77, ucfg.cross_attention_dim), dtype
+    )
+    added = None
+    if ucfg.addition_embed_type == "text_time":
+        emb_dim = ucfg.projection_class_embeddings_input_dim - 6 * ucfg.addition_time_embed_dim
+        added = {
+            "text_embeds": jnp.zeros((2, b, emb_dim), dtype),
+            "time_ids": jnp.tile(
+                jnp.asarray([size, size, 0, 0, size, size], jnp.float32)[None, None],
+                (2, b, 1),
+            ),
+        }
+
+    def run():
+        out = runner.generate(
+            lat, enc, guidance_scale=5.0, num_inference_steps=args.steps,
+            added_cond=added,
+        )
+        jax.block_until_ready(out)
+        return out
+
+    run()  # warmup: compile + execute
+    times = []
+    for _ in range(args.test_times):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    val = times[len(times) // 2]  # median
+
+    vs = A100_SDXL_1024_50STEP_S / val if preset == "sdxl" and size == 1024 else 0.0
+    print(json.dumps({
+        "metric": metric,
+        "value": round(val, 4),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
