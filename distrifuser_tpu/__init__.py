@@ -8,3 +8,12 @@ communication behind compute by reusing one-step-stale activations.
 
 from .__version__ import __version__
 from .utils.config import DistriConfig, init_multihost
+
+
+def __getattr__(name):
+    # Lazy pipeline exports keep `import distrifuser_tpu` light.
+    if name in ("DistriSDXLPipeline", "DistriSDPipeline"):
+        from . import pipelines
+
+        return getattr(pipelines, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

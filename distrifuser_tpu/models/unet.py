@@ -190,6 +190,10 @@ class PatchDispatch:
         return patch_group_norm(p, x, self.ctx, name, groups=groups, eps=eps)
 
     def self_attn(self, p, x, name, *, heads):
+        if self.ctx.attn_impl == "ring":
+            from ..ops.ring_attention import ring_self_attention
+
+            return ring_self_attention(p, x, self.ctx, name, heads=heads)
         return patch_self_attention(p, x, self.ctx, name, heads=heads)
 
     def cross_attn(self, p, x, name, *, heads, enc):

@@ -31,12 +31,40 @@ from ..parallel.context import PatchContext
 from .linear import linear
 
 
+import os
+
+_FLASH_MIN_LEN = 1024
+
+
+def _flash_eligible(q, k, heads: int) -> bool:
+    """Route to the Pallas flash kernel: TPU, long block-aligned sequences,
+    MXU-friendly head_dim.  DISTRIFUSER_TPU_FLASH=0 disables, =1 forces
+    (interpret mode off-TPU is for tests only)."""
+    env = os.environ.get("DISTRIFUSER_TPU_FLASH")
+    if env == "0":
+        return False
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    d = c // heads
+    aligned = lq % 128 == 0 and lk % 128 == 0 and d % 8 == 0 and c % heads == 0
+    if env == "1":
+        return aligned
+    if jax.devices()[0].platform == "cpu":
+        return False
+    return aligned and lk >= _FLASH_MIN_LEN
+
+
 def sdpa(q, k, v, *, heads: int):
     """Scaled dot-product attention over [B, L, C] tensors with H heads.
 
-    The XLA analog of F.scaled_dot_product_attention (attn.py:87,153):
-    jnp-level einsums that XLA fuses and tiles onto the MXU.
+    The analog of F.scaled_dot_product_attention (attn.py:87,153): the Pallas
+    flash kernel (ops/flash_attention.py) for long sequences on TPU, XLA
+    einsum+softmax otherwise.
     """
+    if _flash_eligible(q, k, heads):
+        from .flash_attention import flash_sdpa
+
+        return flash_sdpa(q, k, v, heads=heads)
     b, lq, c = q.shape
     lk = k.shape[1]
     d = c // heads

@@ -1,0 +1,116 @@
+"""Ring attention over the patch axis: the TPU-idiomatic long-context upgrade.
+
+SURVEY.md §5 pins the reference's limit: its patch self-attention gathers the
+*full* sequence KV onto every device (modules/pp/attn.py:134,138) and stores
+all peers' stale KV in the comm buffers — O(L) memory per device per layer,
+the dominant state cost at >=3840^2.  Ring attention keeps semantics
+identical while holding only O(L/n):
+
+* each device's **own** KV slot is always fresh (reference attn.py:135-138);
+* peers' contributions stream around the ring with `lax.ppermute`, one
+  neighbor hop per step, merged into a numerically-stable online softmax
+  (flash-attention style, fp32 accumulators) — n-1 hops move exactly the same
+  bytes as the all-gather, but chunk-by-chunk, so XLA overlaps each hop with
+  the previous chunk's matmuls;
+* in the sync (warmup / full_sync) phase the rotating chunk is each device's
+  *fresh* KV -> exact full attention; in the stale phase it is each device's
+  *previous-step* KV from the carry -> exactly the displaced semantics, and
+  the carried state shrinks to the local chunk (no refresh collective at all:
+  next step's state is just this step's local KV).
+
+Select with DistriConfig(attn_impl="ring"); "gather" (default) keeps the
+reference-faithful all-gather layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.context import PatchContext
+from .linear import linear
+from .attention import split_kv
+
+
+def _chunk_scores(q, kv_chunk, heads):
+    """q: [B, Lq, C]; kv_chunk: [B, Lk, 2C] -> (s [B,H,Lq,Lk] fp32, v [B,Lk,H,D])."""
+    b, lq, c = q.shape
+    d = c // heads
+    k, v = split_kv(kv_chunk)
+    lk = k.shape[1]
+    qh = q.reshape(b, lq, heads, d)
+    kh = k.reshape(b, lk, heads, d)
+    vh = v.reshape(b, lk, heads, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * (1.0 / d**0.5)
+    return s, vh
+
+
+def _online_merge(carry, s, vh):
+    """Flash-style merge of one chunk into (acc, m, l)."""
+    acc, m, l = carry
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1, keepdims=True)
+    acc = acc * corr + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(vh.dtype), vh
+    ).astype(jnp.float32)
+    return acc, m_new, l
+
+
+def ring_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
+    """Sequence-parallel self-attention with ring-streamed remote KV.
+
+    Same output as ops.attention.patch_self_attention for both phases; state
+    per layer is the local KV chunk [B, L_local, 2C] instead of the gathered
+    [n, B, L_local, 2C].
+    """
+    b, lq, c = x.shape
+    d = c // heads
+    q = linear(p["to_q"], x)
+    kv_local = linear(p["to_kv"], x)  # fresh own chunk
+
+    if ctx.n == 1:
+        k, v = split_kv(kv_local)
+        from .attention import sdpa
+
+        return linear(p["to_out"], sdpa(q, k, v, heads=heads))
+
+    # what rotates: fresh KV in sync phase, previous-step KV in stale phase
+    if ctx.is_sync:
+        rotating = kv_local
+    else:
+        rotating = ctx.stale(name)
+
+    # next step's stale state = this step's own fresh chunk (no collective)
+    if ctx.refresh:
+        ctx.emit(name, kv_local)
+    elif ctx.phase == "stale":
+        ctx.emit(name, rotating)  # no_sync: keep the old chunk forever
+
+    # own (always fresh) contribution first
+    s, vh = _chunk_scores(q, kv_local, heads)
+    acc = jnp.zeros((b, heads, lq, d), jnp.float32)
+    m = jnp.full((b, heads, lq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, heads, lq, 1), jnp.float32)
+    acc, m, l = _online_merge((acc, m, l), s, vh)
+
+    perm = [(i, (i + 1) % ctx.n) for i in range(ctx.n)]
+    buf = rotating
+
+    def body(i, carry):
+        # n-1 hops deliver every *peer* chunk exactly once (hop i brings the
+        # chunk of device r-i-1 mod n); the own chunk was merged fresh above
+        # and never arrives, matching attn.py:135-138.
+        acc, m, l, buf = carry
+        buf = lax.ppermute(buf, ctx.axis, perm=perm)
+        s, vh = _chunk_scores(q, buf, heads)
+        acc, m, l = _online_merge((acc, m, l), s, vh)
+        return acc, m, l, buf
+
+    acc, m, l, _ = lax.fori_loop(0, ctx.n - 1, body, (acc, m, l, buf))
+
+    out = (acc / l).astype(x.dtype)  # [B, H, Lq, D]
+    out = out.transpose(0, 2, 1, 3).reshape(b, lq, c)
+    return linear(p["to_out"], out)

@@ -57,6 +57,7 @@ class PatchContext:
     mode: str  # one of SYNC_MODES
     phase: str  # PHASE_SYNC | PHASE_STALE (static per compilation)
     axis: str = SP_AXIS
+    attn_impl: str = "gather"  # "gather" | "ring" (ops/ring_attention.py)
     state_in: Optional[Dict[str, Any]] = None
     state_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Precomputed text-encoder KV per cross-attention layer. The reference

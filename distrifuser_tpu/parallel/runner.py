@@ -144,6 +144,7 @@ class DenoiseRunner:
                 n=cfg.n_device_per_batch,
                 mode=cfg.mode,
                 phase=phase,
+                attn_impl=cfg.attn_impl,
                 state_in=pstate,
                 text_kv=text_kv,
             )
@@ -308,6 +309,87 @@ class DenoiseRunner:
             )(params, latents, enc, added, gs)
 
         return jax.jit(loop)
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def comm_volume_report(self, batch_size: int = 1, text_len: int = 77):
+        """Per-layer-type stale-buffer element counts.
+
+        Parity with the reference's verbose buffer stats at create_buffer
+        time (utils.py:152-158): reports how many elements per device the
+        displaced-patch state holds, grouped by layer type.  Computed with
+        jax.eval_shape — no device work.
+        """
+        cfg = self.cfg
+        if cfg.parallelism != "patch" or not cfg.is_sp:
+            return {}
+        self.scheduler.set_timesteps(2)
+        step = self._make_step(PHASE_SYNC)
+
+        def one_step(params, latents, enc, added, gs):
+            my_enc, my_added, _ = self._branch_inputs(enc, added)
+            text_kv = (
+                {} if cfg.parallelism == "tensor" else precompute_text_kv(params, my_enc)
+            )
+            sstate = self.scheduler.init_state(latents.shape)
+            _, pstate, _ = step(
+                params, 0, latents.astype(jnp.float32), None, sstate,
+                my_enc, my_added, text_kv, gs,
+            )
+            return pstate
+
+        b = batch_size
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        lat = jax.ShapeDtypeStruct(
+            (b, cfg.latent_height, cfg.latent_width, self.ucfg.in_channels),
+            jnp.float32,
+        )
+        enc = jax.ShapeDtypeStruct(
+            (n_br, b, text_len, self.ucfg.cross_attention_dim), jnp.float32
+        )
+        added = None
+        if self.ucfg.addition_embed_type == "text_time":
+            emb = (
+                self.ucfg.projection_class_embeddings_input_dim
+                - 6 * self.ucfg.addition_time_embed_dim
+            )
+            added = {
+                "text_embeds": jax.ShapeDtypeStruct((n_br, b, emb), jnp.float32),
+                "time_ids": jax.ShapeDtypeStruct((n_br, b, 6), jnp.float32),
+            }
+        gs = jax.ShapeDtypeStruct((), jnp.float32)
+
+        shapes = jax.eval_shape(
+            lambda p, l, e, a, g: shard_map(
+                one_step, mesh=cfg.mesh,
+                in_specs=(self.param_specs, P(), P(), P(), P()),
+                out_specs=P(), check_vma=False,
+            )(p, l, e, a, g),
+            self.params, lat, enc, added, gs,
+        )
+
+        def layer_type(name: str) -> str:
+            if "attn1" in name:
+                return "attn"
+            if "norm" in name:
+                return "gn"
+            return "conv2d"
+
+        report: Dict[str, int] = {}
+        for name, s in shapes.items():
+            t = layer_type(name)
+            report[t] = report.get(t, 0) + int(np.prod(s.shape))
+        if cfg.verbose:
+            total = sum(report.values())
+            print(
+                f"Stale-state buffers: {total / 1e6:.3f}M elements over "
+                f"{len(shapes)} tensors per device."
+            )
+            for t, numel in sorted(report.items()):
+                print(f"  {t}: {numel / 1e6:.3f}M elements")
+        return report
 
     # ------------------------------------------------------------------
     # public API

@@ -95,6 +95,11 @@ class DistriConfig:
     parallelism: str = "patch"
     split_scheme: str = "row"
     verbose: bool = False
+    # Patch self-attention layout: "gather" assembles full KV per device
+    # (reference-faithful, pp/attn.py:134-138); "ring" streams peer KV chunks
+    # around the sp axis with ppermute + online softmax, shrinking per-layer
+    # state from O(L) to O(L/n) — the idiomatic TPU long-context path.
+    attn_impl: str = "gather"
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)
@@ -117,6 +122,10 @@ class DistriConfig:
         if self.split_scheme not in SPLIT_SCHEMES:
             raise ValueError(
                 f"split_scheme must be one of {SPLIT_SCHEMES}, got {self.split_scheme!r}"
+            )
+        if self.attn_impl not in ("gather", "ring"):
+            raise ValueError(
+                f"attn_impl must be 'gather' or 'ring', got {self.attn_impl!r}"
             )
         if self.height % 8 != 0 or self.width % 8 != 0:
             # Same constraint as the reference pipelines (pipelines.py:71).

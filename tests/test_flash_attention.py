@@ -1,0 +1,64 @@
+"""Pallas flash attention vs the XLA softmax oracle (interpret mode on CPU)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu.ops.attention import _flash_eligible, sdpa
+from distrifuser_tpu.ops.flash_attention import flash_sdpa
+
+
+@pytest.mark.parametrize("b,l,heads,d", [(1, 256, 2, 16), (2, 384, 1, 32)])
+def test_flash_matches_sdpa(b, l, heads, d):
+    c = heads * d
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(keys[0], (b, l, c))
+    k = jax.random.normal(keys[1], (b, l, c))
+    v = jax.random.normal(keys[2], (b, l, c))
+    want = sdpa(q, k, v, heads=heads)
+    got = flash_sdpa(q, k, v, heads=heads, block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_cross_lengths():
+    # Lq != Lk (e.g. stale-KV patch attention: local q, global kv)
+    b, heads, d = 1, 2, 16
+    c = heads * d
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(keys[0], (b, 128, c))
+    k = jax.random.normal(keys[1], (b, 512, c))
+    v = jax.random.normal(keys[2], (b, 512, c))
+    want = sdpa(q, k, v, heads=heads)
+    got = flash_sdpa(q, k, v, heads=heads, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_numerical_stability_large_logits():
+    b, heads, d = 1, 1, 8
+    c = d
+    q = jnp.ones((b, 128, c)) * 30.0
+    k = jnp.ones((b, 256, c)) * 30.0
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, 256, c))
+    got = flash_sdpa(q, k, v, heads=heads, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    # all logits equal -> output is the mean of v
+    np.testing.assert_allclose(
+        np.asarray(got[0, 0]), np.asarray(v.mean(axis=1)[0]), atol=1e-4
+    )
+
+
+def test_routing_gates():
+    q = jnp.zeros((1, 256, 32))
+    k = jnp.zeros((1, 256, 32))
+    # CPU default: no flash
+    assert not _flash_eligible(q, k, heads=2)
+    os.environ["DISTRIFUSER_TPU_FLASH"] = "1"
+    try:
+        assert _flash_eligible(q, k, heads=2)
+        # unaligned length -> never
+        assert not _flash_eligible(jnp.zeros((1, 200, 32)), k, heads=2)
+    finally:
+        del os.environ["DISTRIFUSER_TPU_FLASH"]
