@@ -4,7 +4,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Protocol mirrors the reference's benchmark mode
 (/root/reference/scripts/run_sdxl.py:124-153): untimed warmup (includes
-compilation), timed runs, trimmed mean, VAE decode excluded
+compilation), timed runs, median reported, VAE decode excluded
 (--output_type latent equivalent).  The full real-architecture SDXL UNet runs
 with random bf16 weights — latency is weight-value-independent.
 

@@ -85,6 +85,8 @@ def decode(params, cfg: VAEConfig, latents, *, tile: int = 0):
         return _decode_tiled(params, cfg, latents, tile)
     p = params["decoder"]
     groups = cfg.norm_num_groups
+    # scheduler latents are fp32; match the (possibly bf16) VAE params
+    latents = latents.astype(params["post_quant_conv"]["kernel"].dtype)
     x = conv2d(params["post_quant_conv"], latents)
     x = conv2d(p["conv_in"], x)
     x = _mid_block(p["mid_block"], x, groups)
@@ -129,6 +131,7 @@ def encode(params, cfg: VAEConfig, images, *, rng=None):
     (multiply by scaling_factor for the diffusion space)."""
     p = params["encoder"]
     groups = cfg.norm_num_groups
+    images = images.astype(p["conv_in"]["kernel"].dtype)
     x = conv2d(p["conv_in"], images)
     for down in p["down_blocks"]:
         for rp in down["resnets"]:

@@ -83,11 +83,13 @@ def ring_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
     else:
         rotating = ctx.stale(name)
 
-    # next step's stale state = this step's own fresh chunk (no collective)
+    # Next step's stale state = this step's own fresh chunk (no collective).
+    # Under no_sync steady state nothing is emitted, so the runner carries the
+    # whole state pytree forward unchanged — same as the gather layout (an
+    # attn-only emit here would change the scan carry structure and fail to
+    # trace).
     if ctx.refresh:
         ctx.emit(name, kv_local)
-    elif ctx.phase == "stale":
-        ctx.emit(name, rotating)  # no_sync: keep the old chunk forever
 
     # own (always fresh) contribution first
     s, vh = _chunk_scores(q, kv_local, heads)

@@ -63,10 +63,15 @@ class SimpleTokenizer:
         self.bos = bos
 
     def __call__(self, texts: List[str], max_length: int = 77):
+        import zlib
+
         ids = np.full((len(texts), max_length), self.eos, np.int64)
         for i, t in enumerate(texts):
+            # crc32, not hash(): process-independent, so multi-host pods and
+            # repeated runs tokenize identically
             toks = [self.bos] + [
-                (hash(w) % (self.vocab_size - 2)) for w in t.lower().split()
+                zlib.crc32(w.encode()) % (self.vocab_size - 2)
+                for w in t.lower().split()
             ][: max_length - 2]
             toks.append(self.eos)
             ids[i, : len(toks)] = toks
@@ -119,6 +124,12 @@ class _DistriPipelineBase:
         self._decode = jax.jit(
             lambda p, l: vae_mod.decode(p, self.vae_config, l)
         )
+        # jit one encoder forward per text-encoder config (re-encoding the
+        # prompt every call would otherwise dispatch hundreds of eager ops)
+        self._clip_jitted = [
+            jax.jit(lambda prm, ids, _cfg=ccfg: clip_mod.clip_text_forward(prm, _cfg, ids))
+            for ccfg, _ in self.text_encoders
+        ]
 
     # -- reference API ---------------------------------------------------
     def set_progress_bar_config(self, **kwargs):  # parity no-op (rank gating)
@@ -195,8 +206,8 @@ class _DistriPipelineBase:
 
     # -- helpers ----------------------------------------------------------
     def _clip(self, which: int, ids):
-        ccfg, cparams = self.text_encoders[which]
-        return clip_mod.clip_text_forward(cparams, ccfg, ids)
+        _, cparams = self.text_encoders[which]
+        return self._clip_jitted[which](cparams, np.asarray(ids))
 
     def _encode(self, prompts, negs):
         raise NotImplementedError

@@ -76,7 +76,7 @@ def config_from_args(args) -> DistriConfig:
     )
 
 
-def _random_sdxl_pipeline(distri_config: DistriConfig) -> DistriSDXLPipeline:
+def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler) -> DistriSDXLPipeline:
     ucfg = unet_mod.sdxl_config()
     vcfg = vae_mod.sdxl_vae_config()
     tc1 = clip_mod.clip_vit_l_config()
@@ -89,10 +89,11 @@ def _random_sdxl_pipeline(distri_config: DistriConfig) -> DistriSDXLPipeline:
         [tc1, tc2],
         [clip_mod.init_clip_params(jax.random.PRNGKey(2), tc1, dt),
          clip_mod.init_clip_params(jax.random.PRNGKey(3), tc2, dt)],
+        scheduler=scheduler,
     )
 
 
-def _random_sd_pipeline(distri_config: DistriConfig) -> DistriSDPipeline:
+def _random_sd_pipeline(distri_config: DistriConfig, scheduler) -> DistriSDPipeline:
     ucfg = unet_mod.sd15_config()
     vcfg = vae_mod.sd_vae_config()
     tc = clip_mod.clip_vit_l_config()
@@ -102,6 +103,7 @@ def _random_sd_pipeline(distri_config: DistriConfig) -> DistriSDPipeline:
         unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dt),
         vcfg, vae_mod.init_vae_params(jax.random.PRNGKey(1), vcfg, dt),
         [tc], [clip_mod.init_clip_params(jax.random.PRNGKey(2), tc, dt)],
+        scheduler=scheduler,
     )
 
 
@@ -112,9 +114,7 @@ def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Dis
             distri_config, args.model_path, scheduler=scheduler
         )
     if args.random_weights:
-        pipe = _random_sdxl_pipeline(distri_config)
-        pipe.scheduler.__init__()  # keep defaults
-        return pipe
+        return _random_sdxl_pipeline(distri_config, scheduler)
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 
@@ -125,7 +125,7 @@ def load_sd_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Distr
             distri_config, args.model_path, scheduler=scheduler
         )
     if args.random_weights:
-        return _random_sd_pipeline(distri_config)
+        return _random_sd_pipeline(distri_config, scheduler)
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 

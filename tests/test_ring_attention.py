@@ -117,6 +117,22 @@ def test_ring_end_to_end_runner(devices8):
     np.testing.assert_allclose(out["ring"], out["gather"], atol=1e-3)
 
 
+def test_ring_no_sync_mode_traces(devices8):
+    """Regression: ring + no_sync must keep the scan carry structure stable
+    (no attn-only state emission in the steady state)."""
+    ucfg = tiny_config()
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    cfg = DistriConfig(
+        devices=devices8, height=128, width=128, warmup_steps=1,
+        mode="no_sync", attn_impl="ring",
+    )
+    runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    lat = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 4))
+    enc = jax.random.normal(jax.random.PRNGKey(2), (2, 1, 7, ucfg.cross_attention_dim))
+    out = runner.generate(lat, enc, num_inference_steps=4)
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_attn_impl_validation(devices8):
     with pytest.raises(ValueError, match="attn_impl"):
         DistriConfig(devices=devices8, attn_impl="bogus")
