@@ -30,6 +30,14 @@ _NORM_HINTS = ("norm", "ln_", "layer_norm", "layernorm")
 
 
 def load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    # Preferred path: the native mmap reader (zero copy, threaded page-in,
+    # distrifuser_tpu/native/fast_safetensors.cc); falls back to the Python
+    # safetensors package.
+    from ..native import load_safetensors_fast
+
+    fast = load_safetensors_fast(path)
+    if fast is not None:
+        return fast
     from safetensors.numpy import load_file
 
     return load_file(path)

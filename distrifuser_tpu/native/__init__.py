@@ -1,0 +1,103 @@
+"""Native (C++) runtime pieces, loaded via ctypes.
+
+`load_safetensors_fast(path)` is the preferred checkpoint-shard reader used
+by models/weights.py: it mmaps the file through fast_safetensors.cc (zero
+copy; threaded page-in for cold multi-GB SDXL shards) and serves numpy views
+sliced per the safetensors JSON header.  Any failure — no compiler, odd
+platform — falls back to the pure-Python safetensors package, so the native
+path is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+import subprocess
+from typing import Dict, Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fast_safetensors.cc")
+_SO = os.path.join(os.path.dirname(__file__), "_fast_safetensors.so")
+
+_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "BF16": None,  # no numpy bf16: served as uint16 and bitcast by jax
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+_lib: Optional[ctypes.CDLL] = None
+_mappings = []  # keep (addr, size) alive for the process lifetime
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.st_open.restype = ctypes.c_void_p
+        lib.st_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.st_prefetch.restype = ctypes.c_uint64
+        lib.st_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+        lib.st_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _build() is not None
+
+
+def load_safetensors_fast(
+    path: str, prefetch_threads: int = 8
+) -> Optional[Dict[str, np.ndarray]]:
+    """Zero-copy load; returns None if the native path is unavailable."""
+    lib = _build()
+    if lib is None:
+        return None
+    size = ctypes.c_uint64()
+    addr = lib.st_open(path.encode(), ctypes.byref(size))
+    if not addr:
+        return None
+    _mappings.append((addr, size.value))
+    if prefetch_threads > 0:
+        lib.st_prefetch(addr, size.value, prefetch_threads)
+
+    buf = (ctypes.c_ubyte * size.value).from_address(addr)
+    raw = np.frombuffer(buf, dtype=np.uint8)
+    (header_len,) = struct.unpack("<Q", raw[:8].tobytes())
+    header = json.loads(raw[8 : 8 + header_len].tobytes())
+    data = raw[8 + header_len :]
+
+    out: Dict[str, np.ndarray] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dt = meta["dtype"]
+        begin, end = meta["data_offsets"]
+        flat = data[begin:end]
+        if dt == "BF16":
+            # serve raw uint16 code points; models/weights.py bitcasts via
+            # jax (ml_dtypes) when casting to the target dtype
+            arr = flat.view(np.uint16).reshape(meta["shape"])
+            try:
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            except ImportError:
+                pass
+        else:
+            arr = flat.view(_DTYPES[dt]).reshape(meta["shape"])
+        out[name] = arr
+    return out

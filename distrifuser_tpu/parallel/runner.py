@@ -311,6 +311,81 @@ class DenoiseRunner:
         return jax.jit(loop)
 
     # ------------------------------------------------------------------
+    # per-step (uncompiled-loop) mode: the reference's --no_cuda_graph
+    # ------------------------------------------------------------------
+
+    def _build_stepwise(self, phase, with_state: bool):
+        """One jitted denoising step driven from Python.
+
+        The patch state crosses the shard_map boundary here, so its leaves are
+        laid out along ("cfg","sp") on axis 0: stale activations vary across
+        CFG branches and (for the ring layout) across patch peers.
+        """
+        cfg = self.cfg
+        # Patch-parallel state varies across CFG branches and (ring layout)
+        # across sp peers -> lay leaves out along ("cfg","sp") on axis 0.
+        # naive_patch's step counter / tensor's empty state are replicated.
+        state_spec = (
+            P((CFG_AXIS, SP_AXIS))
+            if cfg.parallelism == "patch" and with_state
+            else P()
+        )
+
+        def device_step(params, i, x, pstate, sstate, enc, added, gs):
+            my_enc, my_added, _ = self._branch_inputs(enc, added)
+            text_kv = (
+                {} if cfg.parallelism == "tensor" else precompute_text_kv(params, my_enc)
+            )
+            step = self._make_step(phase)
+            return step(params, i, x, pstate, sstate, my_enc, my_added, text_kv, gs)
+
+        def stepper(params, i, x, pstate, sstate, enc, added, gs):
+            return shard_map(
+                device_step,
+                mesh=cfg.mesh,
+                in_specs=(self.param_specs, P(), P(), state_spec, P(), P(), P(), P()),
+                out_specs=(
+                    P(),
+                    P((CFG_AXIS, SP_AXIS)) if cfg.parallelism == "patch" else state_spec,
+                    P(),
+                ),
+                check_vma=False,
+            )(params, i, x, pstate, sstate, enc, added, gs)
+
+        return jax.jit(stepper)
+
+    def _generate_stepwise(self, latents, enc, added, gs, num_steps):
+        """Python loop over per-step compiled calls (reference no-CUDA-graph
+        path, distri_sdxl_unet_pp.py:117-193): same numerics as the fused
+        loop, per-step latency visible from the host."""
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        x = jnp.asarray(latents, jnp.float32)
+        sstate = self.scheduler.init_state(x.shape)
+        pstate: Any = (
+            {"step": jnp.asarray(0)}
+            if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate"
+            else ({} if cfg.parallelism != "patch" else None)
+        )
+        one_phase = cfg.parallelism != "patch" or cfg.mode == "full_sync"
+        n_sync = num_steps if one_phase else min(cfg.warmup_steps + 1, num_steps)
+
+        key = ("stepwise", num_steps)
+        if key not in self._compiled:
+            self._compiled[key] = {}
+        fns = self._compiled[key]
+        for i in range(num_steps):
+            phase = PHASE_SYNC if i < n_sync else PHASE_STALE
+            with_state = pstate is not None
+            fkey = (phase, with_state)
+            if fkey not in fns:
+                fns[fkey] = self._build_stepwise(phase, with_state)
+            x, pstate, sstate = fns[fkey](
+                self.params, jnp.asarray(i), x, pstate, sstate, enc, added, gs
+            )
+        return x
+
+    # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
 
@@ -411,10 +486,18 @@ class DenoiseRunner:
         L, C] with branch 0 = unconditional (reference rank layout,
         utils.py:98-104).  Returns the denoised latent [B, H/8, W/8, C].
         """
+        added = added_cond if added_cond is not None else None
+        if not self.cfg.use_compiled_step:
+            return self._generate_stepwise(
+                jnp.asarray(latents),
+                jnp.asarray(prompt_embeds),
+                added,
+                jnp.asarray(guidance_scale, jnp.float32),
+                num_inference_steps,
+            )
         if num_inference_steps not in self._compiled:
             self._compiled[num_inference_steps] = self._build(num_inference_steps)
         fn = self._compiled[num_inference_steps]
-        added = added_cond if added_cond is not None else None
         return fn(
             self.params,
             jnp.asarray(latents),

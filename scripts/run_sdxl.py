@@ -28,6 +28,9 @@ def get_args() -> argparse.Namespace:
     parser.add_argument("--warmup_times", type=int, default=5)
     parser.add_argument("--test_times", type=int, default=20)
     parser.add_argument("--ignore_ratio", type=float, default=0.2)
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="capture a jax.profiler trace of one generation "
+                        "into this directory (tensorboard format)")
     return parser.parse_args()
 
 
@@ -45,6 +48,13 @@ def main():
             seed=seed,
             output_type=args.output_type,
         )
+
+    if args.profile_dir:
+        run(args.seed)  # compile outside the trace
+        with jax.profiler.trace(args.profile_dir):
+            run(args.seed)
+        if is_main_process():
+            print(f"trace written to {args.profile_dir}")
 
     if args.mode == "generation":
         output = run(args.seed)

@@ -1,0 +1,48 @@
+"""Native mmap safetensors reader vs the Python safetensors package."""
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.native import available, load_safetensors_fast
+
+
+@pytest.mark.skipif(not available(), reason="no native toolchain")
+def test_fast_loader_matches_reference(tmp_path):
+    from safetensors.numpy import load_file, save_file
+
+    rng = np.random.RandomState(0)
+    tensors = {
+        "a.weight": rng.randn(64, 32).astype(np.float32),
+        "b.bias": rng.randn(7).astype(np.float16),
+        "c.table": rng.randint(-5, 5, size=(3, 4, 5)).astype(np.int32),
+    }
+    path = str(tmp_path / "t.safetensors")
+    save_file(tensors, path)
+
+    fast = load_safetensors_fast(path)
+    ref = load_file(path)
+    assert set(fast) == set(ref)
+    for k in ref:
+        assert fast[k].dtype == ref[k].dtype
+        np.testing.assert_array_equal(fast[k], ref[k])
+
+
+@pytest.mark.skipif(not available(), reason="no native toolchain")
+def test_fast_loader_bf16(tmp_path):
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    from safetensors.numpy import save_file
+
+    x = (np.random.RandomState(1).randn(16, 8).astype(np.float32)).astype(
+        ml_dtypes.bfloat16
+    )
+    path = str(tmp_path / "bf16.safetensors")
+    save_file({"w": x}, path)
+    fast = load_safetensors_fast(path)
+    assert fast["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        fast["w"].astype(np.float32), x.astype(np.float32)
+    )
+
+
+def test_missing_file_returns_none():
+    assert load_safetensors_fast("/nonexistent/file.safetensors") in (None,)
