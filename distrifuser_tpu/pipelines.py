@@ -84,6 +84,27 @@ def _hf_tokenizer(path: str):
     return CLIPTokenizer.from_pretrained(path)
 
 
+def _scheduler_from_snapshot(root: str, name: str | BaseScheduler) -> BaseScheduler:
+    """Build the scheduler, honoring the snapshot's scheduler_config.json
+    (prediction_type / betas / train steps) — this is how SD 2.x's
+    v-prediction flows in, the way diffusers from_pretrained wires it for the
+    reference."""
+    if isinstance(name, BaseScheduler):
+        return name
+    kwargs = {}
+    cfg_path = os.path.join(root, "scheduler", "scheduler_config.json")
+    if os.path.exists(cfg_path):
+        import json
+
+        with open(cfg_path) as f:
+            sc = json.load(f)
+        for k in ("num_train_timesteps", "beta_start", "beta_end",
+                  "beta_schedule", "steps_offset", "prediction_type"):
+            if k in sc:
+                kwargs[k] = sc[k]
+    return get_scheduler(name, **kwargs)
+
+
 def _tokenize(tok, texts: List[str]) -> np.ndarray:
     if isinstance(tok, SimpleTokenizer):
         return tok(texts)
@@ -251,7 +272,7 @@ class DistriSDXLPipeline(_DistriPipelineBase):
             tok2 = _hf_tokenizer(os.path.join(root, "tokenizer_2"))
         except Exception:
             tok1 = tok2 = SimpleTokenizer()
-        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,
             unet_mod.sdxl_config(),
@@ -333,7 +354,7 @@ class DistriSDPipeline(_DistriPipelineBase):
             tok = _hf_tokenizer(os.path.join(root, "tokenizer"))
         except Exception:
             tok = SimpleTokenizer()
-        sched = scheduler if isinstance(scheduler, BaseScheduler) else get_scheduler(scheduler)
+        sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,
             unet_mod.sd15_config(),

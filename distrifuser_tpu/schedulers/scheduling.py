@@ -58,12 +58,27 @@ class BaseScheduler:
     prediction_type: str = "epsilon"
 
     def __post_init__(self):
-        if self.prediction_type != "epsilon":
-            raise NotImplementedError("only epsilon prediction is supported")
+        if self.prediction_type not in ("epsilon", "v_prediction"):
+            raise NotImplementedError(
+                "prediction_type must be 'epsilon' or 'v_prediction'"
+            )
         self._alphas_cumprod = _make_alphas_cumprod(
             self.num_train_timesteps, self.beta_start, self.beta_end, self.beta_schedule
         )
         self.num_inference_steps = None
+
+    def _to_epsilon(self, sample, model_output, alpha_cumprod_t):
+        """Convert the model output to an epsilon prediction.
+
+        SD 2.x checkpoints are v-prediction (v = alpha*eps - sigma*x0), which
+        the reference inherits from diffusers' scheduler configs; normalizing
+        to epsilon keeps one update rule per sampler.
+        """
+        if self.prediction_type == "epsilon":
+            return model_output
+        a = jnp.sqrt(alpha_cumprod_t)
+        s = jnp.sqrt(1.0 - alpha_cumprod_t)
+        return a * model_output + s * sample.astype(jnp.float32)
 
     # ---- shared API -------------------------------------------------------
     @property
@@ -106,7 +121,7 @@ class DDIMScheduler(BaseScheduler):
         a_t = self._alpha_t[step_index]
         a_prev = self._alpha_prev[step_index]
         x = sample.astype(jnp.float32)
-        eps = model_output.astype(jnp.float32)
+        eps = self._to_epsilon(sample, model_output.astype(jnp.float32), a_t)
         x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
         x_prev = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
         return x_prev.astype(sample.dtype), state
@@ -137,11 +152,13 @@ class EulerDiscreteScheduler(BaseScheduler):
     def step(self, sample, model_output, step_index, state):
         # Euler works in the sigma-space parameterization x = x0 + sigma * n;
         # `sample` here is that scaled latent (init noise multiplied by
-        # init_noise_sigma), `model_output` is epsilon at the descaled input.
+        # init_noise_sigma), `model_output` is epsilon (or v) at the descaled
+        # input.
         sigma = self._sigmas[step_index]
         sigma_next = self._sigmas[step_index + 1]
         x = sample.astype(jnp.float32)
-        eps = model_output.astype(jnp.float32)
+        ac_t = 1.0 / (sigma**2 + 1.0)  # alpha_cumprod of this sigma
+        eps = self._to_epsilon(x * jnp.sqrt(ac_t), model_output.astype(jnp.float32), ac_t)
         # x0-from-epsilon in this parameterization: x0 = x - sigma * eps
         x_next = x + (sigma_next - sigma) * eps
         return x_next.astype(sample.dtype), state
@@ -187,7 +204,7 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         lam_n = self._lambda[step_index + 1]
 
         x = sample.astype(jnp.float32)
-        eps = model_output.astype(jnp.float32)
+        eps = self._to_epsilon(sample, model_output.astype(jnp.float32), a_t**2)
         x0 = (x - s_t * eps) / a_t
 
         h = lam_n - lam_t

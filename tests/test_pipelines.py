@@ -116,6 +116,16 @@ def test_guidance_forced_off_without_cfg(devices8):
     assert np.isfinite(out.images[0]).all()
 
 
+def test_batch_of_prompts(devices8):
+    pipe, dcfg = build_sd_pipeline(devices8, 4, batch_size=2)
+    out = pipe(["a cat", "a dog"], num_inference_steps=2, output_type="latent")
+    lat = out.images[0]
+    assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
+    assert np.isfinite(lat).all()
+    with pytest.raises(AssertionError, match="batch_size"):
+        pipe("just one", num_inference_steps=2)
+
+
 def test_simple_tokenizer_shapes():
     tok = SimpleTokenizer()
     ids = tok(["hello world", ""])

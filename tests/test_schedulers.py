@@ -88,6 +88,36 @@ def test_dpmsolver_exact_trajectory():
     np.testing.assert_allclose(np.asarray(x), np.asarray(x0), atol=1e-3)
 
 
+def test_v_prediction_exact_trajectory():
+    """SD 2.x parity: with a true-v oracle (v = a*n - s*x0) every sampler must
+    follow the same exact trajectory as the epsilon case."""
+    import numpy as np
+
+    key = jax.random.PRNGKey(5)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+
+    s = DDIMScheduler(prediction_type="v_prediction").set_timesteps(15)
+    a, ap = np.asarray(s._alpha_t), np.asarray(s._alpha_prev)
+    state = s.init_state(x0.shape)
+    for i in range(15):
+        x_t = np.sqrt(a[i]) * x0 + np.sqrt(1 - a[i]) * n
+        v = np.sqrt(a[i]) * np.asarray(n) - np.sqrt(1 - a[i]) * np.asarray(x0)
+        x_prev, state = s.step(jnp.asarray(x_t), jnp.asarray(v), i, state)
+        want = np.sqrt(ap[i]) * x0 + np.sqrt(1 - ap[i]) * n
+        np.testing.assert_allclose(np.asarray(x_prev), np.asarray(want), atol=1e-4)
+
+    e = EulerDiscreteScheduler(prediction_type="v_prediction").set_timesteps(15)
+    sig = np.asarray(e._sigmas)
+    for i in range(15):
+        x_t = x0 + sig[i] * n  # sigma space
+        ac = 1.0 / (sig[i] ** 2 + 1.0)
+        v = np.sqrt(ac) * np.asarray(n) - np.sqrt(1 - ac) * np.asarray(x0)
+        x_next, _ = e.step(jnp.asarray(x_t), jnp.asarray(v), i, {})
+        want = x0 + sig[i + 1] * n
+        np.testing.assert_allclose(np.asarray(x_next), np.asarray(want), atol=1e-4)
+
+
 def test_steps_inside_scan():
     """Schedulers must compose with lax.scan (static shapes, traced indices)."""
     for name in ["ddim", "euler", "dpm-solver"]:
