@@ -54,12 +54,28 @@ def _flash_eligible(q, k, heads: int) -> bool:
     return aligned and lk >= _FLASH_MIN_LEN
 
 
+# Above this many fp32 logit elements (B*H*Lq*Lk), the unfused softmax path
+# chunks queries so the full score matrix never materializes — the safety net
+# when the Pallas flash kernel is unavailable (CPU, odd shapes, env-disabled).
+# 2^28 elements = 1 GiB of fp32 logits.
+_CHUNK_LOGITS_ELEMS = 1 << 28
+
+
+def _sdpa_xla(q, k, v, scale):
+    """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D], fp32 softmax."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+
+
 def sdpa(q, k, v, *, heads: int):
     """Scaled dot-product attention over [B, L, C] tensors with H heads.
 
     The analog of F.scaled_dot_product_attention (attn.py:87,153): the Pallas
-    flash kernel (ops/flash_attention.py) for long sequences on TPU, XLA
-    einsum+softmax otherwise.
+    flash kernel (ops/flash_attention.py) for long sequences on TPU; XLA
+    einsum+softmax otherwise, with query chunking once the score matrix would
+    exceed ~1 GiB (e.g. the VAE's 65k-token single-head mid attention at
+    2048x2048, where materializing L^2 logits cannot fit).
     """
     if _flash_eligible(q, k, heads):
         from .flash_attention import flash_sdpa
@@ -68,12 +84,26 @@ def sdpa(q, k, v, *, heads: int):
     b, lq, c = q.shape
     lk = k.shape[1]
     d = c // heads
+    scale = 1.0 / d**0.5
     q = q.reshape(b, lq, heads, d)
     k = k.reshape(b, lk, heads, d)
     v = v.reshape(b, lk, heads, d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / d**0.5)
-    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    if b * heads * lq * lk > _CHUNK_LOGITS_ELEMS and lq > 1:
+        n_chunks = 1
+        while (
+            b * heads * (lq // n_chunks) * lk > _CHUNK_LOGITS_ELEMS
+            and n_chunks < lq
+        ):
+            n_chunks *= 2
+        while lq % n_chunks != 0:  # keep chunks uniform for lax.map
+            n_chunks //= 2
+        qc = q.reshape(b, n_chunks, lq // n_chunks, heads, d)
+        out = jax.lax.map(
+            lambda qi: _sdpa_xla(qi, k, v, scale), jnp.moveaxis(qc, 1, 0)
+        )  # [n_chunks, B, lq/n, H, D]
+        out = jnp.moveaxis(out, 0, 1).reshape(b, lq, heads, d)
+    else:
+        out = _sdpa_xla(q, k, v, scale)
     return out.reshape(b, lq, c)
 
 

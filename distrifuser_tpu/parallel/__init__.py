@@ -1,2 +1,3 @@
 from .collectives import all_gather, all_gather_seq, gather_cols, gather_rows, halo_exchange, psum_mean
 from .context import PHASE_STALE, PHASE_SYNC, PatchContext
+from .runner import DenoiseRunner, make_runner

@@ -158,7 +158,10 @@ class _DistriPipelineBase:
 
     def prepare(self, num_inference_steps: int = 50, **kwargs) -> None:
         """AOT-compile the denoise loop (the reference's record/capture phase,
-        pipelines.py:60-165)."""
+        pipelines.py:60-165).  In per-step mode (use_cuda_graph=False) steps
+        compile lazily on first use, like the reference's no-graph path."""
+        if not self.distri_config.use_compiled_step:
+            return
         if num_inference_steps not in self.runner._compiled:
             self.runner._compiled[num_inference_steps] = self.runner._build(
                 num_inference_steps

@@ -62,3 +62,22 @@ def test_routing_gates():
         assert not _flash_eligible(jnp.zeros((1, 200, 32)), k, heads=2)
     finally:
         del os.environ["DISTRIFUSER_TPU_FLASH"]
+
+
+def test_chunked_sdpa_matches_direct(monkeypatch):
+    """Query chunking must be numerically identical to the direct path."""
+    import importlib
+
+    attn_mod = importlib.import_module("distrifuser_tpu.ops.attention")
+
+    b, l, heads, d = 1, 512, 2, 16
+    c = heads * d
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (b, l, c))
+    k = jax.random.normal(keys[1], (b, l, c))
+    v = jax.random.normal(keys[2], (b, l, c))
+    direct = sdpa(q, k, v, heads=heads)
+    # force chunking by shrinking the threshold
+    monkeypatch.setattr(attn_mod, "_CHUNK_LOGITS_ELEMS", 1 << 16)
+    chunked = sdpa(q, k, v, heads=heads)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(direct), atol=1e-5)
