@@ -519,7 +519,11 @@ def init_unet_params(key, cfg: UNetConfig, dtype=jnp.float32):
     out_ch = ch0
     for i, btype in enumerate(cfg.down_block_types):
         in_ch, out_ch = out_ch, cfg.block_out_channels[i]
-        block: Dict[str, Any] = {"resnets": [], "attentions": []}
+        # blocks without cross-attention carry no "attentions" key, matching
+        # the state_dict structure the converter produces
+        block: Dict[str, Any] = {"resnets": []}
+        if btype == "CrossAttnDownBlock2D":
+            block["attentions"] = []
         for j in range(cfg.layers_per_block):
             block["resnets"].append(
                 _init_resnet(nxt(), in_ch if j == 0 else out_ch, out_ch, temb_dim, cfg.norm_num_groups)
@@ -559,7 +563,9 @@ def init_unet_params(key, cfg: UNetConfig, dtype=jnp.float32):
     for i, btype in enumerate(cfg.up_block_types):
         out_ch = rev[i]
         in_ch = rev[min(i + 1, len(rev) - 1)]
-        block = {"resnets": [], "attentions": []}
+        block = {"resnets": []}
+        if btype == "CrossAttnUpBlock2D":
+            block["attentions"] = []
         for j in range(cfg.layers_per_block + 1):
             skip_ch = in_ch if j == cfg.layers_per_block else out_ch
             res_in = prev_out if j == 0 else out_ch

@@ -1,3 +1,12 @@
 from .collectives import all_gather, all_gather_seq, gather_cols, gather_rows, halo_exchange, psum_mean
 from .context import PHASE_STALE, PHASE_SYNC, PatchContext
-from .runner import DenoiseRunner, make_runner
+
+
+def __getattr__(name):
+    # Lazy: runner imports models.unet, which imports parallel.context -
+    # an eager re-export here would close an import cycle.
+    if name in ("DenoiseRunner", "make_runner"):
+        from . import runner
+
+        return getattr(runner, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

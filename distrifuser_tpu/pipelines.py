@@ -142,8 +142,11 @@ class _DistriPipelineBase:
         self.tokenizers = tokenizers
         self.text_encoders = text_encoders
         self.runner = make_runner(distri_config, unet_config, unet_params, scheduler)
+        # Above 2048px the whole-latent decode's activations dominate HBM on
+        # one chip; switch to the row-tiled decoder (models/vae.py).
+        tile = 64 if distri_config.latent_height > 128 else 0
         self._decode = jax.jit(
-            lambda p, l: vae_mod.decode(p, self.vae_config, l)
+            lambda p, l: vae_mod.decode(p, self.vae_config, l, tile=tile)
         )
         # jit one encoder forward per text-encoder config (re-encoding the
         # prompt every call would otherwise dispatch hundreds of eager ops)

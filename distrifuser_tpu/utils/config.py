@@ -132,7 +132,16 @@ class DistriConfig:
             raise ValueError("height and width must be multiples of 8")
 
         if self.devices is None:
-            self.devices = tuple(jax.devices())
+            try:
+                self.devices = tuple(jax.devices())
+            except RuntimeError as e:
+                # Mirror the reference's explicit failure surface
+                # (utils.py:44-47) with TPU guidance instead of hanging.
+                raise RuntimeError(
+                    "no usable JAX backend (TPU runtime failed to initialize "
+                    "and no CPU fallback is configured); set JAX_PLATFORMS=cpu "
+                    f"for a CPU run. Original error: {e}"
+                ) from e
         else:
             self.devices = tuple(self.devices)
         world_size = len(self.devices)

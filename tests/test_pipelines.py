@@ -126,6 +126,19 @@ def test_batch_of_prompts(devices8):
         pipe("just one", num_inference_steps=2)
 
 
+def test_sdxl_batch_prompts(devices8):
+    pipe, dcfg = build_sdxl_pipeline(devices8, 4, batch_size=2)
+    out = pipe(
+        ["a red fox", "a blue bird"],
+        negative_prompt=["blurry", "low quality"],
+        num_inference_steps=2,
+        output_type="latent",
+    )
+    lat = out.images[0]
+    assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
+    assert np.isfinite(lat).all()
+
+
 def test_simple_tokenizer_shapes():
     tok = SimpleTokenizer()
     ids = tok(["hello world", ""])
