@@ -30,6 +30,9 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         help="local HF snapshot dir (unet/, vae/, text_encoder*/)")
     parser.add_argument("--random_weights", action="store_true",
                         help="run with architecture-faithful random weights")
+    parser.add_argument("--tiny_model", action="store_true",
+                        help="with --random_weights: use the tiny test "
+                        "architecture (CPU-scale smoke runs)")
     parser.add_argument("--prompt", type=str,
                         default="Astronaut in a jungle, cold color palette, "
                         "muted colors, detailed, 8k")
@@ -76,11 +79,21 @@ def config_from_args(args) -> DistriConfig:
     )
 
 
-def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler) -> DistriSDXLPipeline:
-    ucfg = unet_mod.sdxl_config()
-    vcfg = vae_mod.sdxl_vae_config()
-    tc1 = clip_mod.clip_vit_l_config()
-    tc2 = clip_mod.open_clip_bigg_config()
+def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler,
+                          tiny: bool = False) -> DistriSDXLPipeline:
+    if tiny:
+        ucfg = unet_mod.tiny_config(sdxl=True)
+        vcfg = vae_mod.tiny_vae_config()
+        tc1 = clip_mod.tiny_clip_config(hidden=16)
+        tc2 = clip_mod.CLIPTextConfig(
+            vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=32, projection_dim=32,
+        )
+    else:
+        ucfg = unet_mod.sdxl_config()
+        vcfg = vae_mod.sdxl_vae_config()
+        tc1 = clip_mod.clip_vit_l_config()
+        tc2 = clip_mod.open_clip_bigg_config()
     dt = distri_config.dtype
     return DistriSDXLPipeline.from_params(
         distri_config, ucfg,
@@ -93,10 +106,16 @@ def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler) -> DistriSDXLP
     )
 
 
-def _random_sd_pipeline(distri_config: DistriConfig, scheduler) -> DistriSDPipeline:
-    ucfg = unet_mod.sd15_config()
-    vcfg = vae_mod.sd_vae_config()
-    tc = clip_mod.clip_vit_l_config()
+def _random_sd_pipeline(distri_config: DistriConfig, scheduler,
+                        tiny: bool = False) -> DistriSDPipeline:
+    if tiny:
+        ucfg = unet_mod.tiny_config()
+        vcfg = vae_mod.tiny_vae_config()
+        tc = clip_mod.tiny_clip_config(hidden=32)
+    else:
+        ucfg = unet_mod.sd15_config()
+        vcfg = vae_mod.sd_vae_config()
+        tc = clip_mod.clip_vit_l_config()
     dt = distri_config.dtype
     return DistriSDPipeline.from_params(
         distri_config, ucfg,
@@ -114,7 +133,7 @@ def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Dis
             distri_config, args.model_path, scheduler=scheduler
         )
     if args.random_weights:
-        return _random_sdxl_pipeline(distri_config, scheduler)
+        return _random_sdxl_pipeline(distri_config, scheduler, tiny=getattr(args, 'tiny_model', False))
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 
@@ -125,7 +144,7 @@ def load_sd_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Distr
             distri_config, args.model_path, scheduler=scheduler
         )
     if args.random_weights:
-        return _random_sd_pipeline(distri_config, scheduler)
+        return _random_sd_pipeline(distri_config, scheduler, tiny=getattr(args, 'tiny_model', False))
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 
