@@ -4,8 +4,10 @@
 by models/weights.py: it mmaps the file through fast_safetensors.cc (zero
 copy; threaded page-in for cold multi-GB SDXL shards) and serves numpy views
 sliced per the safetensors JSON header.  Any failure — no compiler, odd
-platform — falls back to the pure-Python safetensors package, so the native
-path is an accelerator, never a requirement.
+platform, unexpected dtype, corrupt header — falls back to the pure-Python
+safetensors package, so the native path is an accelerator, never a
+requirement.  Call `release_mappings()` once the returned arrays have been
+copied (the weight converters produce fresh jax arrays) to unmap the shards.
 """
 
 from __future__ import annotations
@@ -24,13 +26,14 @@ _SO = os.path.join(os.path.dirname(__file__), "_fast_safetensors.so")
 
 _DTYPES = {
     "F64": np.float64, "F32": np.float32, "F16": np.float16,
-    "BF16": None,  # no numpy bf16: served as uint16 and bitcast by jax
+    "BF16": None,  # no numpy bf16: served via ml_dtypes (or rejected)
     "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
-    "U8": np.uint8, "BOOL": np.bool_,
+    "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+    "BOOL": np.bool_,
 }
 
 _lib: Optional[ctypes.CDLL] = None
-_mappings = []  # keep (addr, size) alive for the process lifetime
+_mappings = []  # (addr, size) for mappings whose views may still be alive
 
 
 def _build() -> Optional[ctypes.CDLL]:
@@ -59,10 +62,29 @@ def available() -> bool:
     return _build() is not None
 
 
+def release_mappings() -> int:
+    """Unmap every shard opened by the fast loader.
+
+    Only safe once no numpy views into the mappings are live — the weight
+    converters copy everything into jax arrays, so pipelines call this after
+    conversion.  Returns the number of mappings released.
+    """
+    lib = _build()
+    n = 0
+    if lib is not None:
+        while _mappings:
+            addr, size = _mappings.pop()
+            lib.st_close(addr, size)
+            n += 1
+    else:
+        _mappings.clear()
+    return n
+
+
 def load_safetensors_fast(
     path: str, prefetch_threads: int = 8
 ) -> Optional[Dict[str, np.ndarray]]:
-    """Zero-copy load; returns None if the native path is unavailable."""
+    """Zero-copy load; returns None whenever the Python loader should be used."""
     lib = _build()
     if lib is None:
         return None
@@ -70,34 +92,32 @@ def load_safetensors_fast(
     addr = lib.st_open(path.encode(), ctypes.byref(size))
     if not addr:
         return None
+    try:
+        buf = (ctypes.c_ubyte * size.value).from_address(addr)
+        raw = np.frombuffer(buf, dtype=np.uint8)
+        (header_len,) = struct.unpack("<Q", raw[:8].tobytes())
+        header = json.loads(raw[8 : 8 + header_len].tobytes())
+        data = raw[8 + header_len :]
+
+        out: Dict[str, np.ndarray] = {}
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            dt = meta["dtype"]
+            begin, end = meta["data_offsets"]
+            flat = data[begin:end]
+            if dt == "BF16":
+                import ml_dtypes  # raises -> python fallback
+
+                arr = flat.view(np.uint16).reshape(meta["shape"]).view(ml_dtypes.bfloat16)
+            else:
+                arr = flat.view(_DTYPES[dt]).reshape(meta["shape"])
+            # views alias a PROT_READ mapping: a write would SIGSEGV, so make
+            # the numpy contract say so
+            arr.flags.writeable = False
+            out[name] = arr
+    except Exception:
+        lib.st_close(addr, size.value)
+        return None
     _mappings.append((addr, size.value))
-    if prefetch_threads > 0:
-        lib.st_prefetch(addr, size.value, prefetch_threads)
-
-    buf = (ctypes.c_ubyte * size.value).from_address(addr)
-    raw = np.frombuffer(buf, dtype=np.uint8)
-    (header_len,) = struct.unpack("<Q", raw[:8].tobytes())
-    header = json.loads(raw[8 : 8 + header_len].tobytes())
-    data = raw[8 + header_len :]
-
-    out: Dict[str, np.ndarray] = {}
-    for name, meta in header.items():
-        if name == "__metadata__":
-            continue
-        dt = meta["dtype"]
-        begin, end = meta["data_offsets"]
-        flat = data[begin:end]
-        if dt == "BF16":
-            # serve raw uint16 code points; models/weights.py bitcasts via
-            # jax (ml_dtypes) when casting to the target dtype
-            arr = flat.view(np.uint16).reshape(meta["shape"])
-            try:
-                import ml_dtypes
-
-                arr = arr.view(ml_dtypes.bfloat16)
-            except ImportError:
-                pass
-        else:
-            arr = flat.view(_DTYPES[dt]).reshape(meta["shape"])
-        out[name] = arr
     return out

@@ -95,13 +95,16 @@ def sdpa(q, k, v, *, heads: int):
             and n_chunks < lq
         ):
             n_chunks *= 2
-        while lq % n_chunks != 0:  # keep chunks uniform for lax.map
-            n_chunks //= 2
-        qc = q.reshape(b, n_chunks, lq // n_chunks, heads, d)
+        # pad queries to uniform chunks (odd Lq must still chunk — that is
+        # exactly where the OOM protection matters); padded rows attend to
+        # real keys, produce garbage, and are sliced off
+        lq_pad = -(-lq // n_chunks) * n_chunks
+        qp = jnp.pad(q, ((0, 0), (0, lq_pad - lq), (0, 0), (0, 0)))
+        qc = qp.reshape(b, n_chunks, lq_pad // n_chunks, heads, d)
         out = jax.lax.map(
             lambda qi: _sdpa_xla(qi, k, v, scale), jnp.moveaxis(qc, 1, 0)
-        )  # [n_chunks, B, lq/n, H, D]
-        out = jnp.moveaxis(out, 0, 1).reshape(b, lq, heads, d)
+        )  # [n_chunks, B, lq_pad/n, H, D]
+        out = jnp.moveaxis(out, 0, 1).reshape(b, lq_pad, heads, d)[:, :lq]
     else:
         out = _sdpa_xla(q, k, v, scale)
     return out.reshape(b, lq, c)

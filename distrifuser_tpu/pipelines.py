@@ -154,6 +154,10 @@ class _DistriPipelineBase:
             jax.jit(lambda prm, ids, _cfg=ccfg: clip_mod.clip_text_forward(prm, _cfg, ids))
             for ccfg, _ in self.text_encoders
         ]
+        if distri_config.verbose and distri_config.parallelism == "patch":
+            # buffer-volume report at construction, like the reference's
+            # create_buffer prints (utils.py:152-158)
+            self.runner.comm_volume_report(batch_size=distri_config.batch_size)
 
     # -- reference API ---------------------------------------------------
     def set_progress_bar_config(self, **kwargs):  # parity no-op (rank gating)
@@ -273,6 +277,9 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         te2 = convert_clip_state_dict(
             load_sharded_safetensors(os.path.join(root, "text_encoder_2")), dtype
         )
+        from .native import release_mappings
+
+        release_mappings()  # converted trees are jax copies; unmap the shards
         try:
             tok1 = _hf_tokenizer(os.path.join(root, "tokenizer"))
             tok2 = _hf_tokenizer(os.path.join(root, "tokenizer_2"))
@@ -356,6 +363,9 @@ class DistriSDPipeline(_DistriPipelineBase):
         te = convert_clip_state_dict(
             load_sharded_safetensors(os.path.join(root, "text_encoder")), dtype
         )
+        from .native import release_mappings
+
+        release_mappings()
         try:
             tok = _hf_tokenizer(os.path.join(root, "tokenizer"))
         except Exception:

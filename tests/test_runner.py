@@ -113,3 +113,15 @@ def test_no_cfg_path(devices8):
 def test_geometry_validation(devices8):
     with pytest.raises(ValueError, match="divisible"):
         make_runner(devices8, 8, height=96, width=96)  # latent 12 rows, sp=4, depth 1
+
+
+def test_comm_volume_report(devices8):
+    runner, cfg, ucfg = make_runner(devices8, 4)
+    report = runner.comm_volume_report()
+    # patch mode tracks exactly the three layer families the reference
+    # accounts for (utils.py:152-158): conv halos, attention KV, GN moments
+    assert set(report) == {"conv2d", "attn", "gn"}
+    assert report["attn"] > report["gn"]
+    # single device: no comm, empty report
+    runner1, _, _ = make_runner(devices8, 1)
+    assert runner1.comm_volume_report() == {}

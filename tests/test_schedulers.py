@@ -117,6 +117,19 @@ def test_v_prediction_exact_trajectory():
         want = x0 + sig[i + 1] * n
         np.testing.assert_allclose(np.asarray(x_next), np.asarray(want), atol=1e-4)
 
+    # DPM: pins the a_t**2 alpha-cumprod argument (self._alpha stores sqrt)
+    dpm = DPMSolverMultistepScheduler(prediction_type="v_prediction").set_timesteps(15)
+    a, sg = np.asarray(dpm._alpha), np.asarray(dpm._sigma)
+    state = dpm.init_state(x0.shape)
+    x = a[0] * np.asarray(x0) + sg[0] * np.asarray(n)
+    for i in range(15):
+        eps = (x - a[i] * np.asarray(x0)) / max(sg[i], 1e-12)
+        v = a[i] * eps - sg[i] * np.asarray(x0)
+        x, state = dpm.step(jnp.asarray(x), jnp.asarray(v), i, state)
+        x = np.asarray(x)
+        want = a[i + 1] * np.asarray(x0) + sg[i + 1] * np.asarray(n)
+        np.testing.assert_allclose(x, want, atol=1e-3)
+
 
 def test_steps_inside_scan():
     """Schedulers must compose with lax.scan (static shapes, traced indices)."""
