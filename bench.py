@@ -17,12 +17,35 @@ reference's single-GPU baseline at the same workload shape.
 
 import argparse
 import json
+import os
+import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-
 A100_SDXL_1024_50STEP_S = 6.6
+
+
+def _arm_watchdog(seconds: float) -> None:
+    """Emit a parseable failure line and exit if the TPU runtime wedges.
+
+    The axon chip lease can hang backend init indefinitely after an earlier
+    client died mid-run (observed 2026-07-28); a silent hang gives the driver
+    nothing, an explicit line documents what happened.
+    """
+
+    def fire():
+        time.sleep(seconds)
+        print(json.dumps({
+            "metric": "bench_watchdog_timeout",
+            "value": -1.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        print(f"bench watchdog fired after {seconds}s (TPU runtime hang?)",
+              file=sys.stderr, flush=True)
+        os._exit(2)
+
+    threading.Thread(target=fire, daemon=True).start()
 
 
 def main():
@@ -32,7 +55,12 @@ def main():
     parser.add_argument("--test_times", type=int, default=3)
     parser.add_argument("--preset", type=str, default=None,
                         choices=[None, "sdxl", "tiny"], nargs="?")
+    parser.add_argument("--watchdog_s", type=float, default=1500.0)
     args = parser.parse_args()
+    _arm_watchdog(args.watchdog_s)
+
+    import jax
+    import jax.numpy as jnp
 
     from distrifuser_tpu import DistriConfig
     from distrifuser_tpu.models import unet as unet_mod
