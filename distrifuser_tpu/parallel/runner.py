@@ -487,6 +487,20 @@ class DenoiseRunner:
         utils.py:98-104).  Returns the denoised latent [B, H/8, W/8, C].
         """
         added = added_cond if added_cond is not None else None
+        if jax.process_count() > 1:
+            # Multi-controller (pod) mode: host-local numpy must become
+            # global replicated arrays before entering the jitted program —
+            # the analog of every torchrun rank feeding identical inputs.
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self.cfg.mesh, P())
+            mk = lambda x: jax.make_array_from_process_local_data(  # noqa: E731
+                sharding, np.asarray(x)
+            )
+            latents = mk(latents)
+            prompt_embeds = mk(prompt_embeds)
+            if added is not None:
+                added = jax.tree.map(mk, added)
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),

@@ -1,0 +1,62 @@
+"""Worker process for the multi-host (multi-controller) test.
+
+Each process owns 4 fake CPU devices; two processes form one 8-device global
+mesh — the CPU stand-in for a 2-host TPU pod over DCN, exercising
+jax.distributed bootstrap + global-array input feeding end to end.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distrifuser_tpu import DistriConfig, init_multihost  # noqa: E402
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config  # noqa: E402
+from distrifuser_tpu.parallel.runner import DenoiseRunner  # noqa: E402
+from distrifuser_tpu.schedulers import get_scheduler  # noqa: E402
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    init_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc
+
+    ucfg = tiny_config()
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    cfg = DistriConfig(height=128, width=128, warmup_steps=1)
+    assert cfg.world_size == 4 * nproc
+    runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+
+    lat = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 4))
+    )
+    enc = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(2), (2, 1, 7, ucfg.cross_attention_dim))
+    )
+    out = runner.generate(lat, enc, num_inference_steps=3)
+    out = np.asarray(jax.device_get(out))
+    assert np.isfinite(out).all()
+    print(f"CHECKSUM {pid} {float(np.abs(out).sum()):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
