@@ -45,7 +45,7 @@ from ..models.unet import (
     unet_forward,
 )
 from ..schedulers import BaseScheduler
-from ..utils.config import CFG_AXIS, SP_AXIS, DistriConfig
+from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import gather_cols, gather_rows
 from .context import PHASE_STALE, PHASE_SYNC, PatchContext
 
@@ -299,12 +299,17 @@ class DenoiseRunner:
 
         device_loop = partial(self._device_loop, num_steps=num_steps)
 
+        # Inputs/outputs shard over the dp axis on the image-batch dim; with
+        # dp_degree == 1 this degenerates to replication.
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+
         def loop(params, latents, enc, added, gs):
             return shard_map(
                 device_loop,
                 mesh=cfg.mesh,
-                in_specs=(self.param_specs, P(), P(), P(), P()),
-                out_specs=P(),
+                in_specs=(self.param_specs, lat_spec, enc_spec, enc_spec, P()),
+                out_specs=lat_spec,
                 check_vma=False,
             )(params, latents, enc, added, gs)
 
@@ -326,7 +331,7 @@ class DenoiseRunner:
         # across sp peers -> lay leaves out along ("cfg","sp") on axis 0.
         # naive_patch's step counter / tensor's empty state are replicated.
         state_spec = (
-            P((CFG_AXIS, SP_AXIS))
+            P((DP_AXIS, CFG_AXIS, SP_AXIS))
             if cfg.parallelism == "patch" and with_state
             else P()
         )
@@ -339,14 +344,20 @@ class DenoiseRunner:
             step = self._make_step(phase)
             return step(params, i, x, pstate, sstate, my_enc, my_added, text_kv, gs)
 
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+
         def stepper(params, i, x, pstate, sstate, enc, added, gs):
             return shard_map(
                 device_step,
                 mesh=cfg.mesh,
-                in_specs=(self.param_specs, P(), P(), state_spec, P(), P(), P(), P()),
+                in_specs=(self.param_specs, P(), lat_spec, state_spec, P(),
+                          enc_spec, enc_spec, P()),
                 out_specs=(
-                    P(),
-                    P((CFG_AXIS, SP_AXIS)) if cfg.parallelism == "patch" else state_spec,
+                    lat_spec,
+                    P((DP_AXIS, CFG_AXIS, SP_AXIS))
+                    if cfg.parallelism == "patch"
+                    else state_spec,
                     P(),
                 ),
                 check_vma=False,
@@ -415,7 +426,7 @@ class DenoiseRunner:
             )
             return pstate
 
-        b = batch_size
+        b = batch_size // cfg.dp_degree  # per-image-group batch
         n_br = 2 if cfg.do_classifier_free_guidance else 1
         lat = jax.ShapeDtypeStruct(
             (b, cfg.latent_height, cfg.latent_width, self.ucfg.in_channels),

@@ -37,6 +37,7 @@ from jax.sharding import Mesh
 from .env import check_env, default_backend, is_power_of_2
 
 # Axis names used across the whole framework.
+DP_AXIS = "dp"
 CFG_AXIS = "cfg"
 SP_AXIS = "sp"
 
@@ -104,7 +105,12 @@ class DistriConfig:
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)
     dtype: Any = None  # computation/param dtype; default bf16 on tpu, f32 on cpu
-    batch_size: int = 1  # images per CFG branch
+    batch_size: int = 1  # images per CFG branch (total across dp groups)
+    # Data parallelism over images — beyond the reference, which runs
+    # multi-image sweeps as separate torchrun jobs (generate_coco.py --split,
+    # SURVEY.md §2.1 "Data parallelism: no"). dp_degree independent image
+    # groups each run cfg x sp displaced-patch generation.
+    dp_degree: int = 1
 
     # derived (filled in __post_init__)
     world_size: int = dataclasses.field(init=False, default=1)
@@ -149,16 +155,27 @@ class DistriConfig:
         assert is_power_of_2(world_size), "world size must be a power of 2"
         self.world_size = world_size
 
-        if self.do_classifier_free_guidance and self.split_batch:
-            self.n_device_per_batch = max(world_size // 2, 1)
-        else:
-            self.n_device_per_batch = world_size
+        if world_size % self.dp_degree != 0:
+            raise ValueError(
+                f"dp_degree {self.dp_degree} must divide world size {world_size}"
+            )
+        if self.batch_size % self.dp_degree != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by dp_degree "
+                f"{self.dp_degree}"
+            )
+        group = world_size // self.dp_degree  # devices per image group
 
-        cfg_dim = world_size // self.n_device_per_batch  # 2 or 1
+        if self.do_classifier_free_guidance and self.split_batch:
+            self.n_device_per_batch = max(group // 2, 1)
+        else:
+            self.n_device_per_batch = group
+
+        cfg_dim = group // self.n_device_per_batch  # 2 or 1
         dev_array = np.array(self.devices, dtype=object).reshape(
-            cfg_dim, self.n_device_per_batch
+            self.dp_degree, cfg_dim, self.n_device_per_batch
         )
-        self.mesh = Mesh(dev_array, axis_names=(CFG_AXIS, SP_AXIS))
+        self.mesh = Mesh(dev_array, axis_names=(DP_AXIS, CFG_AXIS, SP_AXIS))
 
         if self.dtype is None:
             import jax.numpy as jnp
@@ -177,22 +194,36 @@ class DistriConfig:
         return self.use_cuda_graph
 
     @property
+    def group_size(self) -> int:
+        """Devices per image group (world / dp_degree)."""
+        return self.world_size // self.dp_degree
+
+    @property
     def cfg_split(self) -> bool:
-        return self.do_classifier_free_guidance and self.split_batch and self.world_size >= 2
+        return (
+            self.do_classifier_free_guidance
+            and self.split_batch
+            and self.group_size >= 2
+        )
 
     def batch_idx(self, rank: int) -> int:
         """CFG-branch index of linear device `rank` (utils.py:98-104).
 
         The reference returns ``1 - int(rank < world//2)`` i.e. ranks
         [0, n) are branch 0 (unconditional), [n, 2n) branch 1 (conditional).
+        With dp_degree > 1 the mapping applies within each image group.
         """
         if self.cfg_split:
-            return rank // self.n_device_per_batch
+            return (rank % self.group_size) // self.n_device_per_batch
         return 0
 
     def split_idx(self, rank: int) -> int:
         """Patch index of linear device `rank` (utils.py:106-109)."""
         return rank % self.n_device_per_batch
+
+    def dp_idx(self, rank: int) -> int:
+        """Image-group index of linear device `rank` (dp extension)."""
+        return rank // self.group_size
 
     # latent-space geometry -------------------------------------------------
     @property

@@ -57,6 +57,14 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         choices=["row", "col", "alternate"])
     parser.add_argument("--output_type", type=str, default="pil",
                         choices=["latent", "pil"])
+    # extensions beyond the reference surface
+    parser.add_argument("--batch_size", type=int, default=1,
+                        help="images per call (prompts list length)")
+    parser.add_argument("--dp_degree", type=int, default=1,
+                        help="data-parallel image groups (extra mesh axis)")
+    parser.add_argument("--attn_impl", type=str, default="gather",
+                        choices=["gather", "ring"],
+                        help="patch attention layout (ring: O(L/n) state)")
 
 
 def config_from_args(args) -> DistriConfig:
@@ -76,6 +84,9 @@ def config_from_args(args) -> DistriConfig:
         use_cuda_graph=not args.no_cuda_graph,
         parallelism=args.parallelism,
         split_scheme=args.split_scheme,
+        batch_size=getattr(args, "batch_size", 1),
+        dp_degree=getattr(args, "dp_degree", 1),
+        attn_impl=getattr(args, "attn_impl", "gather"),
     )
 
 

@@ -9,7 +9,7 @@ import jax
 import pytest
 
 from distrifuser_tpu import DistriConfig
-from distrifuser_tpu.utils.config import CFG_AXIS, SP_AXIS
+from distrifuser_tpu.utils.config import CFG_AXIS, DP_AXIS, SP_AXIS
 
 
 def make_config(devices, **kw):
@@ -21,7 +21,7 @@ def test_cfg_split_topology(devices8):
     cfg = make_config(devices8)
     assert cfg.world_size == 8
     assert cfg.n_device_per_batch == 4
-    assert cfg.mesh.shape == {CFG_AXIS: 2, SP_AXIS: 4}
+    assert cfg.mesh.shape == {DP_AXIS: 1, CFG_AXIS: 2, SP_AXIS: 4}
     # reference utils.py:98-109: ranks [0, n) are CFG branch 0, [n, 2n) branch 1
     assert [cfg.batch_idx(r) for r in range(8)] == [0, 0, 0, 0, 1, 1, 1, 1]
     assert [cfg.split_idx(r) for r in range(8)] == [0, 1, 2, 3, 0, 1, 2, 3]
@@ -33,7 +33,7 @@ def test_cfg_split_topology(devices8):
 def test_no_cfg_split(devices8):
     cfg = make_config(devices8, do_classifier_free_guidance=False)
     assert cfg.n_device_per_batch == 8
-    assert cfg.mesh.shape == {CFG_AXIS: 1, SP_AXIS: 8}
+    assert cfg.mesh.shape == {DP_AXIS: 1, CFG_AXIS: 1, SP_AXIS: 8}
     assert cfg.batch_idx(5) == 0
 
     cfg2 = make_config(devices8, split_batch=False)
@@ -44,7 +44,7 @@ def test_single_device():
     cfg = make_config([jax.devices()[0]])
     assert cfg.world_size == 1
     assert cfg.n_device_per_batch == 1
-    assert cfg.mesh.shape == {CFG_AXIS: 1, SP_AXIS: 1}
+    assert cfg.mesh.shape == {DP_AXIS: 1, CFG_AXIS: 1, SP_AXIS: 1}
 
 
 def test_power_of_two_asserted(devices8):
