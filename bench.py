@@ -25,16 +25,20 @@ import time
 A100_SDXL_1024_50STEP_S = 6.6
 
 
-def _arm_watchdog(seconds: float) -> None:
+def _arm_watchdog(seconds: float):
     """Emit a parseable failure line and exit if the TPU runtime wedges.
 
     The axon chip lease can hang backend init indefinitely after an earlier
     client died mid-run (observed 2026-07-28); a silent hang gives the driver
-    nothing, an explicit line documents what happened.
+    nothing, an explicit line documents what happened.  Returns a disarm
+    callback — the hazard is init/first-compile hang, not long measurements,
+    so the caller disarms after the warmup run completes.
     """
+    _disarmed = threading.Event()
 
     def fire():
-        time.sleep(seconds)
+        if _disarmed.wait(seconds):
+            return
         print(json.dumps({
             "metric": "bench_watchdog_timeout",
             "value": -1.0,
@@ -46,6 +50,7 @@ def _arm_watchdog(seconds: float) -> None:
         os._exit(2)
 
     threading.Thread(target=fire, daemon=True).start()
+    return _disarmed.set  # call to disarm once the runtime has proven healthy
 
 
 def main():
@@ -57,7 +62,7 @@ def main():
                         choices=[None, "sdxl", "tiny"], nargs="?")
     parser.add_argument("--watchdog_s", type=float, default=1500.0)
     args = parser.parse_args()
-    _arm_watchdog(args.watchdog_s)
+    disarm_watchdog = _arm_watchdog(args.watchdog_s)
 
     import jax
     import jax.numpy as jnp
@@ -121,15 +126,18 @@ def main():
     run = make_run(runner)
     try:
         run()  # warmup: compile + execute (flash attention active on TPU)
-    except Exception as e:  # Pallas/Mosaic failure -> XLA attention fallback
-        import os, sys
-
-        print(f"flash-attention path failed ({type(e).__name__}); "
+    except Exception as e:
+        if not on_tpu or os.environ.get("DISTRIFUSER_TPU_FLASH") == "0":
+            raise  # flash was never in play; surface the real error
+        # Pallas/Mosaic failure -> XLA attention fallback; a retry failure
+        # propagates with its own traceback
+        print(f"flash-attention path failed ({type(e).__name__}: {e}); "
               "falling back to XLA attention", file=sys.stderr)
         os.environ["DISTRIFUSER_TPU_FLASH"] = "0"
         runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
         run = make_run(runner)
         run()
+    disarm_watchdog()
     times = []
     for _ in range(args.test_times):
         t0 = time.perf_counter()

@@ -400,7 +400,7 @@ class DenoiseRunner:
     # observability
     # ------------------------------------------------------------------
 
-    def comm_volume_report(self, batch_size: int = 1, text_len: int = 77):
+    def comm_volume_report(self, batch_size: int = None, text_len: int = 77):
         """Per-layer-type stale-buffer element counts.
 
         Parity with the reference's verbose buffer stats at create_buffer
@@ -411,6 +411,11 @@ class DenoiseRunner:
         cfg = self.cfg
         if cfg.parallelism != "patch" or not cfg.is_sp:
             return {}
+        batch_size = cfg.batch_size if batch_size is None else batch_size
+        if batch_size % cfg.dp_degree != 0:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by dp_degree {cfg.dp_degree}"
+            )
         self.scheduler.set_timesteps(2)
         step = self._make_step(PHASE_SYNC)
 

@@ -155,6 +155,8 @@ class DistriConfig:
         assert is_power_of_2(world_size), "world size must be a power of 2"
         self.world_size = world_size
 
+        if self.dp_degree < 1:
+            raise ValueError(f"dp_degree must be >= 1, got {self.dp_degree}")
         if world_size % self.dp_degree != 0:
             raise ValueError(
                 f"dp_degree {self.dp_degree} must divide world size {world_size}"

@@ -84,9 +84,9 @@ def config_from_args(args) -> DistriConfig:
         use_cuda_graph=not args.no_cuda_graph,
         parallelism=args.parallelism,
         split_scheme=args.split_scheme,
-        batch_size=getattr(args, "batch_size", 1),
-        dp_degree=getattr(args, "dp_degree", 1),
-        attn_impl=getattr(args, "attn_impl", "gather"),
+        batch_size=args.batch_size,
+        dp_degree=args.dp_degree,
+        attn_impl=args.attn_impl,
     )
 
 

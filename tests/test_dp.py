@@ -59,3 +59,13 @@ def test_dp_matches_independent_runs(devices8):
             )
         )
         np.testing.assert_allclose(out_dp[img : img + 1], ref, atol=1e-4)
+
+
+def test_dp_through_pipeline(devices8):
+    from tests.test_pipelines import build_sd_pipeline
+
+    pipe, dcfg = build_sd_pipeline(devices8, 8, batch_size=2, dp_degree=2)
+    out = pipe(["a cat", "a dog"], num_inference_steps=2, output_type="latent")
+    lat = out.images[0]
+    assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
+    assert np.isfinite(lat).all()
