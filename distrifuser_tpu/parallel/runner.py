@@ -5,7 +5,7 @@ This is the TPU-native replacement for the reference's hot path
 counter phase (pipelines.py:147-165, distri_sdxl_unet_pp.py:74-116) around a
 replicated diffusers scheduler loop, here the *entire* generation — warmup
 steps, stale steps, CFG combination, scheduler — is a single `jax.jit`
-program over the ("cfg", "sp") mesh:
+program over the ("dp", "cfg", "sp") mesh:
 
 * step 0 runs the synchronous path and *creates* the stale-activation state
   pytree (the reference needs two recording passes + buffer allocation,

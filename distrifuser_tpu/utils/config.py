@@ -5,8 +5,8 @@ TPU-native re-design of the reference's `DistriConfig`
 NCCL process per GPU under torchrun, derives (rank, world_size), and builds
 `batch_group` / `split_group` NCCL communicators.  On TPU the idiomatic shape
 is single-controller SPMD: one process drives every local chip through a named
-`jax.sharding.Mesh`, and the two process-group families become the two mesh
-axes:
+`jax.sharding.Mesh`, and the two process-group families become mesh axes
+(plus a data-parallel axis the reference lacks):
 
 * axis ``"cfg"`` (size 2 when classifier-free guidance is batch-split, else 1)
   — the reference's *split_group* direction (utils.py:91-94): ranks holding the
@@ -14,6 +14,8 @@ axes:
 * axis ``"sp"`` (size ``n_device_per_batch``) — the reference's *batch_group*
   direction (utils.py:87-90): the patch/sequence-parallel peers within one CFG
   branch.
+* axis ``"dp"`` (size ``dp_degree``, default 1) — independent image groups,
+  an extension over the reference's separate-job sweeps.
 
 Device order matches the reference's rank layout (utils.py:98-109):
 linear device index r maps to ``cfg_idx = r // n_device_per_batch`` and

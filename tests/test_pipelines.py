@@ -145,3 +145,11 @@ def test_simple_tokenizer_shapes():
     assert ids.shape == (2, 77)
     assert ids[0, 0] == tok.bos
     assert (ids[1] == tok.eos).sum() >= 76
+
+
+def test_rectangular_image(devices8):
+    pipe, dcfg = build_sd_pipeline(devices8, 4, height=192, width=128)
+    out = pipe("a waterfall", num_inference_steps=2, output_type="latent")
+    lat = out.images[0]
+    assert lat.shape == (1, 24, 16, 4)
+    assert np.isfinite(lat).all()
