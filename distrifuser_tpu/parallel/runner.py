@@ -363,7 +363,12 @@ class DenoiseRunner:
                 check_vma=False,
             )(params, i, x, pstate, sstate, enc, added, gs)
 
-        return jax.jit(stepper)
+        # Donate the stale-state buffers: each step's input state is dead the
+        # moment the refreshed state returns, so XLA reuses the HBM in place
+        # (gather-layout state is O(L) per layer — the dominant allocation at
+        # high resolution).  The fused loop gets this for free from the scan.
+        donate = (3,) if with_state and cfg.parallelism == "patch" else ()
+        return jax.jit(stepper, donate_argnums=donate)
 
     def _generate_stepwise(self, latents, enc, added, gs, num_steps):
         """Python loop over per-step compiled calls (reference no-CUDA-graph
