@@ -50,15 +50,10 @@ def test_stepwise_single_device():
 
 def test_stepwise_with_dp(devices8):
     """Per-step mode with the 3-axis mesh: state lays out over (dp,cfg,sp)."""
-    cfg = DistriConfig(devices=devices8, height=128, width=128, warmup_steps=1,
-                      dp_degree=2, batch_size=2, use_cuda_graph=False)
-    ucfg = tiny_config()
-    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
-    stepw = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
-
-    cfg_f = DistriConfig(devices=devices8, height=128, width=128, warmup_steps=1,
-                        dp_degree=2, batch_size=2, use_cuda_graph=True)
-    fused = make_runner(cfg_f, ucfg, params, get_scheduler("ddim"))
+    stepw, cfg, ucfg = build(devices8, 8, use_cuda_graph=False,
+                             dp_degree=2, batch_size=2)
+    fused, _, _ = build(devices8, 8, use_cuda_graph=True,
+                        dp_degree=2, batch_size=2)
 
     k = jax.random.PRNGKey(5)
     lat = jax.random.normal(k, (2, 16, 16, 4))
