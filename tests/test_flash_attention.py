@@ -81,3 +81,20 @@ def test_chunked_sdpa_matches_direct(monkeypatch):
     monkeypatch.setattr(attn_mod, "_CHUNK_LOGITS_ELEMS", 1 << 16)
     chunked = sdpa(q, k, v, heads=heads)
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(direct), atol=1e-5)
+
+
+def test_flash_bf16_inputs():
+    """The on-TPU dtype: bf16 q/k/v with fp32 accumulators."""
+    b, l, heads, d = 1, 256, 2, 16
+    c = heads * d
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(keys[0], (b, l, c), jnp.bfloat16)
+    k = jax.random.normal(keys[1], (b, l, c), jnp.bfloat16)
+    v = jax.random.normal(keys[2], (b, l, c), jnp.bfloat16)
+    got = flash_sdpa(q, k, v, heads=heads, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = sdpa(q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32), heads=heads)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=0.03
+    )
