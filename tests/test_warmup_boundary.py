@@ -1,0 +1,43 @@
+"""Pin the warmup phase boundary (reference: counter <= warmup_steps selects
+the synchronous path everywhere, SURVEY.md §2.3).
+
+With num_steps = warmup+1 the displaced modes never reach the stale phase, so
+they must match full_sync bit-for-bit; with one more step the first stale
+step runs and outputs must diverge.
+"""
+
+import jax
+import numpy as np
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+from distrifuser_tpu.parallel.runner import DenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+
+
+def _run(devices8, mode, steps, warmup):
+    cfg = DistriConfig(devices=devices8[:4], height=128, width=128,
+                       warmup_steps=warmup, mode=mode)
+    ucfg = tiny_config()
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(k, (1, 16, 16, 4))
+    enc = jax.random.normal(jax.random.fold_in(k, 1), (2, 1, 7, ucfg.cross_attention_dim))
+    return np.asarray(runner.generate(lat, enc, num_inference_steps=steps))
+
+
+def test_warmup_plus_one_is_fully_synchronous(devices8):
+    w = 2
+    a = _run(devices8, "corrected_async_gn", w + 1, w)
+    b = _run(devices8, "full_sync", w + 1, w)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_first_stale_step_diverges(devices8):
+    w = 2
+    a = _run(devices8, "corrected_async_gn", w + 2, w)
+    b = _run(devices8, "full_sync", w + 2, w)
+    assert np.abs(a - b).max() > 1e-6, (
+        "displaced mode never engaged the stale path"
+    )
