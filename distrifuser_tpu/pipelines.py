@@ -182,6 +182,7 @@ class _DistriPipelineBase:
         guidance_scale: float = 5.0,
         seed: int = 0,
         output_type: str = "pil",
+        latents=None,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -204,15 +205,20 @@ class _DistriPipelineBase:
 
         embeds, added = self._encode(prompts, negs)
 
-        key = jax.random.PRNGKey(seed)
-        latents = jax.random.normal(
-            key,
-            (len(prompts), cfg.latent_height, cfg.latent_width,
-             self.unet_config.in_channels),
-            jnp.float32,
-        )
+        lat_shape = (len(prompts), cfg.latent_height, cfg.latent_width,
+                     self.unet_config.in_channels)
         self.scheduler.set_timesteps(num_inference_steps)
-        latents = latents * self.scheduler.init_noise_sigma
+        if latents is None:
+            # seeded noise, pre-scaled (diffusers passes a torch Generator;
+            # the JAX analog is the integer seed)
+            latents = jax.random.normal(jax.random.PRNGKey(seed), lat_shape,
+                                        jnp.float32)
+            latents = latents * self.scheduler.init_noise_sigma
+        else:
+            # caller-supplied initial noise (already scaled), for golden
+            # comparisons across configs
+            latents = jnp.asarray(latents, jnp.float32)
+            assert latents.shape == lat_shape, (latents.shape, lat_shape)
 
         latent = self.runner.generate(
             latents, embeds,

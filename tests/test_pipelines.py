@@ -153,3 +153,15 @@ def test_rectangular_image(devices8):
     lat = out.images[0]
     assert lat.shape == (1, 24, 16, 4)
     assert np.isfinite(lat).all()
+
+
+def test_caller_supplied_latents(devices8):
+    pipe, dcfg = build_sd_pipeline(devices8, 2)
+    lat0 = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(0), (1, 16, 16, 4))
+    )
+    a = pipe("a pier", num_inference_steps=2, latents=lat0, output_type="np").images[0]
+    b = pipe("a pier", num_inference_steps=2, latents=lat0, output_type="np").images[0]
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(AssertionError):
+        pipe("a pier", num_inference_steps=2, latents=lat0[:, :8])
