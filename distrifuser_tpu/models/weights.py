@@ -43,12 +43,35 @@ def load_safetensors(path: str) -> Dict[str, np.ndarray]:
     return load_file(path)
 
 
-def load_sharded_safetensors(model_dir: str, prefix: str = "") -> Dict[str, np.ndarray]:
-    """Load all *.safetensors shards in a directory into one state dict."""
+def load_sharded_safetensors(
+    model_dir: str, prefix: str = "", variant: Optional[str] = None
+) -> Dict[str, np.ndarray]:
+    """Load *.safetensors shards in a directory into one state dict.
+
+    HF snapshots may carry both base and variant weights (e.g.
+    ``diffusion_pytorch_model.safetensors`` and ``...fp16.safetensors``) with
+    identical tensor names; mixing them would be nondeterministic.  With
+    ``variant`` set (e.g. "fp16") only those files load; otherwise variant
+    files are skipped whenever base files exist.
+    """
+    names = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if variant:
+        names = [f for f in names if f".{variant}." in f]
+        if not names:
+            raise FileNotFoundError(
+                f"no .{variant}. safetensors shards in {model_dir}"
+            )
+    else:
+        # "name.safetensors" / "name-00001-of-00002.safetensors" are base;
+        # "name.fp16.safetensors" is a variant (3 dot-segments)
+        base = [f for f in names if len(f.split(".")) == 2]
+        if base:
+            names = base
     sd: Dict[str, np.ndarray] = {}
-    for fname in sorted(os.listdir(model_dir)):
-        if fname.endswith(".safetensors"):
-            sd.update(load_safetensors(os.path.join(model_dir, fname)))
+    for fname in names:
+        sd.update(load_safetensors(os.path.join(model_dir, fname)))
     if prefix:
         sd = {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
     return sd

@@ -261,6 +261,7 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         pretrained_model_name_or_path: str,
         scheduler: str | BaseScheduler = "ddim",
         dtype=None,
+        variant: Optional[str] = None,
         **kwargs,
     ) -> "DistriSDXLPipeline":
         root = pretrained_model_name_or_path
@@ -272,16 +273,16 @@ class DistriSDXLPipeline(_DistriPipelineBase):
             )
         dtype = dtype or distri_config.dtype
         unet_params = convert_unet_state_dict(
-            load_sharded_safetensors(os.path.join(root, "unet")), dtype
+            load_sharded_safetensors(os.path.join(root, "unet"), variant=variant), dtype
         )
         vae_params = convert_vae_state_dict(
-            load_sharded_safetensors(os.path.join(root, "vae")), dtype
+            load_sharded_safetensors(os.path.join(root, "vae"), variant=variant), dtype
         )
         te1 = convert_clip_state_dict(
-            load_sharded_safetensors(os.path.join(root, "text_encoder")), dtype
+            load_sharded_safetensors(os.path.join(root, "text_encoder"), variant=variant), dtype
         )
         te2 = convert_clip_state_dict(
-            load_sharded_safetensors(os.path.join(root, "text_encoder_2")), dtype
+            load_sharded_safetensors(os.path.join(root, "text_encoder_2"), variant=variant), dtype
         )
         from .native import release_mappings
 
@@ -352,6 +353,7 @@ class DistriSDPipeline(_DistriPipelineBase):
         pretrained_model_name_or_path: str,
         scheduler: str | BaseScheduler = "ddim",
         dtype=None,
+        variant: Optional[str] = None,
         **kwargs,
     ) -> "DistriSDPipeline":
         root = pretrained_model_name_or_path
@@ -361,13 +363,13 @@ class DistriSDPipeline(_DistriPipelineBase):
             )
         dtype = dtype or distri_config.dtype
         unet_params = convert_unet_state_dict(
-            load_sharded_safetensors(os.path.join(root, "unet")), dtype
+            load_sharded_safetensors(os.path.join(root, "unet"), variant=variant), dtype
         )
         vae_params = convert_vae_state_dict(
-            load_sharded_safetensors(os.path.join(root, "vae")), dtype
+            load_sharded_safetensors(os.path.join(root, "vae"), variant=variant), dtype
         )
         te = convert_clip_state_dict(
-            load_sharded_safetensors(os.path.join(root, "text_encoder")), dtype
+            load_sharded_safetensors(os.path.join(root, "text_encoder"), variant=variant), dtype
         )
         from .native import release_mappings
 

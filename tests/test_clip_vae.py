@@ -168,3 +168,20 @@ def test_params_disk_cache_roundtrip(tmp_path):
     assert jax.tree.structure(params) == jax.tree.structure(loaded)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_loader_variant_selection(tmp_path):
+    from safetensors.numpy import save_file
+
+    from distrifuser_tpu.models.weights import load_sharded_safetensors
+
+    d = str(tmp_path)
+    save_file({"w": np.zeros((2,), np.float32)}, f"{d}/model.safetensors")
+    save_file({"w": np.ones((2,), np.float16)}, f"{d}/model.fp16.safetensors")
+
+    base = load_sharded_safetensors(d)
+    assert base["w"].dtype == np.float32  # variant ignored when base exists
+    fp16 = load_sharded_safetensors(d, variant="fp16")
+    assert fp16["w"].dtype == np.float16
+    with pytest.raises(FileNotFoundError):
+        load_sharded_safetensors(d, variant="bf16")
