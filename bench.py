@@ -18,6 +18,7 @@ reference's single-GPU baseline at the same workload shape.
 import argparse
 import json
 import os
+import statistics
 import sys
 import threading
 import time
@@ -143,8 +144,7 @@ def main():
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
-    times.sort()
-    val = times[len(times) // 2]  # median
+    val = statistics.median(times)
 
     # baseline scaled to the actual step count (it is per-50-step-generation)
     vs = (

@@ -21,7 +21,7 @@ Converted trees can be cached to disk with `save_params` / `load_params`
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -92,6 +92,11 @@ def load_safetensors_fast(
     addr = lib.st_open(path.encode(), ctypes.byref(size))
     if not addr:
         return None
+    if prefetch_threads > 0:
+        # threaded page-in: touch every page with a striped thread pool so a
+        # cold multi-GB shard reads at full disk bandwidth up front instead of
+        # serially faulting during per-tensor conversion
+        lib.st_prefetch(addr, size.value, prefetch_threads)
     try:
         buf = (ctypes.c_ubyte * size.value).from_address(addr)
         raw = np.frombuffer(buf, dtype=np.uint8)

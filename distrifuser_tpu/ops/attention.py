@@ -80,7 +80,10 @@ def sdpa(q, k, v, *, heads: int):
     if _flash_eligible(q, k, heads):
         from .flash_attention import flash_sdpa
 
-        return flash_sdpa(q, k, v, heads=heads)
+        # Forcing via env on a non-TPU backend means interpret mode (tests):
+        # Mosaic kernels only compile for TPU.
+        interpret = jax.devices()[0].platform == "cpu"
+        return flash_sdpa(q, k, v, heads=heads, interpret=interpret)
     b, lq, c = q.shape
     lk = k.shape[1]
     d = c // heads

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -82,6 +83,26 @@ def _hf_tokenizer(path: str):
     from transformers import CLIPTokenizer
 
     return CLIPTokenizer.from_pretrained(path)
+
+
+def _tokenizer_or_fallback(path: str):
+    """Real CLIP tokenizer, or the hash tokenizer with a LOUD warning.
+
+    The fallback keeps weightless smoke tests running, but on a real snapshot
+    a broken tokenizer dir would silently ruin every generated image — so the
+    degradation must never be silent."""
+    try:
+        return _hf_tokenizer(path)
+    except Exception as e:
+        print(
+            f"WARNING: failed to load CLIP tokenizer from {path!r} "
+            f"({type(e).__name__}: {e}); falling back to the hash-based "
+            "SimpleTokenizer. Generated images will NOT match real-prompt "
+            "outputs.",
+            file=sys.stderr,
+            flush=True,
+        )
+        return SimpleTokenizer()
 
 
 def _scheduler_from_snapshot(root: str, name: str | BaseScheduler) -> BaseScheduler:
@@ -227,7 +248,8 @@ class _DistriPipelineBase:
             added_cond=added,
         )
         if output_type == "latent":
-            return PipelineOutput(images=[np.asarray(latent)])
+            # one entry per image, matching the 'np'/'pil' contract
+            return PipelineOutput(images=list(np.asarray(latent)))
         image = self._decode(
             self.vae_params, latent / self.vae_config.scaling_factor
         )
@@ -287,11 +309,8 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         from .native import release_mappings
 
         release_mappings()  # converted trees are jax copies; unmap the shards
-        try:
-            tok1 = _hf_tokenizer(os.path.join(root, "tokenizer"))
-            tok2 = _hf_tokenizer(os.path.join(root, "tokenizer_2"))
-        except Exception:
-            tok1 = tok2 = SimpleTokenizer()
+        tok1 = _tokenizer_or_fallback(os.path.join(root, "tokenizer"))
+        tok2 = _tokenizer_or_fallback(os.path.join(root, "tokenizer_2"))
         sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,
@@ -374,10 +393,7 @@ class DistriSDPipeline(_DistriPipelineBase):
         from .native import release_mappings
 
         release_mappings()
-        try:
-            tok = _hf_tokenizer(os.path.join(root, "tokenizer"))
-        except Exception:
-            tok = SimpleTokenizer()
+        tok = _tokenizer_or_fallback(os.path.join(root, "tokenizer"))
         sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,

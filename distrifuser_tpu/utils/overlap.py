@@ -1,0 +1,220 @@
+"""HLO-level comm/compute overlap verification.
+
+The displaced-patch design claims its stale-refresh collectives are *latency
+hidden*: each stale step's halo exchanges and KV all-gathers produce values
+consumed only by the NEXT scan iteration, so XLA's latency-hiding scheduler
+is free to run them concurrently with the current step's convs/matmuls.  The
+reference gets the same effect imperatively with async NCCL all-gathers
+waited one step later (/root/reference/distrifuser/utils.py:170-190,
+modules/pp/attn.py:123-143); here the property is structural — and therefore
+checkable from the compiled HLO, not assumed.
+
+`analyze_loop_collectives(hlo_text)` parses every while-loop body in a
+compiled module and classifies each collective (all-gather / collective-
+permute / all-reduce / reduce-scatter, sync or async-start form) as
+
+* **deferred** — its value reaches ONLY the loop carry (the ROOT tuple),
+  travelling exclusively through data-movement ops (copies, reshapes,
+  concatenates, layout fusions that contain no arithmetic).  Nothing in the
+  current iteration computes with it; the scheduler may overlap it with all
+  remaining compute of the iteration.
+* **inline** — some transitive consumer does arithmetic this iteration
+  (attention matmuls on sync-phase KV gathers, scheduler math on the final
+  output gather).  These serialize against compute.
+
+The steady-state (stale scan) body of a patch-parallel program must have
+inline collectives ONLY for the per-step full-output gather + CFG combine
+(the reference's output gather is synchronous too, distri_sdxl_unet_pp.py:
+162-169); every refresh collective must classify deferred.
+tests/test_overlap.py asserts this, with the sync path as negative control.
+`python -m distrifuser_tpu.utils.overlap <file.hlo>` prints the report for
+any dumped module (e.g. from a real-chip run with XLA dump flags).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List
+
+_COLLECTIVES = (
+    "all-gather(", "collective-permute(", "all-reduce(", "reduce-scatter(",
+    "all-gather-start(", "collective-permute-start(", "all-reduce-start(",
+    "all-to-all(",
+)
+# pure data movement: consuming a value through these does not compute with it
+_DM_OPS = frozenset({
+    "copy", "bitcast", "bitcast-convert", "convert", "reshape", "transpose",
+    "concatenate", "pad", "slice", "dynamic-slice", "dynamic-update-slice",
+    "broadcast", "reverse", "tuple", "get-tuple-element",
+    "all-gather-done", "collective-permute-done", "all-reduce-done",
+    "optimization-barrier",
+})
+# ops that may appear in a data-movement fusion without consuming anything
+_DM_SOURCES = frozenset({"parameter", "constant", "iota"})
+
+_ATTR_REF = re.compile(r"(?:condition|body)=%[\w.\-]+")
+_CALLS = re.compile(r"calls=%?([\w.\-]+)")
+_TOKEN = re.compile(r"%([\w.\-]+)")
+_DEF = re.compile(r"^(ROOT )?%?([\w.\-]+) = ")
+_BLOCK_HEAD = re.compile(r"^(?:ENTRY )?%?([\w.\-]+)\s*\(.*\)\s*->\s*.*\{$")
+_OPCODE = re.compile(r"([\w\-]+)\(")
+
+
+def parse_computations(hlo_text: str) -> Dict[str, List[str]]:
+    """Split printed HLO into {computation name: [instruction lines]}."""
+    blocks: Dict[str, List[str]] = {}
+    cur, acc = None, []
+    for line in hlo_text.splitlines():
+        m = _BLOCK_HEAD.match(line)
+        if m:
+            cur, acc = m.group(1), []
+            continue
+        if line.startswith("}"):
+            if cur is not None:
+                blocks[cur] = acc
+            cur = None
+            continue
+        if cur is not None:
+            acc.append(line.strip())
+    return blocks
+
+
+def _opcode(line: str) -> str:
+    m = _OPCODE.search(line.split(" = ", 1)[1])
+    return m.group(1) if m else "?"
+
+
+@dataclasses.dataclass
+class LoopReport:
+    body: str
+    deferred: Dict[str, str]  # instruction name -> opcode
+    inline: Dict[str, str]
+
+    @property
+    def n_deferred(self) -> int:
+        return len(self.deferred)
+
+    @property
+    def n_inline(self) -> int:
+        return len(self.inline)
+
+
+class _Analyzer:
+    def __init__(self, hlo_text: str):
+        self.blocks = parse_computations(hlo_text)
+        self._dm_comp: Dict[str, bool] = {}
+
+    def _computation_is_dm(self, name: str) -> bool:
+        """True if a (fusion) computation contains no arithmetic at all."""
+        if name in self._dm_comp:
+            return self._dm_comp[name]
+        self._dm_comp[name] = False  # cycle guard
+        ok = True
+        for ln in self.blocks.get(name, ()):
+            if " = " not in ln:
+                continue
+            op = _opcode(ln)
+            if op in _DM_OPS or op in _DM_SOURCES:
+                continue
+            if op == "fusion":
+                m = _CALLS.search(ln)
+                if m and self._computation_is_dm(m.group(1)):
+                    continue
+            ok = False
+            break
+        self._dm_comp[name] = ok
+        return ok
+
+    def analyze_body(self, body: str) -> LoopReport | None:
+        lines = self.blocks.get(body, [])
+        defs: Dict[str, str] = {}
+        root = None
+        for ln in lines:
+            m = _DEF.match(ln)
+            if m:
+                defs[m.group(2)] = ln
+                if m.group(1):
+                    root = m.group(2)
+        if root is None:
+            return None
+        consumers: Dict[str, List[str]] = {n: [] for n in defs}
+        for n, ln in defs.items():
+            rhs = _ATTR_REF.sub("", ln.split(" = ", 1)[1])
+            rhs = _CALLS.sub("", rhs)
+            for op in _TOKEN.findall(rhs):
+                if op in defs and op != n:
+                    consumers[op].append(n)
+
+        def dm_consumer(name: str) -> bool:
+            """Consuming instruction is pure data movement?"""
+            ln = defs[name]
+            op = _opcode(ln)
+            if op in _DM_OPS:
+                return True
+            if op == "fusion":
+                m = _CALLS.search(ln)
+                return bool(m) and self._computation_is_dm(m.group(1))
+            return False
+
+        def deferred(coll: str) -> bool:
+            """Value reaches only the carry, via data movement only."""
+            seen, frontier = set(), [coll]
+            while frontier:
+                n = frontier.pop()
+                if n in seen:
+                    continue
+                seen.add(n)
+                if not consumers[n] and n != root:
+                    continue  # dead value: harmless
+                for u in consumers[n]:
+                    if u == root and _opcode(defs[u]) == "tuple":
+                        continue
+                    if dm_consumer(u):
+                        frontier.append(u)
+                    else:
+                        return False
+            return True
+
+        d, i = {}, {}
+        for n, ln in defs.items():
+            if any(c in ln for c in _COLLECTIVES):
+                (d if deferred(n) else i)[n] = _opcode(ln)
+        if d or i:
+            return LoopReport(body, d, i)
+        return None
+
+
+def analyze_loop_collectives(hlo_text: str) -> List[LoopReport]:
+    """Classify every while-body collective as deferred (carry-only through
+    data movement) or inline (computed with this iteration)."""
+    analyzer = _Analyzer(hlo_text)
+    bodies = set(re.findall(r"body=%?([\w.\-]+)", hlo_text))
+    reports = []
+    for body in sorted(bodies):
+        r = analyzer.analyze_body(body)
+        if r is not None:
+            reports.append(r)
+    return reports
+
+
+def format_report(reports: List[LoopReport]) -> str:
+    from collections import Counter
+
+    out = []
+    for r in reports:
+        out.append(
+            f"loop body {r.body}: {r.n_deferred} deferred / {r.n_inline} inline"
+        )
+        if r.deferred:
+            out.append(f"  deferred (overlappable): {dict(Counter(r.deferred.values()))}")
+        if r.inline:
+            out.append(f"  inline (serializing):    {dict(Counter(r.inline.values()))}")
+    return "\n".join(out) if out else "no while-loop collectives found"
+
+
+if __name__ == "__main__":
+    import sys
+
+    with open(sys.argv[1]) as f:
+        print(format_report(analyze_loop_collectives(f.read())))

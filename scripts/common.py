@@ -78,6 +78,9 @@ def config_from_args(args) -> DistriConfig:
     return DistriConfig(
         height=h,
         width=w,
+        # reference parity (run_sdxl.py:87): guidance_scale <= 1 disables CFG
+        # entirely — no cfg mesh axis, single-branch UNet batch
+        do_classifier_free_guidance=args.guidance_scale > 1,
         split_batch=not args.no_split_batch,
         warmup_steps=args.warmup_steps,
         mode=args.sync_mode,

@@ -1,0 +1,50 @@
+"""CLI flag-surface parity tests (scripts/common.py).
+
+The reference derives CFG from the flag value
+(/root/reference/scripts/run_sdxl.py:87:
+``do_classifier_free_guidance = guidance_scale > 1``); the config built from
+argv must match, so ``--guidance_scale 1`` never builds a cfg mesh axis or
+runs the unconditional branch.
+"""
+
+import argparse
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import common  # noqa: E402
+from distrifuser_tpu.utils.config import CFG_AXIS  # noqa: E402
+
+
+def _args(argv):
+    parser = argparse.ArgumentParser()
+    common.add_distri_args(parser)
+    return parser.parse_args(argv)
+
+
+def test_guidance_scale_1_disables_cfg(devices8):
+    args = _args(["--guidance_scale", "1.0"])
+    cfg = common.config_from_args(args)
+    assert not cfg.do_classifier_free_guidance
+    assert cfg.mesh.shape[CFG_AXIS] == 1
+    # every device serves the single branch
+    assert cfg.n_device_per_batch == cfg.world_size
+
+
+def test_guidance_scale_default_enables_cfg(devices8):
+    cfg = common.config_from_args(_args([]))
+    assert cfg.do_classifier_free_guidance
+    assert cfg.mesh.shape[CFG_AXIS] == 2
+
+
+def test_tokenizer_fallback_is_loud(capsys):
+    from distrifuser_tpu import pipelines
+
+    tok = pipelines._tokenizer_or_fallback("/nonexistent/tokenizer/dir")
+    assert isinstance(tok, pipelines.SimpleTokenizer)
+    err = capsys.readouterr().err
+    assert "WARNING" in err
+    assert "/nonexistent/tokenizer/dir" in err

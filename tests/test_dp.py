@@ -66,6 +66,7 @@ def test_dp_through_pipeline(devices8):
 
     pipe, dcfg = build_sd_pipeline(devices8, 8, batch_size=2, dp_degree=2)
     out = pipe(["a cat", "a dog"], num_inference_steps=2, output_type="latent")
-    lat = out.images[0]
+    assert len(out.images) == 2
+    lat = np.stack(out.images)
     assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()

@@ -1,0 +1,71 @@
+"""Comm/compute overlap: structural verification from compiled HLO.
+
+The claim under test (runner.py docstring, SURVEY.md §3.3): in the stale
+steady-state scan, every refresh collective (halo ppermute, KV all-gather)
+produces values consumed only by the *next* iteration, so the scheduler can
+hide them behind compute — the role of the reference's async NCCL gathers
+(/root/reference/distrifuser/utils.py:170-190).  The sync/full_sync path is
+the negative control: its gathers feed attention in the same step and MUST
+classify as inline, proving the analysis discriminates.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models import unet as unet_mod
+from distrifuser_tpu.parallel.runner import DenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.utils.overlap import analyze_loop_collectives
+
+
+def _compiled_hlo(devices8, mode, num_steps):
+    ucfg = unet_mod.tiny_config(sdxl=False)
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg)
+    depth = len(ucfg.block_out_channels) - 1
+    cfg = DistriConfig(
+        devices=devices8, height=8 * 8 * (1 << depth) * 2, width=128,
+        warmup_steps=1, parallelism="patch", mode=mode,
+    )
+    runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    lat = jnp.zeros((1, cfg.latent_height, cfg.latent_width, ucfg.in_channels))
+    enc = jnp.zeros((2, 1, 7, ucfg.cross_attention_dim))
+    fn = runner._build(num_steps)
+    return fn.lower(params, lat, enc, None, 5.0).compile().as_text()
+
+
+def test_stale_scan_collectives_all_deferred(devices8):
+    """Steady state: every refresh collective must be carry-only; the only
+    same-step consumers allowed are the full-output gather + CFG combine
+    (synchronous in the reference as well, distri_sdxl_unet_pp.py:162-169)."""
+    hlo = _compiled_hlo(devices8, "corrected_async_gn", 4)
+    reports = analyze_loop_collectives(hlo)
+    assert reports, "no while-loop collectives found in patch program"
+    # with warmup_steps=1 and 4 steps the only surviving loop is the stale scan
+    stale = max(reports, key=lambda r: r.n_deferred)
+    assert stale.n_inline <= 2, (
+        f"stale-scan refresh collectives serialize against compute: {stale.inline}"
+    )
+    assert all(k.startswith("all-gather") for k in stale.inline.values()), (
+        f"only the output/CFG gathers may be inline, got {stale.inline}"
+    )
+    # the refresh set: per-conv halo permutes + per-self-attn KV gathers
+    kinds = set(stale.deferred.values())
+    assert "collective-permute" in kinds, "halo refreshes missing from carry"
+    assert any(k.startswith("all-gather") for k in kinds), (
+        "KV refreshes missing from carry"
+    )
+    assert stale.n_deferred >= 10
+
+
+def test_sync_path_collectives_are_inline(devices8):
+    """Negative control: full_sync gathers feed same-step attention compute —
+    the analyzer must NOT classify them as overlappable."""
+    hlo = _compiled_hlo(devices8, "full_sync", 5)
+    reports = analyze_loop_collectives(hlo)
+    assert reports, "no while-loop collectives found in full_sync program"
+    body = max(reports, key=lambda r: r.n_inline)
+    assert body.n_inline > 0, (
+        "analysis lost discrimination: sync-phase gathers classified deferred"
+    )

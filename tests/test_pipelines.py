@@ -99,8 +99,9 @@ def test_sdxl_multi_device_matches_single(devices8):
 def test_sd_pipeline_latent_output(devices8):
     pipe, dcfg = build_sd_pipeline(devices8, 4)
     out = pipe("a cat", num_inference_steps=2, seed=3, output_type="latent")
+    assert len(out.images) == 1  # one entry per image, like 'np'/'pil'
     lat = out.images[0]
-    assert lat.shape == (1, dcfg.latent_height, dcfg.latent_width, 4)
+    assert lat.shape == (dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()
 
 
@@ -119,7 +120,8 @@ def test_guidance_forced_off_without_cfg(devices8):
 def test_batch_of_prompts(devices8):
     pipe, dcfg = build_sd_pipeline(devices8, 4, batch_size=2)
     out = pipe(["a cat", "a dog"], num_inference_steps=2, output_type="latent")
-    lat = out.images[0]
+    assert len(out.images) == 2
+    lat = np.stack(out.images)
     assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()
     with pytest.raises(AssertionError, match="batch_size"):
@@ -134,7 +136,8 @@ def test_sdxl_batch_prompts(devices8):
         num_inference_steps=2,
         output_type="latent",
     )
-    lat = out.images[0]
+    assert len(out.images) == 2
+    lat = np.stack(out.images)
     assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()
 
@@ -150,8 +153,9 @@ def test_simple_tokenizer_shapes():
 def test_rectangular_image(devices8):
     pipe, dcfg = build_sd_pipeline(devices8, 4, height=192, width=128)
     out = pipe("a waterfall", num_inference_steps=2, output_type="latent")
+    assert len(out.images) == 1
     lat = out.images[0]
-    assert lat.shape == (1, 24, 16, 4)
+    assert lat.shape == (24, 16, 4)
     assert np.isfinite(lat).all()
 
 

@@ -1,0 +1,231 @@
+"""Scheduler golden tests: exact analytic trajectories + independent references.
+
+Two layers of defense (diffusers is not installed on this box, so diffusers
+==0.24 cannot be imported; these replace bit-parity with math):
+
+1. **Point-mass exactness** (closed form).  For a point-mass data
+   distribution at ``x0``, the exact epsilon is recoverable at every noise
+   level, and each sampler's update must map ``alpha_t*x0 + sigma_t*n``
+   EXACTLY to ``alpha_prev*x0 + sigma_prev*n`` with the *same* ``n`` — for any
+   step size, any schedule.  This pins every coefficient and table index of
+   DDIM / Euler / DPM++ (and the v-prediction conversion) analytically; an
+   off-by-one in the alpha/sigma tables or a sign error in the update cannot
+   pass.  (Derivation: DDIM eq.(12) of arXiv:2010.02502 with eta=0;
+   DPM-Solver++ first-order update of arXiv:2211.01095 — exact x0 makes the
+   2M correction a no-op.)
+
+2. **Independent 2M reference.**  The multistep correction is invisible to
+   (1), so a from-the-paper numpy implementation of DPM-Solver++(2M) —
+   written in diffusers' list-carry style, deliberately NOT sharing the scan
+   carry-state code under test — is driven by a nonlinear fake model and must
+   match the jnp implementation step for step.  Tail convention: final sigma
+   = 0, last step first-order (diffusers lower_order_final=True,
+   final_sigmas_type="zero").
+
+Table goldens (leading spacing, steps_offset=1) are hand-computed:
+1000 train steps / 50 inference steps -> timesteps 981, 961, ..., 21, 1.
+"""
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.schedulers.scheduling import (
+    _leading_timesteps,
+    _make_alphas_cumprod,
+)
+
+SHAPE = (2, 4, 4, 3)
+
+
+def _tables(steps):
+    ac = _make_alphas_cumprod(1000, 0.00085, 0.012, "scaled_linear")
+    ts = _leading_timesteps(1000, steps, 1)
+    return ac, ts
+
+
+def _rand(seed):
+    r = np.random.RandomState(seed)
+    return r.randn(*SHAPE).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# table goldens
+# ---------------------------------------------------------------------------
+
+def test_leading_timesteps_golden():
+    ts = _leading_timesteps(1000, 50, 1)
+    assert ts[0] == 981 and ts[1] == 961 and ts[-1] == 1
+    assert len(ts) == 50 and np.all(np.diff(ts) == -20)
+    # 25-step case: ratio 40
+    ts25 = _leading_timesteps(1000, 25, 1)
+    assert ts25[0] == 961 and ts25[-1] == 1 and np.all(np.diff(ts25) == -40)
+
+
+def test_scaled_linear_betas_golden():
+    ac = _make_alphas_cumprod(1000, 0.00085, 0.012, "scaled_linear")
+    # beta_0 = 0.00085 exactly; beta_999 = 0.012 exactly
+    assert ac[0] == pytest.approx(1 - 0.00085, rel=1e-12)
+    assert len(ac) == 1000 and ac[-1] < 5e-3  # SD's terminal alpha_bar ~ 0.0047
+    assert np.all(np.diff(ac) < 0)
+
+
+# ---------------------------------------------------------------------------
+# 1. point-mass exactness (closed-form trajectories)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("steps", [7, 50])
+@pytest.mark.parametrize("pred", ["epsilon", "v_prediction"])
+def test_ddim_point_mass_exact(steps, pred):
+    ac, ts = _tables(steps)
+    a = np.sqrt(ac[ts])
+    s = np.sqrt(1 - ac[ts])
+    prev = ts - 1000 // steps
+    ac_prev = np.where(prev >= 0, ac[np.clip(prev, 0, None)], ac[0])
+    a_p, s_p = np.sqrt(ac_prev), np.sqrt(1 - ac_prev)
+
+    x0, n = _rand(0), _rand(1)
+    sched = get_scheduler("ddim", prediction_type=pred).set_timesteps(steps)
+    state = sched.init_state(SHAPE)
+    x = a[0] * x0 + s[0] * n
+    for i in range(steps):
+        if pred == "epsilon":
+            out = n
+        else:
+            out = a[i] * n - s[i] * x0  # v-target of the point mass
+        x, state = sched.step(x, out, i, state)
+        expect = a_p[i] * x0 + s_p[i] * n
+        np.testing.assert_allclose(np.asarray(x), expect, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("steps", [7, 50])
+@pytest.mark.parametrize("pred", ["epsilon", "v_prediction"])
+def test_euler_point_mass_exact(steps, pred):
+    ac, ts = _tables(steps)
+    sig = np.append(((1 - ac[ts]) / ac[ts]) ** 0.5, 0.0)
+
+    x0, n = _rand(2), _rand(3)
+    sched = get_scheduler("euler", prediction_type=pred).set_timesteps(steps)
+    state = sched.init_state(SHAPE)
+    x = x0 + sig[0] * n  # sigma-space parameterization
+    for i in range(steps):
+        # the model sees the descaled (VP) input; alpha_bar = 1/(sigma^2+1)
+        av = 1.0 / np.sqrt(sig[i] ** 2 + 1.0)
+        sv = sig[i] * av
+        scaled = np.asarray(sched.scale_model_input(x, i))
+        np.testing.assert_allclose(
+            scaled, av * (x0 + sig[i] * n), rtol=2e-5, atol=1e-5
+        )
+        out = n if pred == "epsilon" else av * n - sv * x0
+        x, state = sched.step(x, out, i, state)
+        np.testing.assert_allclose(
+            np.asarray(x), x0 + sig[i + 1] * n, rtol=2e-4, atol=2e-5
+        )
+    np.testing.assert_allclose(np.asarray(x), x0, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("steps", [7, 50])
+@pytest.mark.parametrize("pred", ["epsilon", "v_prediction"])
+def test_dpm_point_mass_exact(steps, pred):
+    ac, ts = _tables(steps)
+    a = np.append(np.sqrt(ac[ts]), 1.0)
+    s = np.append(np.sqrt(1 - ac[ts]), 0.0)
+
+    x0, n = _rand(4), _rand(5)
+    sched = get_scheduler("dpm-solver", prediction_type=pred).set_timesteps(steps)
+    state = sched.init_state(SHAPE)
+    x = a[0] * x0 + s[0] * n
+    for i in range(steps):
+        out = n if pred == "epsilon" else a[i] * n - s[i] * x0
+        x, state = sched.step(x, out, i, state)
+        expect = a[i + 1] * x0 + s[i + 1] * n
+        np.testing.assert_allclose(np.asarray(x), expect, rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(np.asarray(x), x0, rtol=3e-4, atol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2. independent references, nonlinear fake model (exercises 2M correction)
+# ---------------------------------------------------------------------------
+
+def _fake_eps(x, i):
+    """Deterministic, nonlinear, step-dependent stand-in model."""
+    return np.tanh(0.7 * np.asarray(x, np.float64)) + 0.05 * np.cos(float(i))
+
+
+def _ddim_reference(x, steps):
+    ac, ts = _tables(steps)
+    ratio = 1000 // steps
+    traj = []
+    for i, t in enumerate(ts):
+        eps = _fake_eps(x, i)
+        a_t = ac[t]
+        a_p = ac[t - ratio] if t - ratio >= 0 else ac[0]
+        pred_x0 = (x - np.sqrt(1 - a_t) * eps) / np.sqrt(a_t)
+        x = np.sqrt(a_p) * pred_x0 + np.sqrt(1 - a_p) * eps
+        traj.append(x)
+    return traj
+
+
+def _euler_reference(x, steps):
+    ac, ts = _tables(steps)
+    sig = np.append(((1 - ac[ts]) / ac[ts]) ** 0.5, 0.0)
+    traj = []
+    for i in range(steps):
+        eps = _fake_eps(x / np.sqrt(sig[i] ** 2 + 1.0), i)
+        x = x + (sig[i + 1] - sig[i]) * eps  # d/dsigma of x = x0 + sigma*eps
+        traj.append(x)
+    return traj
+
+
+def _dpm_2m_reference(x, steps):
+    """DPM-Solver++(2M), list-carry style (arXiv:2211.01095 eq. (4.3)/(4.4);
+    diffusers multistep_dpm_solver_second_order_update convention for r)."""
+    ac, ts = _tables(steps)
+    alpha = np.append(np.sqrt(ac[ts]), 1.0)
+    sigma = np.append(np.sqrt(1 - ac[ts]), 0.0)
+    with np.errstate(divide="ignore"):
+        lam = np.log(alpha) - np.log(sigma)  # +inf at the appended tail
+    x0_hist = []
+    traj = []
+    for i in range(steps):
+        eps = _fake_eps(x, i)
+        x0 = (x - sigma[i] * eps) / alpha[i]
+        last = i == steps - 1
+        if i == 0 or last:
+            d = x0  # no history / lower_order_final
+        else:
+            h = lam[i + 1] - lam[i]
+            h_prev = lam[i] - lam[i - 1]
+            r = h_prev / h
+            d = (1 + 1 / (2 * r)) * x0 - (1 / (2 * r)) * x0_hist[-1]
+        if last:
+            x = x0  # sigma_next = 0, expm1(-inf) = -1 -> alpha_next * D
+        else:
+            h = lam[i + 1] - lam[i]
+            x = (sigma[i + 1] / sigma[i]) * x - alpha[i + 1] * np.expm1(-h) * d
+        x0_hist.append(x0)
+        traj.append(x)
+    return traj
+
+
+@pytest.mark.parametrize(
+    "name,ref",
+    [("ddim", _ddim_reference), ("euler", _euler_reference),
+     ("dpm-solver", _dpm_2m_reference)],
+)
+@pytest.mark.parametrize("steps", [4, 13, 50])
+def test_matches_independent_reference(name, ref, steps):
+    sched = get_scheduler(name).set_timesteps(steps)
+    state = sched.init_state(SHAPE)
+    x_init = _rand(6) * float(sched.init_noise_sigma)
+    expected = ref(x_init.copy(), steps)
+
+    x = x_init.copy()
+    for i in range(steps):
+        model_in = np.asarray(sched.scale_model_input(x, i), np.float64)
+        out = _fake_eps(model_in, i)
+        x, state = sched.step(x, out, i, state)
+        np.testing.assert_allclose(
+            np.asarray(x), expected[i], rtol=5e-4, atol=5e-5,
+            err_msg=f"{name} step {i}/{steps}",
+        )

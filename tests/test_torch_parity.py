@@ -1,0 +1,152 @@
+"""Per-op torch parity: converted weights + JAX ops vs torch modules.
+
+The HF->JAX converter (models/weights.py) transposes every kernel; a wrong
+axis order produces images that are garbage yet shape-correct, so random-
+weight smoke tests cannot catch it.  These tests drive *diffusers-named*
+torch state_dicts through the real converter (`_convert` / `_fuse_kv`) and
+assert the JAX ops reproduce the torch ops bit-for-bit (fp32 tolerances) —
+the single-device ground truth the reference inherits from torch
+(/root/reference/distrifuser/modules/pp/conv2d.py, attn.py compute with
+F.conv2d / F.scaled_dot_product_attention on the same weights).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from distrifuser_tpu.models.unet import layer_norm
+from distrifuser_tpu.models.weights import _convert, _fuse_kv
+from distrifuser_tpu.ops.attention import attention, sdpa
+from distrifuser_tpu.ops.conv import conv2d
+from distrifuser_tpu.ops.linear import feed_forward, linear
+from distrifuser_tpu.ops.normalization import group_norm
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def _sd(module, prefix):
+    return {f"{prefix}.{k}": v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+def _assert_close(jax_out, torch_out):
+    np.testing.assert_allclose(
+        np.asarray(jax_out), torch_out.detach().numpy(), rtol=RTOL, atol=ATOL
+    )
+
+
+@pytest.mark.parametrize("k,stride,cin,cout", [(3, 1, 8, 16), (3, 2, 8, 16), (1, 1, 8, 4)])
+def test_conv2d_parity(k, stride, cin, cout):
+    torch.manual_seed(0)
+    m = torch.nn.Conv2d(cin, cout, k, stride=stride, padding=(k - 1) // 2)
+    p = _convert(_sd(m, "conv"))["conv"]
+    x = torch.randn(2, cin, 12, 16)
+    y_t = m(x)  # NCHW
+    y_j = conv2d(p, np.asarray(x.permute(0, 2, 3, 1)), stride=stride)
+    _assert_close(np.moveaxis(np.asarray(y_j), 3, 1), y_t)
+
+
+def test_linear_parity():
+    torch.manual_seed(1)
+    m = torch.nn.Linear(24, 40)
+    p = _convert(_sd(m, "lin"))["lin"]
+    x = torch.randn(3, 7, 24)
+    _assert_close(linear(p, np.asarray(x)), m(x))
+
+
+def test_group_norm_parity():
+    torch.manual_seed(2)
+    m = torch.nn.GroupNorm(8, 32)
+    with torch.no_grad():  # non-trivial affine
+        m.weight.mul_(torch.randn(32) * 0.2 + 1.0)
+        m.bias.add_(torch.randn(32) * 0.3)
+    p = _convert(_sd(m, "gn"))["gn"]
+    x = torch.randn(2, 32, 6, 10)
+    y_j = group_norm(p, np.asarray(x.permute(0, 2, 3, 1)), groups=8)
+    _assert_close(np.moveaxis(np.asarray(y_j), 3, 1), m(x))
+
+
+def test_layer_norm_parity():
+    torch.manual_seed(3)
+    m = torch.nn.LayerNorm(48)
+    with torch.no_grad():
+        m.weight.mul_(torch.randn(48) * 0.2 + 1.0)
+        m.bias.add_(torch.randn(48) * 0.3)
+    p = _convert(_sd(m, "ln"))["ln"]
+    x = torch.randn(2, 9, 48)
+    _assert_close(layer_norm(p, np.asarray(x)), m(x))
+
+
+@pytest.mark.parametrize("heads,lq,lk", [(4, 33, 33), (8, 16, 77)])
+def test_sdpa_parity(heads, lq, lk):
+    torch.manual_seed(4)
+    b, d = 2, 16
+    c = heads * d
+    q = torch.randn(b, lq, c)
+    kk = torch.randn(b, lk, c)
+    v = torch.randn(b, lk, c)
+
+    def split(t, l):  # [B, L, C] -> [B, H, L, D], torch head convention
+        return t.view(b, l, heads, d).transpose(1, 2)
+
+    y_t = (
+        F.scaled_dot_product_attention(split(q, lq), split(kk, lk), split(v, lk))
+        .transpose(1, 2)
+        .reshape(b, lq, c)
+    )
+    y_j = sdpa(np.asarray(q), np.asarray(kk), np.asarray(v), heads=heads)
+    _assert_close(y_j, y_t)
+
+
+@pytest.mark.parametrize("cross", [False, True])
+def test_attention_block_parity_fused_kv(cross):
+    """Full attention block through the converter, incl. the to_k/to_v ->
+    to_kv fusion (split_kv must un-interleave in the same order)."""
+    torch.manual_seed(5)
+    b, l, heads, d = 2, 24, 4, 8
+    c = heads * d
+    c_enc = 20 if cross else c
+    to_q = torch.nn.Linear(c, c, bias=False)
+    to_k = torch.nn.Linear(c_enc, c, bias=False)
+    to_v = torch.nn.Linear(c_enc, c, bias=False)
+    to_out = torch.nn.Linear(c, c)
+
+    sd = {}
+    for name, m in [("to_q", to_q), ("to_k", to_k), ("to_v", to_v)]:
+        sd.update(_sd(m, f"attn.{name}"))
+    sd.update(_sd(to_out, "attn.to_out.0"))  # diffusers ModuleList naming
+    p = _fuse_kv(_convert(sd))["attn"]
+    assert "to_kv" in p and "to_k" not in p
+
+    x = torch.randn(b, l, c)
+    enc = torch.randn(b, 11, c_enc) if cross else x
+
+    def split(t):
+        return t.view(b, -1, heads, d).transpose(1, 2)
+
+    y_t = to_out(
+        F.scaled_dot_product_attention(split(to_q(x)), split(to_k(enc)), split(to_v(enc)))
+        .transpose(1, 2)
+        .reshape(b, l, c)
+    )
+    y_j = attention(
+        p, np.asarray(x), heads=heads,
+        encoder_hidden_states=np.asarray(enc) if cross else None,
+    )
+    _assert_close(y_j, y_t)
+
+
+def test_feed_forward_geglu_parity():
+    """diffusers FeedForward(GEGLU): net.0.proj -> chunk -> a*gelu(g) -> net.2."""
+    torch.manual_seed(6)
+    c, inner = 16, 64
+    proj = torch.nn.Linear(c, inner * 2)
+    out = torch.nn.Linear(inner, c)
+    sd = {**_sd(proj, "ff.net.0.proj"), **_sd(out, "ff.net.2")}
+    p = _convert(sd)["ff"]
+    assert "net_0" in p and "net_2" in p  # renamed, digit keys not listified
+
+    x = torch.randn(2, 9, c)
+    a, g = proj(x).chunk(2, dim=-1)
+    y_t = out(a * F.gelu(g))
+    _assert_close(feed_forward(p, np.asarray(x)), y_t)
