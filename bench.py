@@ -26,20 +26,38 @@ import time
 A100_SDXL_1024_50STEP_S = 6.6
 
 
-def _arm_watchdog(seconds: float):
-    """Emit a parseable failure line and exit if the TPU runtime wedges.
+_RETRY_FLAG = "--_watchdog_retried"
 
-    The axon chip lease can hang backend init indefinitely after an earlier
-    client died mid-run (observed 2026-07-28); a silent hang gives the driver
-    nothing, an explicit line documents what happened.  Returns a disarm
-    callback — the hazard is init/first-compile hang, not long measurements,
-    so the caller disarms after the warmup run completes.
+
+def _arm_watchdog(seconds: float):
+    """Retry once, then emit a parseable failure line, if the runtime wedges.
+
+    The axon chip lease can hang backend init for ~40 min after an earlier
+    client died mid-run (observed 2026-07-28/29); a silent hang gives the
+    driver nothing.  On first fire the process re-execs itself (a fresh
+    process re-attempts backend init — the lease may have expired by then);
+    on second fire it emits an explicit bench_watchdog_timeout line.  Returns
+    a disarm callback — the hazard is init/first-compile hang, not long
+    measurements, so the caller disarms after the warmup run completes.
     """
     _disarmed = threading.Event()
 
     def fire():
         if _disarmed.wait(seconds):
             return
+        if _RETRY_FLAG not in sys.argv:
+            print(f"bench watchdog fired after {seconds}s; re-execing for one "
+                  "retry (chip lease may have expired)", file=sys.stderr,
+                  flush=True)
+            try:
+                os.execv(sys.executable,
+                         [sys.executable, os.path.abspath(__file__),
+                          *sys.argv[1:], _RETRY_FLAG])
+            except OSError as e:
+                # exec failed: fall through to the explicit timeout line
+                # rather than dying silently in this daemon thread
+                print(f"watchdog re-exec failed ({e}); giving up",
+                      file=sys.stderr, flush=True)
         print(json.dumps({
             "metric": "bench_watchdog_timeout",
             "value": -1.0,
@@ -62,9 +80,16 @@ def main():
     parser.add_argument("--preset", type=str, default=None,
                         choices=[None, "sdxl", "tiny"], nargs="?")
     parser.add_argument("--watchdog_s", type=float, default=1500.0)
+    parser.add_argument(_RETRY_FLAG, action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
     disarm_watchdog = _arm_watchdog(args.watchdog_s)
 
+    # persistent compilation cache: a watchdog-retry (or a repeated bench run)
+    # skips the multi-minute 50-step SDXL compile
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
     import jax
     import jax.numpy as jnp
 

@@ -78,12 +78,20 @@ def sdpa(q, k, v, *, heads: int):
     2048x2048, where materializing L^2 logits cannot fit).
     """
     if _flash_eligible(q, k, heads):
-        from .flash_attention import flash_sdpa
+        from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_sdpa
 
         # Forcing via env on a non-TPU backend means interpret mode (tests):
         # Mosaic kernels only compile for TPU.
         interpret = jax.devices()[0].platform == "cpu"
-        return flash_sdpa(q, k, v, heads=heads, interpret=interpret)
+        # block sizes tunable per chip without code changes (scripts/tune_flash.py)
+        bq = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BQ", DEFAULT_BLOCK_Q))
+        bk = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BK", DEFAULT_BLOCK_K))
+        lq, lk = q.shape[1], k.shape[1]
+        bq = bq if lq % bq == 0 else DEFAULT_BLOCK_Q
+        bk = bk if lk % bk == 0 else DEFAULT_BLOCK_K
+        return flash_sdpa(
+            q, k, v, heads=heads, block_q=bq, block_k=bk, interpret=interpret
+        )
     b, lq, c = q.shape
     lk = k.shape[1]
     d = c // heads

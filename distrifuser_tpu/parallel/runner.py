@@ -491,6 +491,43 @@ class DenoiseRunner:
     # public API
     # ------------------------------------------------------------------
 
+    def compiled_hlo(self, num_inference_steps: int = 4, batch_size: int = None,
+                     text_len: int = 77) -> str:
+        """Optimized-HLO text of the fused loop (abstract inputs, no device
+        execution beyond compilation).  Feed to utils/overlap.py to verify
+        the refresh collectives stay carry-only on this backend."""
+        cfg = self.cfg
+        b = cfg.batch_size if batch_size is None else batch_size
+        if b % cfg.dp_degree != 0:
+            raise ValueError(
+                f"batch_size {b} not divisible by dp_degree {cfg.dp_degree}"
+            )
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        lat = jax.ShapeDtypeStruct(
+            (b, cfg.latent_height, cfg.latent_width, self.ucfg.in_channels),
+            jnp.float32,
+        )
+        enc = jax.ShapeDtypeStruct(
+            (n_br, b, text_len, self.ucfg.cross_attention_dim), cfg.dtype
+        )
+        added = None
+        if self.ucfg.addition_embed_type == "text_time":
+            emb = (
+                self.ucfg.projection_class_embeddings_input_dim
+                - 6 * self.ucfg.addition_time_embed_dim
+            )
+            added = {
+                "text_embeds": jax.ShapeDtypeStruct((n_br, b, emb), cfg.dtype),
+                "time_ids": jax.ShapeDtypeStruct((n_br, b, 6), jnp.float32),
+            }
+        gs = jax.ShapeDtypeStruct((), jnp.float32)
+        # seed the jit cache: a following generate() with the same step count
+        # reuses this program instead of re-compiling (jit caches by shape)
+        fn = self._compiled.setdefault(
+            num_inference_steps, self._build(num_inference_steps)
+        )
+        return fn.lower(self.params, lat, enc, added, gs).compile().as_text()
+
     def generate(
         self,
         latents,

@@ -1,0 +1,276 @@
+"""Native image-quality metrics: PSNR, LPIPS (AlexNet), FID.
+
+The reference computes PSNR via torchmetrics, LPIPS via the `lpips` package
+and FID via `cleanfid` (/root/reference/scripts/compute_metrics.py:62-79) —
+all of which download pretrained weights at first use.  This box has zero
+egress, so the metrics are implemented natively here and the *weights* are
+the only pluggable piece:
+
+* PSNR — pure numpy, no weights.
+* LPIPS — the Zhang et al. (arXiv:1801.03924) metric with the AlexNet trunk
+  written out in torch (no torchvision dependency).  `lpips_weights` is a
+  state-dict file holding the torchvision-AlexNet `features.*` tensors plus
+  the LPIPS `lin{0..4}` 1x1 heads (the official `alex.pth` merged with the
+  backbone; see `LPIPS_EXPECTED_KEYS`).
+* FID — Fréchet distance between InceptionV3-pool3 feature Gaussians
+  (Heusel et al., arXiv:1706.08500).  `fid_extractor` is any callable
+  mapping uint8 RGB [N,H,W,3] -> features [N,D]; `load_fid_extractor` wraps
+  a TorchScript file (the standard `pt_inception-2015-12-05` export used by
+  pytorch-fid works offline).
+
+The *math* (normalization, Fréchet distance incl. the sqrtm branch cuts,
+feature statistics) is fully tested with random weights; only the numbers'
+comparability to published tables depends on the pretrained files.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# PSNR
+# --------------------------------------------------------------------------
+
+
+def psnr(a: np.ndarray, b: np.ndarray, data_range: float = 1.0) -> float:
+    """Peak signal-to-noise ratio between same-shape float images."""
+    mse = float(np.mean((np.asarray(a, np.float64) - np.asarray(b, np.float64)) ** 2))
+    return 10.0 * float(np.log10(data_range**2 / max(mse, 1e-12)))
+
+
+# --------------------------------------------------------------------------
+# LPIPS (AlexNet trunk, torch; no torchvision)
+# --------------------------------------------------------------------------
+
+# (out_ch, in_ch, kernel, stride, pad, maxpool_after)
+_ALEX_CONVS = (
+    (64, 3, 11, 4, 2, True),
+    (192, 64, 5, 1, 2, True),
+    (384, 192, 3, 1, 1, False),
+    (256, 384, 3, 1, 1, False),
+    (256, 256, 3, 1, 1, False),
+)
+# torchvision AlexNet state-dict indices of the conv layers in `features`
+_ALEX_IDX = (0, 3, 6, 8, 10)
+
+LPIPS_EXPECTED_KEYS = tuple(
+    [f"features.{i}.{p}" for i in _ALEX_IDX for p in ("weight", "bias")]
+    + [f"lin{i}.model.1.weight" for i in range(5)]
+)
+
+# LPIPS input scaling layer (inputs in [-1, 1])
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+
+class LPIPS:
+    """Learned Perceptual Image Patch Similarity, AlexNet variant.
+
+    ``state`` maps LPIPS_EXPECTED_KEYS to arrays (torch or numpy).  Use
+    `LPIPS.from_file(path)` for a merged offline checkpoint, or
+    `LPIPS.random(seed)` for math-level tests.
+    """
+
+    def __init__(self, state: Dict[str, np.ndarray]):
+        import torch
+
+        missing = [k for k in LPIPS_EXPECTED_KEYS if k not in state]
+        if missing:
+            raise KeyError(f"LPIPS state dict missing {missing[:4]}...")
+        self._t = torch
+        self._convs = []
+        for i in _ALEX_IDX:
+            w = torch.as_tensor(np.asarray(state[f"features.{i}.weight"]), dtype=torch.float32)
+            b = torch.as_tensor(np.asarray(state[f"features.{i}.bias"]), dtype=torch.float32)
+            self._convs.append((w, b))
+        self._lins = [
+            torch.as_tensor(np.asarray(state[f"lin{i}.model.1.weight"]), dtype=torch.float32)
+            for i in range(5)
+        ]
+        self._shift = torch.tensor(_SHIFT, dtype=torch.float32).view(1, 3, 1, 1)
+        self._scale = torch.tensor(_SCALE, dtype=torch.float32).view(1, 3, 1, 1)
+
+    @classmethod
+    def from_file(cls, path: str) -> "LPIPS":
+        import torch
+
+        state = torch.load(path, map_location="cpu", weights_only=True)
+        return cls({k: v.numpy() for k, v in state.items()})
+
+    @classmethod
+    def random(cls, seed: int = 0) -> "LPIPS":
+        r = np.random.RandomState(seed)
+        state: Dict[str, np.ndarray] = {}
+        for i, (co, ci, k, _, _, _) in zip(_ALEX_IDX, _ALEX_CONVS):
+            state[f"features.{i}.weight"] = r.randn(co, ci, k, k).astype(np.float32) * 0.05
+            state[f"features.{i}.bias"] = np.zeros(co, np.float32)
+        for i, (co, _, _, _, _, _) in enumerate(_ALEX_CONVS):
+            state[f"lin{i}.model.1.weight"] = np.abs(
+                r.randn(1, co, 1, 1).astype(np.float32)
+            )
+        return cls(state)
+
+    def _features(self, x):
+        t, F = self._t, self._t.nn.functional
+        x = (x - self._shift) / self._scale
+        feats = []
+        for (w, b), (_, _, _, stride, pad, pool) in zip(self._convs, _ALEX_CONVS):
+            x = F.relu(F.conv2d(x, w, b, stride=stride, padding=pad))
+            feats.append(x)
+            if pool:
+                x = F.max_pool2d(x, kernel_size=3, stride=2)
+        return feats
+
+    def __call__(self, a: np.ndarray, b: np.ndarray) -> float:
+        """Images as float RGB [H,W,3] (or [N,H,W,3]) in [0,1]."""
+        t, F = self._t, self._t.nn.functional
+        with t.no_grad():
+            ta = self._to_input(a)
+            tb = self._to_input(b)
+            total = t.zeros(ta.shape[0])
+            for fa, fb, lin in zip(self._features(ta), self._features(tb), self._lins):
+                na = fa / fa.norm(dim=1, keepdim=True).clamp_min(1e-10)
+                nb = fb / fb.norm(dim=1, keepdim=True).clamp_min(1e-10)
+                d = (na - nb) ** 2
+                total = total + F.conv2d(d, lin).mean(dim=(1, 2, 3))
+            return float(total.mean())
+
+    def _to_input(self, img: np.ndarray):
+        t = self._t
+        x = np.asarray(img, np.float32)
+        if x.ndim == 3:
+            x = x[None]
+        x = x * 2.0 - 1.0  # [0,1] -> [-1,1]
+        return t.as_tensor(x).permute(0, 3, 1, 2)
+
+
+# --------------------------------------------------------------------------
+# FID
+# --------------------------------------------------------------------------
+
+
+def feature_statistics(features: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(mu, sigma) of a [N, D] feature matrix (rowvar-free covariance)."""
+    f = np.asarray(features, np.float64)
+    mu = f.mean(axis=0)
+    sigma = np.cov(f, rowvar=False)
+    return mu, np.atleast_2d(sigma)
+
+
+class RunningStatistics:
+    """Streaming (mu, sigma) accumulator — feature batches in, Gaussian out.
+
+    FID over the reference workload (5k-30k COCO images, generate_coco.py)
+    cannot hold all images in memory at once; only the [D] sum and [D, D]
+    outer-product sum persist between batches."""
+
+    def __init__(self):
+        self.n = 0
+        self._sum = None
+        self._outer = None
+
+    def update(self, features: np.ndarray) -> None:
+        f = np.asarray(features, np.float64)
+        if self._sum is None:
+            self._sum = np.zeros(f.shape[1])
+            self._outer = np.zeros((f.shape[1], f.shape[1]))
+        self.n += f.shape[0]
+        self._sum += f.sum(axis=0)
+        self._outer += f.T @ f
+
+    def finalize(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self.n < 2:
+            raise ValueError("need at least 2 samples for covariance")
+        mu = self._sum / self.n
+        # unbiased covariance, matching np.cov
+        sigma = (self._outer - self.n * np.outer(mu, mu)) / (self.n - 1)
+        return mu, sigma
+
+
+def frechet_distance(
+    mu1: np.ndarray, sigma1: np.ndarray, mu2: np.ndarray, sigma2: np.ndarray,
+    eps: float = 1e-6,
+) -> float:
+    """||mu1-mu2||^2 + tr(s1 + s2 - 2 sqrt(s1 s2)) with the standard
+    numerical guards (arXiv:1706.08500 eq. 6; complex residue dropped)."""
+    from scipy import linalg
+
+    diff = np.asarray(mu1, np.float64) - np.asarray(mu2, np.float64)
+    covmean, _ = linalg.sqrtm(sigma1 @ sigma2, disp=False)
+    if not np.isfinite(covmean).all():
+        offset = np.eye(sigma1.shape[0]) * eps
+        covmean = linalg.sqrtm((sigma1 + offset) @ (sigma2 + offset))
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return float(diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2 * np.trace(covmean))
+
+
+def fid_from_features(f0: np.ndarray, f1: np.ndarray) -> float:
+    return frechet_distance(*feature_statistics(f0), *feature_statistics(f1))
+
+
+def load_fid_extractor(path: str, batch: int = 32) -> Callable[[np.ndarray], np.ndarray]:
+    """Wrap a TorchScript feature extractor file: uint8 RGB [N,H,W,3] -> [N,D].
+
+    The standard offline artifact is pytorch-fid's `pt_inception-2015-12-05`
+    TorchScript export (maps [N,3,299,299] in [0,1]-scaled float to pool3
+    features); any module with that contract works.
+    """
+    import torch
+
+    mod = torch.jit.load(path, map_location="cpu").eval()
+
+    def extract(imgs: np.ndarray) -> np.ndarray:
+        outs = []
+        with torch.no_grad():
+            for i in range(0, len(imgs), batch):
+                x = torch.as_tensor(
+                    np.asarray(imgs[i : i + batch], np.float32) / 255.0
+                ).permute(0, 3, 1, 2)
+                if x.shape[-2:] != (299, 299):
+                    x = torch.nn.functional.interpolate(
+                        x, size=(299, 299), mode="bilinear", align_corners=False
+                    )
+                y = mod(x)
+                if isinstance(y, (list, tuple)):
+                    y = y[0]
+                outs.append(np.asarray(y.reshape(y.shape[0], -1)))
+        return np.concatenate(outs, axis=0)
+
+    return extract
+
+
+def fid_between_dirs(
+    root0: str,
+    root1: str,
+    extractor: Callable[[np.ndarray], np.ndarray],
+    batch: int = 32,
+) -> float:
+    """FID between all images of two directories (reference cleanfid call,
+    compute_metrics.py:79).  Streams images batch-by-batch — the 5k+ COCO
+    result dirs never sit in memory whole; mixed image sizes within a
+    directory fall back to one-image batches (the extractor resizes)."""
+    import os
+
+    from PIL import Image
+
+    def dir_stats(root):
+        names = sorted(
+            f for f in os.listdir(root) if f.lower().endswith((".png", ".jpg"))
+        )
+        stats = RunningStatistics()
+        for i in range(0, len(names), batch):
+            imgs = [
+                np.asarray(Image.open(os.path.join(root, n)).convert("RGB"))
+                for n in names[i : i + batch]
+            ]
+            if len({im.shape for im in imgs}) == 1:
+                stats.update(extractor(np.stack(imgs)))
+            else:
+                for im in imgs:
+                    stats.update(extractor(im[None]))
+        return stats.finalize()
+
+    return frechet_distance(*dir_stats(root0), *dir_stats(root1))

@@ -1,18 +1,36 @@
 """Pairwise image-quality metrics between two result directories
 (parity: /root/reference/scripts/compute_metrics.py).
 
-PSNR is computed natively (no extra deps).  LPIPS (pretrained AlexNet/VGG)
-and FID (pretrained InceptionV3) need weights this zero-egress box cannot
-fetch; they run when `lpips` / `cleanfid` + their caches are present and are
-reported as unavailable otherwise — same metrics surface as the reference
-(compute_metrics.py:62-79), degraded gracefully.
+All three reference metrics are computed **natively**
+(distrifuser_tpu/utils/metrics.py): PSNR needs no weights; LPIPS and FID
+take offline pretrained-weight files via `--lpips_weights` (merged
+AlexNet+LPIPS state dict) and `--fid_weights` (TorchScript InceptionV3,
+e.g. pytorch-fid's pt_inception export) since this zero-egress box cannot
+download them.  Without the files they are reported unavailable — loudly,
+with the flag to pass.
 """
 
 import argparse
+import importlib.util
 import os
 
 import numpy as np
 from PIL import Image
+
+# Load metrics.py by file path: going through the distrifuser_tpu package
+# would import jax, which an offline metrics box (numpy/PIL/torch only, the
+# reference's compute_metrics environment) need not have.
+_spec = importlib.util.spec_from_file_location(
+    "_distrifuser_metrics",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "distrifuser_tpu", "utils", "metrics.py"),
+)
+_metrics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_metrics)
+LPIPS = _metrics.LPIPS
+fid_between_dirs = _metrics.fid_between_dirs
+load_fid_extractor = _metrics.load_fid_extractor
+psnr = _metrics.psnr
 
 
 class MultiImageDataset:
@@ -22,7 +40,6 @@ class MultiImageDataset:
     def __init__(self, root0: str, root1: str, is_gt: bool = False):
         self.roots = [root0, root1]
         self.is_gt = is_gt
-        self.names = []
         names0 = {f for f in os.listdir(root0) if f.lower().endswith((".png", ".jpg"))}
         names1 = {f for f in os.listdir(root1) if f.lower().endswith((".png", ".jpg"))}
         self.names = sorted(names0 & names1)
@@ -44,45 +61,37 @@ class MultiImageDataset:
         return imgs
 
 
-def psnr(a: np.ndarray, b: np.ndarray) -> float:
-    mse = float(np.mean((a - b) ** 2))
-    return 10 * np.log10(1.0 / max(mse, 1e-12))
-
-
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--input_root0", type=str, required=True)
     parser.add_argument("--input_root1", type=str, required=True)
     parser.add_argument("--is_gt", action="store_true")
     parser.add_argument("--batch_size", type=int, default=64)  # parity flag
+    parser.add_argument("--lpips_weights", type=str, default=None,
+                        help="offline merged AlexNet+LPIPS state-dict file")
+    parser.add_argument("--fid_weights", type=str, default=None,
+                        help="offline TorchScript InceptionV3 feature extractor")
     args = parser.parse_args()
 
     ds = MultiImageDataset(args.input_root0, args.input_root1, is_gt=args.is_gt)
     psnrs = [psnr(*ds[i]) for i in range(len(ds))]
     print(f"PSNR: {np.mean(psnrs):.4f} dB over {len(ds)} pairs")
 
-    try:
-        import lpips  # type: ignore
-        import torch
-
-        net = lpips.LPIPS(net="alex")
-        vals = []
-        for i in range(len(ds)):
-            a, b = ds[i]
-            ta = torch.tensor(a * 2 - 1, dtype=torch.float32).permute(2, 0, 1)[None]
-            tb = torch.tensor(b * 2 - 1, dtype=torch.float32).permute(2, 0, 1)[None]
-            vals.append(float(net(ta, tb)))
+    if args.lpips_weights:
+        net = LPIPS.from_file(args.lpips_weights)
+        vals = [net(*ds[i]) for i in range(len(ds))]
         print(f"LPIPS: {np.mean(vals):.4f}")
-    except Exception as e:
-        print(f"LPIPS: unavailable ({type(e).__name__}: pretrained weights need network)")
+    else:
+        print("LPIPS: unavailable (pass --lpips_weights <alexnet+lpips state dict>)")
 
-    try:
-        from cleanfid import fid  # type: ignore
-
-        score = fid.compute_fid(args.input_root0, args.input_root1)
+    if args.fid_weights:
+        score = fid_between_dirs(
+            args.input_root0, args.input_root1,
+            load_fid_extractor(args.fid_weights, batch=args.batch_size),
+        )
         print(f"FID: {score:.4f}")
-    except Exception as e:
-        print(f"FID: unavailable ({type(e).__name__}: pretrained weights need network)")
+    else:
+        print("FID: unavailable (pass --fid_weights <TorchScript InceptionV3>)")
 
 
 if __name__ == "__main__":

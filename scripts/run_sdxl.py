@@ -31,6 +31,9 @@ def get_args() -> argparse.Namespace:
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="capture a jax.profiler trace of one generation "
                         "into this directory (tensorboard format)")
+    parser.add_argument("--dump_hlo", type=str, default=None,
+                        help="write the fused loop's optimized HLO here and "
+                        "print the comm/compute overlap report")
     return parser.parse_args()
 
 
@@ -49,6 +52,19 @@ def main():
             output_type=args.output_type,
         )
 
+    if args.dump_hlo:
+        from distrifuser_tpu.utils.overlap import (
+            analyze_loop_collectives,
+            format_report,
+        )
+
+        hlo = pipeline.runner.compiled_hlo(args.num_inference_steps)
+        with open(args.dump_hlo, "w") as f:
+            f.write(hlo)
+        if is_main_process():
+            print(f"HLO written to {args.dump_hlo}")
+            print(format_report(analyze_loop_collectives(hlo)))
+
     if args.profile_dir:
         run(args.seed)  # compile outside the trace
         with jax.profiler.trace(args.profile_dir):
@@ -66,15 +82,16 @@ def main():
     # benchmark (reference run_sdxl.py:124-153)
     for _ in range(args.warmup_times):
         out = run(args.seed)
-        jax.block_until_ready(out.images[0]) if args.output_type == "latent" else None
+        jax.block_until_ready(out.images)
 
     latencies = []
     for i in range(args.test_times):
         t0 = time.perf_counter()
         out = run(args.seed + i)
-        # device sync (the reference's torch.cuda.synchronize)
-        if args.output_type == "latent":
-            jax.block_until_ready(out.images[0])
+        # device sync (the reference's torch.cuda.synchronize); unconditional —
+        # both output types materialize on host, but the timing protocol must
+        # not depend on that implementation detail
+        jax.block_until_ready(out.images)
         latencies.append(time.perf_counter() - t0)
 
     latencies.sort()

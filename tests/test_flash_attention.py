@@ -64,6 +64,21 @@ def test_routing_gates():
         del os.environ["DISTRIFUSER_TPU_FLASH"]
 
 
+def test_forced_flash_on_cpu_uses_interpret(monkeypatch):
+    """DISTRIFUSER_TPU_FLASH=1 on a CPU backend must route sdpa through the
+    interpret-mode kernel (Mosaic only compiles for TPU) and match XLA."""
+    b, l, heads, d = 1, 128, 2, 16
+    c = heads * d
+    keys = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(keys[0], (b, l, c))
+    k = jax.random.normal(keys[1], (b, l, c))
+    v = jax.random.normal(keys[2], (b, l, c))
+    plain = sdpa(q, k, v, heads=heads)
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "1")
+    forced = sdpa(q, k, v, heads=heads)
+    np.testing.assert_allclose(np.asarray(forced), np.asarray(plain), atol=2e-5)
+
+
 def test_chunked_sdpa_matches_direct(monkeypatch):
     """Query chunking must be numerically identical to the direct path."""
     import importlib

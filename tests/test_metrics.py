@@ -1,0 +1,137 @@
+"""Native metric implementations (utils/metrics.py): math-level validation.
+
+Pretrained weights cannot exist on this box, so LPIPS/FID are validated at
+the level the weights don't touch: metric identities (zero at identical
+inputs, symmetry, positivity), the closed-form Fréchet distance between
+known Gaussians, and the end-to-end directory flow with a random-weight
+extractor.  Reference surface: scripts/compute_metrics.py (reference
+computes the same three metrics, compute_metrics.py:62-79).
+"""
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.utils.metrics import (
+    LPIPS,
+    feature_statistics,
+    fid_from_features,
+    frechet_distance,
+    psnr,
+)
+
+
+def test_psnr_basics():
+    r = np.random.RandomState(0)
+    a = r.rand(16, 16, 3)
+    assert psnr(a, a) >= 120.0  # mse floor -> 120 dB
+    noisy = np.clip(a + 0.1 * r.randn(*a.shape), 0, 1)
+    assert 10 < psnr(a, noisy) < 30
+    # scaling the error down raises PSNR
+    less_noisy = a + 0.5 * (noisy - a)
+    assert psnr(a, less_noisy) > psnr(a, noisy)
+
+
+def test_frechet_distance_closed_form():
+    # identical Gaussians -> 0
+    mu = np.array([1.0, -2.0])
+    sig = np.array([[2.0, 0.3], [0.3, 1.0]])
+    assert frechet_distance(mu, sig, mu, sig) == pytest.approx(0.0, abs=1e-8)
+    # diagonal case: d = |mu1-mu2|^2 + sum((sqrt(s1)-sqrt(s2))^2)
+    mu1, mu2 = np.array([0.0, 0.0]), np.array([3.0, 4.0])
+    s1 = np.diag([4.0, 9.0])
+    s2 = np.diag([1.0, 16.0])
+    expect = 25.0 + (2 - 1) ** 2 + (3 - 4) ** 2
+    assert frechet_distance(mu1, s1, mu2, s2) == pytest.approx(expect, rel=1e-9)
+
+
+def test_fid_from_features_behaviour():
+    r = np.random.RandomState(1)
+    f0 = r.randn(500, 8)
+    f1 = r.randn(500, 8)
+    same_dist = fid_from_features(f0, f1)  # same distribution: near 0
+    shifted = fid_from_features(f0, f1 + 3.0)  # mean shift of 3 in 8 dims
+    assert same_dist < 1.0
+    assert shifted == pytest.approx(8 * 9.0, rel=0.2)
+    assert shifted > same_dist
+
+
+def test_feature_statistics_shapes():
+    f = np.random.RandomState(2).randn(10, 5)
+    mu, sig = feature_statistics(f)
+    assert mu.shape == (5,) and sig.shape == (5, 5)
+    np.testing.assert_allclose(sig, sig.T)
+
+
+def test_lpips_metric_identities():
+    net = LPIPS.random(seed=0)
+    r = np.random.RandomState(3)
+    a = r.rand(64, 64, 3)
+    b = r.rand(64, 64, 3)
+    assert net(a, a) == pytest.approx(0.0, abs=1e-9)
+    d_ab, d_ba = net(a, b), net(b, a)
+    assert d_ab > 0
+    assert d_ab == pytest.approx(d_ba, rel=1e-6)
+    # a small perturbation scores closer than an unrelated image
+    near = np.clip(a + 0.02 * r.randn(*a.shape), 0, 1)
+    assert net(a, near) < d_ab
+
+
+def test_lpips_rejects_incomplete_state():
+    with pytest.raises(KeyError, match="missing"):
+        LPIPS({"features.0.weight": np.zeros((64, 3, 11, 11), np.float32)})
+
+
+def test_running_statistics_matches_batch():
+    from distrifuser_tpu.utils.metrics import RunningStatistics
+
+    r = np.random.RandomState(5)
+    f = r.randn(100, 6)
+    stats = RunningStatistics()
+    for i in range(0, 100, 7):  # uneven batches
+        stats.update(f[i : i + 7])
+    mu_s, sig_s = stats.finalize()
+    mu_b, sig_b = feature_statistics(f)
+    np.testing.assert_allclose(mu_s, mu_b, rtol=1e-10)
+    np.testing.assert_allclose(sig_s, sig_b, rtol=1e-8, atol=1e-12)
+
+
+def test_fid_between_dirs_mixed_sizes(tmp_path):
+    """Dirs with differing image sizes must stream without np.stack errors."""
+    from PIL import Image
+
+    from distrifuser_tpu.utils.metrics import fid_between_dirs
+
+    r = np.random.RandomState(6)
+    d0, d1 = tmp_path / "a", tmp_path / "b"
+    d0.mkdir(), d1.mkdir()
+    for i, size in enumerate([24, 32, 24, 32]):
+        img = (r.rand(size, size, 3) * 255).astype(np.uint8)
+        Image.fromarray(img).save(d0 / f"{i}.png")
+        Image.fromarray(img).save(d1 / f"{i}.png")
+
+    def extractor(imgs):  # size-insensitive features: channel means
+        return imgs.reshape(len(imgs), -1, 3).mean(axis=1).astype(np.float64)
+
+    assert fid_between_dirs(str(d0), str(d1), extractor, batch=3) == pytest.approx(
+        0.0, abs=1e-9
+    )
+
+
+def test_fid_between_dirs_roundtrip(tmp_path):
+    from PIL import Image
+
+    from distrifuser_tpu.utils.metrics import fid_between_dirs
+
+    r = np.random.RandomState(4)
+    d0, d1 = tmp_path / "a", tmp_path / "b"
+    d0.mkdir(), d1.mkdir()
+    for i in range(6):
+        img = (r.rand(32, 32, 3) * 255).astype(np.uint8)
+        Image.fromarray(img).save(d0 / f"{i}.png")
+        Image.fromarray(img).save(d1 / f"{i}.png")  # identical copies
+
+    def extractor(imgs):  # random projection features
+        rp = np.random.RandomState(0).randn(32 * 32 * 3, 4)
+        return imgs.reshape(len(imgs), -1).astype(np.float64) @ rp
+
+    assert fid_between_dirs(str(d0), str(d1), extractor) == pytest.approx(0.0, abs=1e-6)
