@@ -159,7 +159,7 @@ def patch_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
         gathered = lax.dynamic_update_index_in_dim(gathered, kv, ctx.split_idx(), 0)
         full_kv = _flatten_seq(gathered)
         if ctx.refresh:
-            ctx.emit(name, lax.all_gather(kv, ctx.axis))
+            ctx.emit_refresh_gather(name, kv)
     k, v = split_kv(full_kv)
     return linear(p["to_out"], sdpa(q, k, v, heads=heads))
 

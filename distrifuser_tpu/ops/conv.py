@@ -106,7 +106,6 @@ def patch_conv2d(p, x, ctx: PatchContext, name: str, *, stride: int = 1):
         halos = ctx.stale(name)  # [2, B, ph, W, C] from the previous step
         top, bottom = halos[0], halos[1]
         if ctx.refresh:
-            f_top, f_bottom = halo_exchange(x, ph, ctx.n, ctx.axis)
-            ctx.emit(name, jnp.stack([f_top, f_bottom]))
+            ctx.emit_refresh_halos(name, x, ph)
     padded = jnp.concatenate([top, x, bottom], axis=1)
     return _conv_valid_h(p, padded, stride, pw)

@@ -93,7 +93,7 @@ def patch_group_norm(
                 full = gathered.mean(axis=0) + (m - own_stale)
             else:  # stale_gn: stale peers + fresh self (groupnorm.py:52-55)
                 full = (gathered.sum(axis=0) - own_stale + m) / ctx.n
-            ctx.emit(name, lax.all_gather(m, ctx.axis))
+            ctx.emit_refresh_gather(name, m)
         var = full[1] - jnp.square(full[0])
         if ctx.mode == "corrected_async_gn":
             local_var = m[1] - jnp.square(m[0])

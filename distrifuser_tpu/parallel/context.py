@@ -31,9 +31,11 @@ the module path string (e.g. ``"down_blocks.1.attentions.0.transformer_blocks.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
+from jax import lax
 
 from ..utils.config import SP_AXIS
 
@@ -58,8 +60,19 @@ class PatchContext:
     phase: str  # PHASE_SYNC | PHASE_STALE (static per compilation)
     axis: str = SP_AXIS
     attn_impl: str = "gather"  # "gather" | "ring" (ops/ring_attention.py)
+    # Batch the stale-phase refresh collectives: defer every layer's fresh
+    # halo/KV/moment emission and run ONE flat ppermute pair + one all-gather
+    # per dtype at the end of the step (`flush()`), instead of ~60 small
+    # per-layer collectives.  The functional analog of the reference's
+    # `comm_checkpoint` buffer batching (utils.py:181-190).  Trade-off: fewer
+    # collective launches on ICI vs a narrower overlap window (the batched
+    # exchange can only start once the last layer has produced its rows).
+    batch_comm: bool = False
     state_in: Optional[Dict[str, Any]] = None
     state_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # deferred refresh emissions (batch_comm): name -> local tensor / rows
+    _def_gather: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    _def_halo: Dict[str, Tuple[Any, Any]] = dataclasses.field(default_factory=dict)
     # Precomputed text-encoder KV per cross-attention layer. The reference
     # caches these at counter==0 (modules/pp/attn.py:56,73-77); we compute
     # them once before the denoise loop.
@@ -97,3 +110,88 @@ class PatchContext:
         if name in self.state_out:
             raise ValueError(f"duplicate state emission for layer {name!r}")
         self.state_out[name] = value
+
+    # ------------------------------------------------------------------
+    # refresh emissions (stale phase): immediate or deferred-batched
+    # ------------------------------------------------------------------
+
+    def emit_refresh_gather(self, name: str, local: Any) -> None:
+        """Record `local` as this layer's next-step gathered state
+        ([n, *local.shape] after the all-gather) — immediately, or deferred
+        into the step-end batched exchange under ``batch_comm``."""
+        if self.batch_comm:
+            if name in self._def_gather or name in self.state_out:
+                raise ValueError(f"duplicate state emission for layer {name!r}")
+            self._def_gather[name] = local
+        else:
+            self.emit(name, lax.all_gather(local, self.axis))
+
+    def emit_refresh_halos(self, name: str, x: Any, halo: int) -> None:
+        """Record the fresh boundary rows of ``x`` [B, h, W, C] as this
+        layer's next-step halo state [2, B, halo, W, C] (stacked
+        from-prev/from-next, matching the sync-phase emission in
+        ops/conv.py)."""
+        if self.batch_comm:
+            if name in self._def_halo or name in self.state_out:
+                raise ValueError(f"duplicate state emission for layer {name!r}")
+            # x.shape[1]-halo (not -halo) so halo == 0 defers zero rows, the
+            # same empty halos halo_exchange returns on the unbatched path
+            self._def_halo[name] = (x[:, :halo], x[:, x.shape[1] - halo :])
+        else:
+            from .collectives import halo_exchange
+
+            top, bottom = halo_exchange(x, halo, self.n, self.axis)
+            self.emit(name, jnp.stack([top, bottom]))
+
+    def flush(self) -> None:
+        """Run the batched refresh exchanges deferred by ``batch_comm``.
+
+        One `lax.all_gather` per participating dtype carries every layer's
+        flattened KV/moment tensor; one non-wrapping `lax.ppermute` pair
+        carries every conv's boundary rows.  Results are split back to the
+        per-layer shapes the unbatched path would have produced, so the carry
+        pytree (and therefore numerics) is identical either way.  No-op when
+        nothing was deferred.
+        """
+        if self._def_gather:
+            by_dtype: Dict[Any, list] = {}
+            for name, t in self._def_gather.items():
+                by_dtype.setdefault(jnp.dtype(t.dtype), []).append((name, t))
+            for items in by_dtype.values():
+                flat = jnp.concatenate([t.reshape(-1) for _, t in items])
+                gathered = lax.all_gather(flat, self.axis)  # [n, total]
+                off = 0
+                for name, t in items:
+                    size = t.size
+                    self.state_out[name] = gathered[:, off : off + size].reshape(
+                        (gathered.shape[0],) + t.shape
+                    )
+                    off += size
+            self._def_gather.clear()
+        if self._def_halo:
+            down = [(i, i + 1) for i in range(self.n - 1)]  # send to next
+            up = [(i + 1, i) for i in range(self.n - 1)]  # send to previous
+            by_dtype = {}
+            for name, (top_rows, bottom_rows) in self._def_halo.items():
+                by_dtype.setdefault(jnp.dtype(top_rows.dtype), []).append(
+                    (name, top_rows, bottom_rows)
+                )
+            for items in by_dtype.values():
+                # my bottom rows -> next device's from-prev (top) halo;
+                # my top rows -> previous device's from-next (bottom) halo.
+                bottoms = jnp.concatenate([b.reshape(-1) for _, _, b in items])
+                tops = jnp.concatenate([t.reshape(-1) for _, t, _ in items])
+                from_prev = lax.ppermute(bottoms, self.axis, perm=down)
+                from_next = lax.ppermute(tops, self.axis, perm=up)
+                off = 0
+                for name, top_rows, _ in items:
+                    size = top_rows.size
+                    shape = top_rows.shape
+                    self.state_out[name] = jnp.stack(
+                        [
+                            from_prev[off : off + size].reshape(shape),
+                            from_next[off : off + size].reshape(shape),
+                        ]
+                    )
+                    off += size
+            self._def_halo.clear()

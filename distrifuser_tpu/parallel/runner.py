@@ -145,6 +145,7 @@ class DenoiseRunner:
                 mode=cfg.mode,
                 phase=phase,
                 attn_impl=cfg.attn_impl,
+                batch_comm=cfg.comm_batch,
                 state_in=pstate,
                 text_kv=text_kv,
             )
@@ -152,6 +153,7 @@ class DenoiseRunner:
                 params, ucfg, x_in, t, my_enc,
                 dispatch=PatchDispatch(ctx), added_cond=my_added,
             )
+            ctx.flush()  # batched refresh exchange (no-op unless comm_batch)
             out = gather_rows(out_local) if cfg.is_sp else out_local
             new_state = ctx.state_out if ctx.state_out else pstate
             return out, new_state

@@ -103,6 +103,13 @@ class DistriConfig:
     # around the sp axis with ppermute + online softmax, shrinking per-layer
     # state from O(L) to O(L/n) — the idiomatic TPU long-context path.
     attn_impl: str = "gather"
+    # Batch the stale-phase refresh collectives into one flat exchange per
+    # step (per collective kind) — the TPU-native analog of the reference's
+    # `comm_checkpoint` buffer batching (utils.py:181-190).  Off by default:
+    # per-layer deferred collectives give XLA's latency-hiding scheduler a
+    # wider overlap window; turn on if an ICI profile shows per-collective
+    # launch overhead dominating (~60 small collectives/step at 8-way).
+    comm_batch: bool = False
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)

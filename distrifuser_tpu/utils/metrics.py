@@ -198,7 +198,9 @@ def frechet_distance(
     from scipy import linalg
 
     diff = np.asarray(mu1, np.float64) - np.asarray(mu2, np.float64)
-    covmean, _ = linalg.sqrtm(sigma1 @ sigma2, disp=False)
+    # sqrtm's `disp` kwarg is deprecated (removal in scipy 1.18); singular
+    # products surface as non-finite entries, handled by the eps-offset retry
+    covmean = np.atleast_2d(linalg.sqrtm(sigma1 @ sigma2))
     if not np.isfinite(covmean).all():
         offset = np.eye(sigma1.shape[0]) * eps
         covmean = linalg.sqrtm((sigma1 + offset) @ (sigma2 + offset))

@@ -65,6 +65,9 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--attn_impl", type=str, default="gather",
                         choices=["gather", "ring"],
                         help="patch attention layout (ring: O(L/n) state)")
+    parser.add_argument("--comm_batch", action="store_true",
+                        help="batch stale-refresh collectives into one flat "
+                        "exchange per step (analog of comm_checkpoint batching)")
 
 
 def config_from_args(args) -> DistriConfig:
@@ -90,6 +93,7 @@ def config_from_args(args) -> DistriConfig:
         batch_size=args.batch_size,
         dp_degree=args.dp_degree,
         attn_impl=args.attn_impl,
+        comm_batch=args.comm_batch,
     )
 
 

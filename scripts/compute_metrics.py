@@ -88,6 +88,7 @@ def main():
         score = fid_between_dirs(
             args.input_root0, args.input_root1,
             load_fid_extractor(args.fid_weights, batch=args.batch_size),
+            batch=args.batch_size,
         )
         print(f"FID: {score:.4f}")
     else:

@@ -59,9 +59,9 @@ def main():
         )
 
         hlo = pipeline.runner.compiled_hlo(args.num_inference_steps)
-        with open(args.dump_hlo, "w") as f:
-            f.write(hlo)
         if is_main_process():
+            with open(args.dump_hlo, "w") as f:
+                f.write(hlo)
             print(f"HLO written to {args.dump_hlo}")
             print(format_report(analyze_loop_collectives(hlo)))
 
