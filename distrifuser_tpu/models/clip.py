@@ -55,6 +55,44 @@ def open_clip_bigg_config() -> CLIPTextConfig:
     )
 
 
+def open_clip_vith_config() -> CLIPTextConfig:
+    """OpenCLIP ViT-H/14 text tower as shipped in SD 2.x snapshots
+    (23 transformer layers — diffusers stores the truncated penultimate-layer
+    variant — GeLU MLPs, final hidden state consumed)."""
+    return CLIPTextConfig(
+        hidden_size=1024,
+        num_hidden_layers=23,
+        num_attention_heads=16,
+        intermediate_size=4096,
+        hidden_act="gelu",
+    )
+
+
+def clip_config_from_json(source) -> CLIPTextConfig:
+    """Build a CLIPTextConfig from a transformers `text_encoder/config.json`
+    (path or dict).  `projection_dim` is honored only when the stored
+    architecture is CLIPTextModelWithProjection (SDXL's text_encoder_2) —
+    plain CLIPTextModel snapshots carry the field too, but no
+    text_projection weights exist to apply it."""
+    from .unet import load_config_source
+
+    cfg = load_config_source(source)
+    with_projection = "CLIPTextModelWithProjection" in (
+        cfg.get("architectures") or []
+    )
+    return CLIPTextConfig(
+        vocab_size=cfg.get("vocab_size", 49408),
+        hidden_size=cfg.get("hidden_size", 768),
+        num_hidden_layers=cfg.get("num_hidden_layers", 12),
+        num_attention_heads=cfg.get("num_attention_heads", 12),
+        intermediate_size=cfg.get("intermediate_size", 3072),
+        max_position_embeddings=cfg.get("max_position_embeddings", 77),
+        hidden_act=cfg.get("hidden_act", "quick_gelu"),
+        eos_token_id=cfg.get("eos_token_id", 49407),
+        projection_dim=cfg.get("projection_dim") if with_projection else None,
+    )
+
+
 def tiny_clip_config(hidden: int = 32) -> CLIPTextConfig:
     return CLIPTextConfig(
         vocab_size=1000,

@@ -116,6 +116,140 @@ def sd15_config() -> UNetConfig:
         cross_attention_dim=768,
         use_linear_projection=False,
         addition_embed_type=None,
+        projection_class_embeddings_input_dim=0,
+    )
+
+
+def sd21_config() -> UNetConfig:
+    """SD 2.0/2.1 UNet (stabilityai/stable-diffusion-2-1 and compatible):
+    SD1.x block structure, OpenCLIP ViT-H conditioning (1024), uniform
+    64-dim heads, linear transformer projections."""
+    return UNetConfig(
+        block_out_channels=(320, 640, 1280, 1280),
+        down_block_types=(
+            "CrossAttnDownBlock2D",
+            "CrossAttnDownBlock2D",
+            "CrossAttnDownBlock2D",
+            "DownBlock2D",
+        ),
+        up_block_types=(
+            "UpBlock2D",
+            "CrossAttnUpBlock2D",
+            "CrossAttnUpBlock2D",
+            "CrossAttnUpBlock2D",
+        ),
+        transformer_layers_per_block=(1, 1, 1, 1),
+        num_attention_heads=(5, 10, 20, 20),
+        cross_attention_dim=1024,
+        use_linear_projection=True,
+        addition_embed_type=None,
+        projection_class_embeddings_input_dim=0,
+    )
+
+
+_SUPPORTED_DOWN_BLOCKS = {"DownBlock2D", "CrossAttnDownBlock2D"}
+_SUPPORTED_UP_BLOCKS = {"UpBlock2D", "CrossAttnUpBlock2D"}
+
+
+def load_config_source(source) -> Dict[str, Any]:
+    """Normalize a config source: a json file path (str/PathLike) or an
+    already-parsed mapping.  Shared by the unet/clip/vae config loaders."""
+    import os
+
+    if isinstance(source, (str, bytes, os.PathLike)):
+        import json
+
+        with open(source) as f:
+            return json.load(f)
+    return dict(source)
+
+
+def unet_config_from_json(source) -> UNetConfig:
+    """Build a UNetConfig from a diffusers `unet/config.json` (path or dict).
+
+    The reference never needs this — it calls diffusers `from_pretrained`,
+    which instantiates the architecture from this very file
+    (/root/reference/distrifuser/pipelines.py:30-42).  Reading it here makes
+    every SD-family snapshot (1.4/1.5, 2.0/2.1 base+v, SDXL-base — and
+    refiner-architecture UNets via from_params; the refiner's img2img
+    *pipeline* is out of scope here, as in the reference) load with its true
+    architecture instead of a hardcoded preset.
+
+    Notes on diffusers quirks reproduced here:
+    * `attention_head_dim` in these configs historically means *number of
+      heads* per block when `num_attention_heads` is absent (SD1.5's 8,
+      SD2.1's [5,10,20,20], SDXL's [5,10,20]) — diffusers carries the same
+      naming bug forward for backwards compatibility.
+    * scalar fields broadcast over blocks (`transformer_layers_per_block: 1`).
+    * flag fields appear as scalars or per-block lists; a list of falses
+      (diffusers' re-saved form) means disabled, same as `false`.
+    """
+    cfg = load_config_source(source)
+
+    def per_block(value, default):
+        v = cfg.get(value, default)
+        if isinstance(v, (list, tuple)):
+            return tuple(v)
+        return (v,) * len(blocks)
+
+    blocks = tuple(cfg["block_out_channels"])
+    down = tuple(cfg["down_block_types"])
+    up = tuple(cfg["up_block_types"])
+    unsupported = (set(down) - _SUPPORTED_DOWN_BLOCKS) | (
+        set(up) - _SUPPORTED_UP_BLOCKS
+    )
+    def enabled(v):
+        # scalar-or-per-block-list flag; [false, false, ...] means disabled
+        return any(v) if isinstance(v, (list, tuple)) else bool(v)
+
+    for key, bad in (
+        ("block types", unsupported),
+        ("class_embed_type", cfg.get("class_embed_type")),
+        ("encoder_hid_dim", cfg.get("encoder_hid_dim")),
+        ("dual_cross_attention", enabled(cfg.get("dual_cross_attention"))),
+        ("only_cross_attention", enabled(cfg.get("only_cross_attention"))),
+    ):
+        if bad:
+            raise NotImplementedError(
+                f"unsupported UNet config: {key}={bad!r} (supported: the "
+                "SD1.x/SD2.x/SDXL UNet2DConditionModel family)"
+            )
+    add_type = cfg.get("addition_embed_type")
+    if add_type not in (None, "text_time"):
+        raise NotImplementedError(
+            f"unsupported addition_embed_type {add_type!r}"
+        )
+    heads = cfg.get("num_attention_heads") or cfg["attention_head_dim"]
+    if not isinstance(heads, (list, tuple)):
+        heads = (heads,) * len(blocks)
+    cross = cfg.get("cross_attention_dim", 1280)
+    if isinstance(cross, (list, tuple)):
+        uniq = set(cross)
+        if len(uniq) != 1:
+            raise NotImplementedError(
+                f"per-block cross_attention_dim {cross!r} unsupported"
+            )
+        cross = cross[0]
+    return UNetConfig(
+        in_channels=cfg.get("in_channels", 4),
+        out_channels=cfg.get("out_channels", 4),
+        block_out_channels=blocks,
+        down_block_types=down,
+        up_block_types=up,
+        layers_per_block=cfg.get("layers_per_block", 2),
+        transformer_layers_per_block=per_block("transformer_layers_per_block", 1),
+        num_attention_heads=tuple(heads),
+        cross_attention_dim=cross,
+        norm_num_groups=cfg.get("norm_num_groups", 32),
+        use_linear_projection=cfg.get("use_linear_projection", False),
+        addition_embed_type=add_type,
+        addition_time_embed_dim=cfg.get("addition_time_embed_dim", 256) or 256,
+        projection_class_embeddings_input_dim=cfg.get(
+            "projection_class_embeddings_input_dim", 0
+        )
+        or 0,
+        flip_sin_to_cos=cfg.get("flip_sin_to_cos", True),
+        freq_shift=cfg.get("freq_shift", 0),
     )
 
 
@@ -320,7 +454,7 @@ def unet_forward(
                   silu(linear(params["time_embedding"]["linear_1"], temb)))
     if cfg.addition_embed_type == "text_time":
         assert added_cond is not None, "SDXL needs added_cond text_embeds/time_ids"
-        time_ids = added_cond["time_ids"]  # [B, 6]
+        time_ids = added_cond["time_ids"]  # [B, n_ids] (6 base / 5 refiner)
         tid_emb = timestep_embedding(
             time_ids.reshape(-1), cfg.addition_time_embed_dim,
             flip_sin_to_cos=cfg.flip_sin_to_cos, freq_shift=cfg.freq_shift,

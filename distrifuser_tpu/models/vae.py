@@ -48,6 +48,24 @@ def sd_vae_config() -> VAEConfig:
     return VAEConfig(scaling_factor=0.18215)
 
 
+def vae_config_from_json(source) -> VAEConfig:
+    """Build a VAEConfig from a diffusers `vae/config.json` (path or dict) —
+    carries the snapshot's true scaling_factor (0.18215 SD, 0.13025 SDXL)
+    and channel layout instead of assuming a preset."""
+    from .unet import load_config_source
+
+    cfg = load_config_source(source)
+    return VAEConfig(
+        in_channels=cfg.get("in_channels", 3),
+        out_channels=cfg.get("out_channels", 3),
+        latent_channels=cfg.get("latent_channels", 4),
+        block_out_channels=tuple(cfg.get("block_out_channels", (128, 256, 512, 512))),
+        layers_per_block=cfg.get("layers_per_block", 2),
+        norm_num_groups=cfg.get("norm_num_groups", 32),
+        scaling_factor=cfg.get("scaling_factor", 0.18215),
+    )
+
+
 def tiny_vae_config() -> VAEConfig:
     return VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
                      norm_num_groups=8, scaling_factor=0.18215)

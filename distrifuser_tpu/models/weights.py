@@ -167,7 +167,12 @@ def _convert(sd: Dict[str, np.ndarray], *, skip=()) -> Dict[str, Any]:
 def _cast(tree, dtype):
     import jax
 
-    return jax.tree.map(lambda a: jnp.asarray(a, dtype), tree)
+    # jnp.array (copy=True), NOT jnp.asarray: on the CPU backend asarray can
+    # be zero-copy over a numpy view into the loader's mmap, and the
+    # release_mappings() call after conversion would then unmap live param
+    # memory — garbage weights or SIGSEGV on first use.  TPU always copies to
+    # HBM, which is why only CPU runs could hit it.
+    return jax.tree.map(lambda a: jnp.array(a, dtype), tree)
 
 
 def convert_unet_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):

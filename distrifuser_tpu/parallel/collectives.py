@@ -38,6 +38,18 @@ def psum_mean(x, n: int, axis: str = SP_AXIS):
     return lax.pmean(x, axis)
 
 
+def neighbor_perms(n: int):
+    """Non-wrapping neighbor permutations along the patch axis:
+    ``(down, up)`` = (send to next device, send to previous device).  Edge
+    devices have no source and receive zeros from ppermute — the image-border
+    zero padding of a global conv.  Single source of truth for the halo edge
+    convention (used by halo_exchange and the batched flush in
+    parallel/context.py)."""
+    down = [(i, i + 1) for i in range(n - 1)]
+    up = [(i + 1, i) for i in range(n - 1)]
+    return down, up
+
+
 def halo_exchange(x, halo: int, n: int, axis: str = SP_AXIS):
     """Exchange boundary rows with spatial neighbors along the patch axis.
 
@@ -51,8 +63,7 @@ def halo_exchange(x, halo: int, n: int, axis: str = SP_AXIS):
     if halo == 0 or n == 1:
         zeros = jnp.zeros(x.shape[:1] + (halo,) + x.shape[2:], x.dtype)
         return zeros, zeros
-    down = [(i, i + 1) for i in range(n - 1)]  # send to next device
-    up = [(i + 1, i) for i in range(n - 1)]  # send to previous device
+    down, up = neighbor_perms(n)
     from_prev = lax.ppermute(x[:, -halo:], axis, perm=down)
     from_next = lax.ppermute(x[:, :halo], axis, perm=up)
     return from_prev, from_next

@@ -169,8 +169,9 @@ class PatchContext:
                     off += size
             self._def_gather.clear()
         if self._def_halo:
-            down = [(i, i + 1) for i in range(self.n - 1)]  # send to next
-            up = [(i + 1, i) for i in range(self.n - 1)]  # send to previous
+            from .collectives import neighbor_perms
+
+            down, up = neighbor_perms(self.n)
             by_dtype = {}
             for name, (top_rows, bottom_rows) in self._def_halo.items():
                 by_dtype.setdefault(jnp.dtype(top_rows.dtype), []).append(

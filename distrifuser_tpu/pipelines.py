@@ -105,6 +105,15 @@ def _tokenizer_or_fallback(path: str):
         return SimpleTokenizer()
 
 
+def _config_from_snapshot(root: str, subdir: str, loader, fallback):
+    """Derive a model config from the snapshot's `<subdir>/config.json`
+    (the way diffusers from_pretrained instantiates the architecture for the
+    reference, /root/reference/distrifuser/pipelines.py:30-42); fall back to
+    the named preset for bare weight dumps without config files."""
+    path = os.path.join(root, subdir, "config.json")
+    return loader(path) if os.path.exists(path) else fallback()
+
+
 def _scheduler_from_snapshot(root: str, name: str | BaseScheduler) -> BaseScheduler:
     """Build the scheduler, honoring the snapshot's scheduler_config.json
     (prediction_type / betas / train steps) — this is how SD 2.x's
@@ -314,15 +323,31 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,
-            unet_mod.sdxl_config(),
+            _config_from_snapshot(
+                root, "unet", unet_mod.unet_config_from_json, unet_mod.sdxl_config
+            ),
             unet_params,
-            vae_mod.sdxl_vae_config(),
+            _config_from_snapshot(
+                root, "vae", vae_mod.vae_config_from_json, vae_mod.sdxl_vae_config
+            ),
             vae_params,
             sched,
             [tok1, tok2],
             [
-                (clip_mod.clip_vit_l_config(), te1),
-                (clip_mod.open_clip_bigg_config(), te2),
+                (
+                    _config_from_snapshot(
+                        root, "text_encoder",
+                        clip_mod.clip_config_from_json, clip_mod.clip_vit_l_config,
+                    ),
+                    te1,
+                ),
+                (
+                    _config_from_snapshot(
+                        root, "text_encoder_2",
+                        clip_mod.clip_config_from_json, clip_mod.open_clip_bigg_config,
+                    ),
+                    te2,
+                ),
             ],
         )
 
@@ -353,9 +378,19 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         )
         emb = emb.reshape(n_br, b, *emb.shape[1:])
         pooled = out2["text_embeds"].reshape(n_br, b, -1)
-        time_ids = jnp.asarray(
-            [cfg.height, cfg.width, 0, 0, cfg.height, cfg.width], jnp.float32
-        )
+        # time-id count is derived from the UNet's add-embedding width:
+        # (proj_in - pooled) / per-id embed dim = 6 for SDXL-base
+        # (orig h, w, crop top/left, target h, w) and 5 for refiner-style
+        # configs (orig h, w, crop top/left, aesthetic score).
+        ucfg = self.unet_config
+        n_ids = (
+            ucfg.projection_class_embeddings_input_dim - pooled.shape[-1]
+        ) // ucfg.addition_time_embed_dim
+        if n_ids == 5:
+            ids = [cfg.height, cfg.width, 0, 0, 6.0]  # diffusers' default score
+        else:
+            ids = [cfg.height, cfg.width, 0, 0, cfg.height, cfg.width]
+        time_ids = jnp.asarray(ids, jnp.float32)
         time_ids = jnp.tile(time_ids[None, None], (n_br, b, 1))
         added = {"text_embeds": pooled, "time_ids": time_ids}
         return emb, added
@@ -397,13 +432,25 @@ class DistriSDPipeline(_DistriPipelineBase):
         sched = _scheduler_from_snapshot(root, scheduler)
         return cls(
             distri_config,
-            unet_mod.sd15_config(),
+            _config_from_snapshot(
+                root, "unet", unet_mod.unet_config_from_json, unet_mod.sd15_config
+            ),
             unet_params,
-            vae_mod.sd_vae_config(),
+            _config_from_snapshot(
+                root, "vae", vae_mod.vae_config_from_json, vae_mod.sd_vae_config
+            ),
             vae_params,
             sched,
             [tok],
-            [(clip_mod.clip_vit_l_config(), te)],
+            [
+                (
+                    _config_from_snapshot(
+                        root, "text_encoder",
+                        clip_mod.clip_config_from_json, clip_mod.clip_vit_l_config,
+                    ),
+                    te,
+                )
+            ],
         )
 
     @classmethod

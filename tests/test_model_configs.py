@@ -1,0 +1,255 @@
+"""Config-driven architecture: deriving model configs from snapshot JSON.
+
+The reference gets its architectures from diffusers `from_pretrained`, which
+reads each component's config.json (/root/reference/distrifuser/pipelines.py:
+30-42).  `unet_config_from_json` / `clip_config_from_json` /
+`vae_config_from_json` replicate that here, so SD 1.x, SD 2.x (ViT-H text
+encoder, v-prediction) and SDXL snapshots all load with their true
+architecture.  The config dicts below are the actual fields of the published
+snapshots' config.json files.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models import clip as clip_mod
+from distrifuser_tpu.models import unet as unet_mod
+from distrifuser_tpu.models import vae as vae_mod
+
+SD15_UNET_JSON = {
+    "_class_name": "UNet2DConditionModel",
+    "attention_head_dim": 8,
+    "block_out_channels": [320, 640, 1280, 1280],
+    "cross_attention_dim": 768,
+    "down_block_types": ["CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+                         "CrossAttnDownBlock2D", "DownBlock2D"],
+    "flip_sin_to_cos": True,
+    "freq_shift": 0,
+    "in_channels": 4,
+    "layers_per_block": 2,
+    "norm_num_groups": 32,
+    "out_channels": 4,
+    "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D",
+                       "CrossAttnUpBlock2D", "CrossAttnUpBlock2D"],
+}
+
+SD21_UNET_JSON = {
+    "_class_name": "UNet2DConditionModel",
+    "attention_head_dim": [5, 10, 20, 20],
+    "block_out_channels": [320, 640, 1280, 1280],
+    "cross_attention_dim": 1024,
+    "down_block_types": ["CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+                         "CrossAttnDownBlock2D", "DownBlock2D"],
+    "dual_cross_attention": False,
+    "in_channels": 4,
+    "layers_per_block": 2,
+    "norm_num_groups": 32,
+    "only_cross_attention": False,
+    "out_channels": 4,
+    "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D",
+                       "CrossAttnUpBlock2D", "CrossAttnUpBlock2D"],
+    "upcast_attention": True,
+    "use_linear_projection": True,
+}
+
+SDXL_UNET_JSON = {
+    "_class_name": "UNet2DConditionModel",
+    "addition_embed_type": "text_time",
+    "addition_time_embed_dim": 256,
+    "attention_head_dim": [5, 10, 20],
+    "block_out_channels": [320, 640, 1280],
+    "cross_attention_dim": 2048,
+    "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D",
+                         "CrossAttnDownBlock2D"],
+    "in_channels": 4,
+    "layers_per_block": 2,
+    "norm_num_groups": 32,
+    "out_channels": 4,
+    "projection_class_embeddings_input_dim": 2816,
+    "transformer_layers_per_block": [1, 2, 10],
+    "up_block_types": ["CrossAttnUpBlock2D", "CrossAttnUpBlock2D",
+                       "UpBlock2D"],
+    "use_linear_projection": True,
+}
+
+
+def test_unet_config_from_json_matches_presets():
+    assert unet_mod.unet_config_from_json(SD15_UNET_JSON) == unet_mod.sd15_config()
+    assert unet_mod.unet_config_from_json(SD21_UNET_JSON) == unet_mod.sd21_config()
+    assert unet_mod.unet_config_from_json(SDXL_UNET_JSON) == unet_mod.sdxl_config()
+
+
+def test_unet_config_from_json_scalar_broadcast():
+    cfg = unet_mod.unet_config_from_json(SD15_UNET_JSON)
+    assert cfg.num_attention_heads == (8, 8, 8, 8)  # scalar head count
+    assert cfg.transformer_layers_per_block == (1, 1, 1, 1)  # absent -> 1s
+
+
+def test_unet_config_from_json_rejects_unsupported():
+    bad = dict(SD15_UNET_JSON, class_embed_type="projection")
+    with pytest.raises(NotImplementedError, match="class_embed_type"):
+        unet_mod.unet_config_from_json(bad)
+    bad = dict(SD15_UNET_JSON, down_block_types=["AttnDownBlock2D"] * 4)
+    with pytest.raises(NotImplementedError, match="block types"):
+        unet_mod.unet_config_from_json(bad)
+    bad = dict(SD15_UNET_JSON, addition_embed_type="image_time")
+    with pytest.raises(NotImplementedError, match="addition_embed_type"):
+        unet_mod.unet_config_from_json(bad)
+    # diffusers re-saves disabled flags as per-block false lists — supported
+    ok = dict(SD21_UNET_JSON, only_cross_attention=[False] * 4,
+              dual_cross_attention=[False] * 4)
+    assert unet_mod.unet_config_from_json(ok) == unet_mod.sd21_config()
+
+
+def test_clip_config_from_json():
+    # SD1.x/SDXL text_encoder: ViT-L saved as plain CLIPTextModel — the
+    # projection_dim field is present but must NOT be honored
+    vit_l = {
+        "architectures": ["CLIPTextModel"], "hidden_act": "quick_gelu",
+        "hidden_size": 768, "intermediate_size": 3072,
+        "max_position_embeddings": 77, "num_attention_heads": 12,
+        "num_hidden_layers": 12, "projection_dim": 768, "vocab_size": 49408,
+        "eos_token_id": 49407,
+    }
+    assert clip_mod.clip_config_from_json(vit_l) == clip_mod.clip_vit_l_config()
+
+    # SD2.x text_encoder: OpenCLIP ViT-H, 23 stored layers, GeLU
+    vit_h = {
+        "architectures": ["CLIPTextModel"], "hidden_act": "gelu",
+        "hidden_size": 1024, "intermediate_size": 4096,
+        "max_position_embeddings": 77, "num_attention_heads": 16,
+        "num_hidden_layers": 23, "projection_dim": 512, "vocab_size": 49408,
+        "eos_token_id": 49407,
+    }
+    assert clip_mod.clip_config_from_json(vit_h) == clip_mod.open_clip_vith_config()
+
+    # SDXL text_encoder_2: bigG WithProjection — projection IS honored
+    bigg = {
+        "architectures": ["CLIPTextModelWithProjection"], "hidden_act": "gelu",
+        "hidden_size": 1280, "intermediate_size": 5120,
+        "max_position_embeddings": 77, "num_attention_heads": 20,
+        "num_hidden_layers": 32, "projection_dim": 1280, "vocab_size": 49408,
+        "eos_token_id": 49407,
+    }
+    assert clip_mod.clip_config_from_json(bigg) == clip_mod.open_clip_bigg_config()
+
+
+def test_vae_config_from_json():
+    sdxl_vae = {
+        "_class_name": "AutoencoderKL", "block_out_channels": [128, 256, 512, 512],
+        "in_channels": 3, "latent_channels": 4, "layers_per_block": 2,
+        "norm_num_groups": 32, "out_channels": 3, "scaling_factor": 0.13025,
+    }
+    assert vae_mod.vae_config_from_json(sdxl_vae) == vae_mod.sdxl_vae_config()
+    sd_vae = dict(sdxl_vae, scaling_factor=0.18215)
+    assert vae_mod.vae_config_from_json(sd_vae) == vae_mod.sd_vae_config()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: from_pretrained derives the architecture from a snapshot
+# ---------------------------------------------------------------------------
+
+
+def _write_safetensors(path, tree, invert):
+    from safetensors.numpy import save_file
+
+    sd = {}
+    invert(tree, "", sd)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()}, path)
+
+
+def test_sd_from_pretrained_is_config_driven(tmp_path):
+    """A fabricated SD2.1-style snapshot (linear projections, GeLU text
+    encoder, v-prediction scheduler) must load with exactly that
+    architecture — not the hardcoded SD1.5 preset."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from test_weights_roundtrip import invert_tree
+
+    root = tmp_path / "snap"
+    # tiny SD2.1-flavored UNet: linear projections ON (sd15 preset has OFF)
+    unet_json = {
+        "_class_name": "UNet2DConditionModel",
+        "attention_head_dim": [2, 4],
+        "block_out_channels": [32, 64],
+        "cross_attention_dim": 32,
+        "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D"],
+        "in_channels": 4, "layers_per_block": 1, "norm_num_groups": 8,
+        "out_channels": 4,
+        "up_block_types": ["CrossAttnUpBlock2D", "UpBlock2D"],
+        "use_linear_projection": True,
+    }
+    ucfg = unet_mod.unet_config_from_json(unet_json)
+    # structurally the tiny test architecture (embed-dim defaults aside)
+    assert ucfg.block_out_channels == (32, 64)
+    assert ucfg.num_attention_heads == (2, 4)
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg)
+    _write_safetensors(
+        str(root / "unet" / "diffusion_pytorch_model.safetensors"),
+        params, invert_tree,
+    )
+    (root / "unet" / "config.json").write_text(json.dumps(unet_json))
+
+    # tiny VAE
+    vae_json = {
+        "_class_name": "AutoencoderKL", "block_out_channels": [16, 32],
+        "in_channels": 3, "latent_channels": 4, "layers_per_block": 1,
+        "norm_num_groups": 8, "out_channels": 3, "scaling_factor": 0.9,
+    }
+    vcfg = vae_mod.vae_config_from_json(vae_json)
+    vae_params = vae_mod.init_vae_params(jax.random.PRNGKey(1), vcfg)
+    _write_safetensors(
+        str(root / "vae" / "diffusion_pytorch_model.safetensors"),
+        vae_params, invert_tree,
+    )
+    (root / "vae" / "config.json").write_text(json.dumps(vae_json))
+
+    # tiny GeLU text encoder via transformers (ViT-H style act)
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=77, hidden_act="gelu",
+        eos_token_id=999, bos_token_id=998,
+    )
+    torch.manual_seed(0)
+    te = transformers.CLIPTextModel(hf_cfg).eval()
+    from safetensors.torch import save_file as save_torch
+
+    os.makedirs(root / "text_encoder", exist_ok=True)
+    save_torch(dict(te.state_dict()), str(root / "text_encoder" / "model.safetensors"))
+    (root / "text_encoder" / "config.json").write_text(
+        json.dumps(dict(hf_cfg.to_dict(), architectures=["CLIPTextModel"]))
+    )
+
+    os.makedirs(root / "scheduler", exist_ok=True)
+    (root / "scheduler" / "scheduler_config.json").write_text(
+        json.dumps({"_class_name": "DDIMScheduler",
+                    "prediction_type": "v_prediction",
+                    "num_train_timesteps": 1000})
+    )
+
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+
+    dcfg = DistriConfig(devices=jax.devices("cpu")[:2], height=64, width=64,
+                        warmup_steps=1)
+    pipe = DistriSDPipeline.from_pretrained(dcfg, str(root))
+    # architecture came from the JSON, not the sd15 preset
+    assert pipe.unet_config == ucfg
+    assert pipe.unet_config.use_linear_projection is True
+    assert pipe.vae_config.scaling_factor == 0.9
+    tcfg = pipe.text_encoders[0][0]
+    assert tcfg.hidden_act == "gelu" and tcfg.projection_dim is None
+    assert pipe.scheduler.prediction_type == "v_prediction"
+
+    out = pipe(prompt="a photo", num_inference_steps=2, guidance_scale=5.0,
+               seed=0, output_type="latent")
+    lat = np.asarray(out.images[0])
+    assert lat.shape == (8, 8, 4)
+    assert np.isfinite(lat).all()
