@@ -150,9 +150,15 @@ def clip_text_forward(params, cfg: CLIPTextConfig, input_ids) -> Dict[str, Any]:
         hidden_states.append(x)
 
     last = layer_norm(params["final_layer_norm"], x)
-    # EOS pooling: first position holding the EOS token (transformers uses
-    # argmax over ids == eos for CLIP's left-to-right tokenizer output).
-    eos_pos = jnp.argmax((ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
+    # EOS pooling, matching transformers CLIPTextModel exactly: configs with
+    # the legacy eos_token_id == 2 (every published SD/SDXL text_encoder
+    # config.json carries it) pool at argmax(ids) — valid because the real
+    # EOS token 49407 is the highest id in the CLIP vocab — while modern
+    # configs pool at the first position equal to eos_token_id.
+    if cfg.eos_token_id == 2:
+        eos_pos = jnp.argmax(ids, axis=1)
+    else:
+        eos_pos = jnp.argmax((ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
     pooled = last[jnp.arange(b), eos_pos]
     out = {
         "hidden_states": hidden_states,

@@ -202,12 +202,17 @@ def unet_config_from_json(source) -> UNetConfig:
         # scalar-or-per-block-list flag; [false, false, ...] means disabled
         return any(v) if isinstance(v, (list, tuple)) else bool(v)
 
+    mid = cfg.get("mid_block_type", "UNetMidBlock2DCrossAttn")
     for key, bad in (
         ("block types", unsupported),
         ("class_embed_type", cfg.get("class_embed_type")),
         ("encoder_hid_dim", cfg.get("encoder_hid_dim")),
         ("dual_cross_attention", enabled(cfg.get("dual_cross_attention"))),
         ("only_cross_attention", enabled(cfg.get("only_cross_attention"))),
+        # LCM-distilled guidance embedding: weights would be silently dropped
+        ("time_cond_proj_dim", cfg.get("time_cond_proj_dim")),
+        ("class_embeddings_concat", cfg.get("class_embeddings_concat")),
+        ("mid_block_type", None if mid == "UNetMidBlock2DCrossAttn" else mid),
     ):
         if bad:
             raise NotImplementedError(
@@ -219,7 +224,8 @@ def unet_config_from_json(source) -> UNetConfig:
         raise NotImplementedError(
             f"unsupported addition_embed_type {add_type!r}"
         )
-    heads = cfg.get("num_attention_heads") or cfg["attention_head_dim"]
+    # diffusers defaults attention_head_dim=8 (meaning 8 heads, see above)
+    heads = cfg.get("num_attention_heads") or cfg.get("attention_head_dim", 8)
     if not isinstance(heads, (list, tuple)):
         heads = (heads,) * len(blocks)
     cross = cfg.get("cross_attention_dim", 1280)

@@ -386,6 +386,14 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         n_ids = (
             ucfg.projection_class_embeddings_input_dim - pooled.shape[-1]
         ) // ucfg.addition_time_embed_dim
+        if n_ids not in (5, 6):
+            raise ValueError(
+                f"cannot derive time-ids: add-embedding expects {n_ids} ids "
+                f"(proj_in={ucfg.projection_class_embeddings_input_dim}, "
+                f"pooled={pooled.shape[-1]}, "
+                f"per-id={ucfg.addition_time_embed_dim}); only the SDXL-base "
+                "(6) and refiner-style (5) layouts are supported"
+            )
         if n_ids == 5:
             ids = [cfg.height, cfg.width, 0, 0, 6.0]  # diffusers' default score
         else:

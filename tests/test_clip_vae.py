@@ -79,6 +79,47 @@ def test_clip_matches_transformers(tmp_path):
     )
 
 
+def test_clip_legacy_eos_pooling_matches_transformers(tmp_path):
+    """Every published SD/SDXL text_encoder config.json carries the legacy
+    eos_token_id=2; transformers special-cases it by pooling at argmax(ids)
+    (valid because the real EOS 49407 is the top of the CLIP vocab).  Our
+    forward must reproduce that, or pooled/text_embeds silently come from
+    the wrong position on real snapshots."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=77, projection_dim=32,
+        eos_token_id=2, bos_token_id=998, hidden_act="quick_gelu",
+    )
+    torch.manual_seed(0)
+    model = transformers.CLIPTextModelWithProjection(hf_cfg).eval()
+
+    # "real" eos = highest vocab id (999), sitting mid-sequence; the token 2
+    # also appears earlier — the ==eos_token_id rule would pool there (wrong)
+    ids = np.random.RandomState(0).randint(3, 990, size=(2, 12))
+    ids[:, 1] = 2
+    ids[0, 5:] = 999
+    ids[1, -1] = 999
+    with torch.no_grad():
+        out = model(torch.tensor(ids))
+
+    params = convert_clip_state_dict(
+        {k: v.numpy() for k, v in model.state_dict().items()}
+    )
+    cfg = CLIPTextConfig(
+        vocab_size=1000, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, projection_dim=32,
+        eos_token_id=2,
+    )
+    ours = clip_text_forward(params, cfg, ids)
+    np.testing.assert_allclose(
+        np.asarray(ours["text_embeds"]), out.text_embeds.numpy(), atol=2e-5
+    )
+
+
 def test_clip_random_init_forward():
     cfg = tiny_clip_config()
     params = init_clip_params(jax.random.PRNGKey(0), cfg)

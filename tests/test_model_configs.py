@@ -104,6 +104,22 @@ def test_unet_config_from_json_rejects_unsupported():
     ok = dict(SD21_UNET_JSON, only_cross_attention=[False] * 4,
               dual_cross_attention=[False] * 4)
     assert unet_mod.unet_config_from_json(ok) == unet_mod.sd21_config()
+    # LCM-distilled guidance embedding: loading would silently drop weights
+    bad = dict(SD15_UNET_JSON, time_cond_proj_dim=256)
+    with pytest.raises(NotImplementedError, match="time_cond_proj_dim"):
+        unet_mod.unet_config_from_json(bad)
+    bad = dict(SD15_UNET_JSON, mid_block_type="UNetMidBlock2DSimpleCrossAttn")
+    with pytest.raises(NotImplementedError, match="mid_block_type"):
+        unet_mod.unet_config_from_json(bad)
+
+
+def test_unet_config_from_json_head_default():
+    """diffusers defaults attention_head_dim=8 when both head fields are
+    absent — a stripped config must load, not KeyError."""
+    minimal = {k: v for k, v in SD15_UNET_JSON.items()
+               if k != "attention_head_dim"}
+    cfg = unet_mod.unet_config_from_json(minimal)
+    assert cfg.num_attention_heads == (8, 8, 8, 8)
 
 
 def test_clip_config_from_json():
