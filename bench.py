@@ -29,6 +29,23 @@ A100_SDXL_1024_50STEP_S = 6.6
 _RETRY_FLAG = "--_watchdog_retried"
 
 
+def _reexec_once(reason: str) -> bool:
+    """Re-exec this script with the retry flag appended (fresh process =
+    fresh backend-init attempt).  Returns False if the retry was already
+    spent or exec itself failed — callers then emit their explicit JSON
+    failure line instead of dying silently."""
+    if _RETRY_FLAG in sys.argv:
+        return False
+    print(f"{reason}; re-execing for one retry", file=sys.stderr, flush=True)
+    try:
+        os.execv(sys.executable,
+                 [sys.executable, os.path.abspath(__file__),
+                  *sys.argv[1:], _RETRY_FLAG])
+    except OSError as e:
+        print(f"re-exec failed ({e}); giving up", file=sys.stderr, flush=True)
+    return False
+
+
 def _arm_watchdog(seconds: float):
     """Retry once, then emit a parseable failure line, if the runtime wedges.
 
@@ -45,19 +62,8 @@ def _arm_watchdog(seconds: float):
     def fire():
         if _disarmed.wait(seconds):
             return
-        if _RETRY_FLAG not in sys.argv:
-            print(f"bench watchdog fired after {seconds}s; re-execing for one "
-                  "retry (chip lease may have expired)", file=sys.stderr,
-                  flush=True)
-            try:
-                os.execv(sys.executable,
-                         [sys.executable, os.path.abspath(__file__),
-                          *sys.argv[1:], _RETRY_FLAG])
-            except OSError as e:
-                # exec failed: fall through to the explicit timeout line
-                # rather than dying silently in this daemon thread
-                print(f"watchdog re-exec failed ({e}); giving up",
-                      file=sys.stderr, flush=True)
+        _reexec_once(f"bench watchdog fired after {seconds}s "
+                     "(chip lease may have expired)")
         print(json.dumps({
             "metric": "bench_watchdog_timeout",
             "value": -1.0,
@@ -98,7 +104,28 @@ def main():
     from distrifuser_tpu.parallel.runner import make_runner
     from distrifuser_tpu.schedulers import get_scheduler
 
-    on_tpu = jax.devices()[0].platform != "cpu"
+    # Backend init can also FAIL (not just hang): a wedged chip lease
+    # surfaces as 'Unable to initialize backend axon: UNAVAILABLE' after
+    # ~40 min (observed 2026-07-29).  JAX caches the init failure
+    # process-wide, so retry via re-exec (a fresh process re-attempts the
+    # claim); on the flagged second failure emit an explicit parseable
+    # line instead of a raw traceback.
+    try:
+        devices = jax.devices()
+    except RuntimeError as e:
+        if _RETRY_FLAG not in sys.argv:
+            time.sleep(30)  # give a stale grant a moment to clear
+        _reexec_once(f"backend init failed ({e})")
+        print(json.dumps({
+            "metric": "bench_backend_unavailable",
+            "value": -1.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        print(f"TPU backend unavailable after retry: {e}", file=sys.stderr,
+              flush=True)
+        sys.exit(3)
+    on_tpu = devices[0].platform != "cpu"
     preset = args.preset or ("sdxl" if on_tpu else "tiny")
     if preset == "sdxl":
         ucfg = unet_mod.sdxl_config()
@@ -110,7 +137,7 @@ def main():
         metric = f"tiny_unet_{args.steps}step_{size}px_latency"
 
     cfg = DistriConfig(
-        devices=jax.devices()[:1],  # single-chip headline number
+        devices=devices[:1],  # single-chip headline number
         height=size,
         width=size,
         warmup_steps=4,

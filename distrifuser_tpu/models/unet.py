@@ -202,7 +202,10 @@ def unet_config_from_json(source) -> UNetConfig:
         # scalar-or-per-block-list flag; [false, false, ...] means disabled
         return any(v) if isinstance(v, (list, tuple)) else bool(v)
 
-    mid = cfg.get("mid_block_type", "UNetMidBlock2DCrossAttn")
+    # key-present-with-null is valid diffusers and means "no mid block" —
+    # unsupported here just like any nonstandard type
+    mid = cfg["mid_block_type"] if "mid_block_type" in cfg else "UNetMidBlock2DCrossAttn"
+    mid_bad = "null (no mid block)" if mid is None else mid
     for key, bad in (
         ("block types", unsupported),
         ("class_embed_type", cfg.get("class_embed_type")),
@@ -212,7 +215,7 @@ def unet_config_from_json(source) -> UNetConfig:
         # LCM-distilled guidance embedding: weights would be silently dropped
         ("time_cond_proj_dim", cfg.get("time_cond_proj_dim")),
         ("class_embeddings_concat", cfg.get("class_embeddings_concat")),
-        ("mid_block_type", None if mid == "UNetMidBlock2DCrossAttn" else mid),
+        ("mid_block_type", None if mid == "UNetMidBlock2DCrossAttn" else mid_bad),
     ):
         if bad:
             raise NotImplementedError(

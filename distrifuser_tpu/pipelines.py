@@ -383,10 +383,9 @@ class DistriSDXLPipeline(_DistriPipelineBase):
         # (orig h, w, crop top/left, target h, w) and 5 for refiner-style
         # configs (orig h, w, crop top/left, aesthetic score).
         ucfg = self.unet_config
-        n_ids = (
-            ucfg.projection_class_embeddings_input_dim - pooled.shape[-1]
-        ) // ucfg.addition_time_embed_dim
-        if n_ids not in (5, 6):
+        extra = ucfg.projection_class_embeddings_input_dim - pooled.shape[-1]
+        n_ids = extra // ucfg.addition_time_embed_dim
+        if n_ids not in (5, 6) or extra % ucfg.addition_time_embed_dim:
             raise ValueError(
                 f"cannot derive time-ids: add-embedding expects {n_ids} ids "
                 f"(proj_in={ucfg.projection_class_embeddings_input_dim}, "
