@@ -36,30 +36,69 @@ _lib: Optional[ctypes.CDLL] = None
 _mappings = []  # (addr, size) for mappings whose views may still be alive
 
 
+def _compile_and_load(src: str, so: str, *flags: str) -> Optional[ctypes.CDLL]:
+    """Rebuild-if-stale then dlopen; None on any failure (callers fall back
+    to their pure-Python paths — native code is an accelerator here, never a
+    requirement)."""
+    try:
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", *flags, "-o", so, src],
+                check=True, capture_output=True,
+            )
+        return ctypes.CDLL(so)
+    except Exception:
+        return None
+
+
 def _build() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    try:
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", _SO, _SRC],
-                check=True, capture_output=True,
-            )
-        lib = ctypes.CDLL(_SO)
+    lib = _compile_and_load(_SRC, _SO, "-pthread")
+    if lib is not None:
         lib.st_open.restype = ctypes.c_void_p
         lib.st_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
         lib.st_prefetch.restype = ctypes.c_uint64
         lib.st_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
         lib.st_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        _lib = lib
-    except Exception:
-        _lib = None
+    _lib = lib
     return _lib
 
 
 def available() -> bool:
     return _build() is not None
+
+
+_BPE_SRC = os.path.join(os.path.dirname(__file__), "clip_bpe.cc")
+_BPE_SO = os.path.join(os.path.dirname(__file__), "_clip_bpe.so")
+_bpe_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_bpe() -> Optional[ctypes.CDLL]:
+    """Compile/load the native CLIP BPE engine (native/bpe.py wraps it)."""
+    global _bpe_lib
+    if _bpe_lib is not None:
+        return _bpe_lib
+    lib = _compile_and_load(_BPE_SRC, _BPE_SO)
+    if lib is not None:
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_set_unk.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.bpe_add_token.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int32,
+        ]
+        lib.bpe_add_merge.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int32,
+        ]
+        lib.bpe_encode_word.restype = ctypes.c_int32
+        lib.bpe_encode_word.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+    _bpe_lib = lib
+    return _bpe_lib
 
 
 def release_mappings() -> int:

@@ -1,0 +1,194 @@
+"""Native CLIP tokenizer: snapshot vocab.json/merges.txt -> input_ids [B, 77].
+
+The reference reaches tokenization through HuggingFace's tokenizer stack
+(diffusers from_pretrained, /root/reference/distrifuser/pipelines.py:30-42).
+Here the hot per-word BPE merge loop runs in C++ (native/clip_bpe.cc) while
+this wrapper owns exact parity with `CLIPTokenizerFast` — the tokenizer
+diffusers actually loads for the reference pipelines:
+
+* normalization: unicode NFC, collapse runs of whitespace, lowercase
+  (the fast tokenizer's Normalizer sequence; no ftfy/html-unescape — those
+  belong to the slow tokenizer's pre-processing only);
+* the CLIP pre-tokenization regex (via the `regex` package, \\p classes);
+* GPT-2 byte->unicode mapping, "</w>" end-of-word marker;
+* framing: <|startoftext|> + tokens[:75] + <|endoftext|>, padded with the
+  eos token to model_max_length (CLIP's pad token is eos).
+
+Construction raises if the native engine or the vocab files are unavailable
+— callers (pipelines._tokenizer_or_fallback) then fall back to transformers.
+tests/test_native_tokenizer.py asserts id-level parity against transformers
+on the same vocab files.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import unicodedata
+from functools import lru_cache
+from typing import List
+
+import numpy as np
+
+
+@lru_cache()
+def _bytes_to_unicode():
+    """GPT-2/CLIP byte -> printable-unicode-char table (stable, reversible)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def _normalize(text: str) -> str:
+    """CLIPTokenizerFast's normalizer sequence: NFC, \\s+ -> ' ', lowercase."""
+    import regex
+
+    return regex.sub(r"\s+", " ", unicodedata.normalize("NFC", text)).lower()
+
+
+class NativeCLIPTokenizer:
+    """Drop-in for the transformers call surface pipelines._tokenize uses:
+    ``tok(texts, padding="max_length", max_length=tok.model_max_length,
+    truncation=True, return_tensors="np")["input_ids"]``."""
+
+    model_max_length = 77
+
+    def __init__(self, tokenizer_dir: str):
+        import regex
+
+        from . import _build_bpe
+
+        vocab_path = os.path.join(tokenizer_dir, "vocab.json")
+        merges_path = os.path.join(tokenizer_dir, "merges.txt")
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[tuple] = []
+        with open(merges_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#version"):
+                    continue
+                parts = line.split()
+                if len(parts) == 2:
+                    merges.append((parts[0], parts[1]))
+
+        lib = _build_bpe()
+        if lib is None:
+            raise RuntimeError("native BPE engine unavailable (no compiler?)")
+        self._lib = lib
+        self._h = lib.bpe_new()
+        self.bos_token_id = vocab["<|startoftext|>"]
+        self.eos_token_id = vocab["<|endoftext|>"]
+        # Pad token from the snapshot, NOT assumed: SD's tokenizer/ pads with
+        # eos, but SDXL's tokenizer_2/ declares pad_token "!" (id 0) in
+        # special_tokens_map.json — pad ids feed unmasked cross-attention, so
+        # getting this wrong shifts every generated image.
+        self.pad_token_id = self.eos_token_id
+        pad_str = self._read_pad_token(tokenizer_dir)
+        # Special/added tokens are split out of the text BEFORE BPE and map
+        # to their single id with no </w> (tokenizers' added-token splitter);
+        # a pad token like SDXL tokenizer_2's "!" joins the set.
+        self._special = {
+            "<|startoftext|>": self.bos_token_id,
+            "<|endoftext|>": self.eos_token_id,
+        }
+        if pad_str is not None and pad_str in vocab:
+            self.pad_token_id = vocab[pad_str]
+            self._special[pad_str] = self.pad_token_id
+        lib.bpe_set_unk(self._h, self.eos_token_id)  # CLIP unk == eos
+        for sym, idx in vocab.items():
+            b = sym.encode("utf-8")
+            lib.bpe_add_token(self._h, b, len(b), int(idx))
+        for rank, (l, r) in enumerate(merges):
+            lb, rb = l.encode("utf-8"), r.encode("utf-8")
+            lib.bpe_add_merge(self._h, lb, len(lb), rb, len(rb), rank)
+
+        self._byte_map = _bytes_to_unicode()
+        self._pat = regex.compile(
+            r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d"
+            r"|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+",
+            regex.IGNORECASE,
+        )
+        self._added_re = regex.compile(
+            "|".join(
+                regex.escape(s)
+                for s in sorted(self._special, key=len, reverse=True)
+            )
+        )
+        self._out = (ctypes.c_int32 * 4096)()
+
+    @staticmethod
+    def _read_pad_token(tokenizer_dir: str):
+        """Pad token string from special_tokens_map.json / tokenizer_config
+        (either plain string or AddedToken dict form)."""
+        for fname in ("special_tokens_map.json", "tokenizer_config.json"):
+            path = os.path.join(tokenizer_dir, fname)
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    entry = json.load(f).get("pad_token")
+            except (OSError, ValueError):
+                continue
+            if isinstance(entry, dict):
+                entry = entry.get("content")
+            if isinstance(entry, str):
+                return entry
+        return None
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.bpe_free(h)
+
+    def _encode_word(self, word: str) -> List[int]:
+        mapped = "".join(self._byte_map[b] for b in word.encode("utf-8"))
+        # initial symbols: one per mapped char, last carries the </w> marker
+        syms = list(mapped[:-1]) + [mapped[-1] + "</w>"]
+        payload = "\x00".join(syms).encode("utf-8")
+        n = self._lib.bpe_encode_word(
+            self._h, payload, len(payload), self._out, len(self._out)
+        )
+        if n < 0:  # absurdly long word: ids would overflow the buffer
+            return [self.eos_token_id]
+        return list(self._out[:n])
+
+    def encode(self, text: str) -> List[int]:
+        """Raw BPE ids (no bos/eos framing) of one prompt."""
+        text = _normalize(text)
+        ids: List[int] = []
+        pos = 0
+        # added-token splitter: special-token literals come out whole, the
+        # text between them goes through regex pre-tokenization + BPE
+        for m in self._added_re.finditer(text):
+            for word in self._pat.findall(text[pos : m.start()]):
+                ids.extend(self._encode_word(word))
+            ids.append(self._special[m.group(0)])
+            pos = m.end()
+        for word in self._pat.findall(text[pos:]):
+            ids.extend(self._encode_word(word))
+        return ids
+
+    def __call__(self, texts, padding="max_length", max_length=None,
+                 truncation=True, return_tensors="np"):
+        max_length = max_length or self.model_max_length
+        rows = []
+        for t in texts:
+            ids = self.encode(t)
+            if truncation:
+                ids = ids[: max_length - 2]
+            row = [self.bos_token_id] + ids + [self.eos_token_id]
+            row += [self.pad_token_id] * (max_length - len(row))
+            rows.append(row[:max_length])
+        return {"input_ids": np.asarray(rows, np.int64)}

@@ -1,0 +1,125 @@
+// Native CLIP byte-pair-encoding engine.
+//
+// The reference tokenizes through HuggingFace's tokenizer stack (Rust/BPE,
+// pulled in by diffusers' from_pretrained — /root/reference/distrifuser/
+// pipelines.py:30-42).  This is the TPU build's native equivalent: the hot
+// per-word merge loop (rank lookups + pair folding, O(n^2) per word) runs in
+// C++, while Python owns the unicode-aware pre-tokenization (regex split,
+// byte->unicode mapping) and the 77-token framing.  See native/bpe.py.
+//
+// Interface (ctypes, see native/__init__.py):
+//   bpe_new()                        -> engine handle
+//   bpe_add_token(h, sym, len, id)   vocab entry: symbol bytes -> id
+//   bpe_add_merge(h, l, ll, r, rl, rank)
+//   bpe_encode_word(h, word, len, out, cap) -> n ids (or -1 on overflow)
+//     `word` is the mapped word as UTF-8 with '\x00' between the initial
+//     symbols (codepoint granularity, last symbol carrying "</w>").
+//     Unknown residual symbols fall back to `unk` (set via bpe_set_unk).
+//   bpe_free(h)
+//
+// Encoded words are memoized per engine (prompts repeat words heavily).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Engine {
+  std::unordered_map<std::string, int32_t> vocab;
+  std::unordered_map<std::string, int32_t> merge_rank;  // "l\x01r" -> rank
+  std::unordered_map<std::string, std::vector<int32_t>> cache;
+  int32_t unk = -1;
+};
+
+std::string pair_key(const std::string& l, const std::string& r) {
+  std::string k;
+  k.reserve(l.size() + r.size() + 1);
+  k += l;
+  k += '\x01';
+  k += r;
+  return k;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_new() { return new Engine(); }
+
+void bpe_free(void* h) { delete static_cast<Engine*>(h); }
+
+void bpe_set_unk(void* h, int32_t id) { static_cast<Engine*>(h)->unk = id; }
+
+void bpe_add_token(void* h, const char* sym, uint32_t len, int32_t id) {
+  static_cast<Engine*>(h)->vocab.emplace(std::string(sym, len), id);
+}
+
+void bpe_add_merge(void* h, const char* l, uint32_t ll, const char* r,
+                   uint32_t rl, int32_t rank) {
+  static_cast<Engine*>(h)->merge_rank.emplace(
+      pair_key(std::string(l, ll), std::string(r, rl)), rank);
+}
+
+int32_t bpe_encode_word(void* h, const char* word, uint32_t len, int32_t* out,
+                        int32_t cap) {
+  Engine& e = *static_cast<Engine*>(h);
+  std::string key(word, len);
+  auto hit = e.cache.find(key);
+  if (hit == e.cache.end()) {
+    // split on the '\x00' separators Python placed between initial symbols
+    std::vector<std::string> syms;
+    {
+      size_t start = 0;
+      for (size_t i = 0; i <= key.size(); ++i) {
+        if (i == key.size() || key[i] == '\0') {
+          if (i > start) syms.emplace_back(key.substr(start, i - start));
+          start = i + 1;
+        }
+      }
+    }
+    // iterative lowest-rank pair folding
+    while (syms.size() > 1) {
+      int32_t best_rank = INT32_MAX;
+      size_t best_i = 0;
+      for (size_t i = 0; i + 1 < syms.size(); ++i) {
+        auto it = e.merge_rank.find(pair_key(syms[i], syms[i + 1]));
+        if (it != e.merge_rank.end() && it->second < best_rank) {
+          best_rank = it->second;
+          best_i = i;
+        }
+      }
+      if (best_rank == INT32_MAX) break;
+      // fold every occurrence of the winning pair left-to-right
+      const std::string l = syms[best_i];
+      const std::string r = syms[best_i + 1];
+      std::vector<std::string> merged;
+      merged.reserve(syms.size());
+      for (size_t i = 0; i < syms.size();) {
+        if (i + 1 < syms.size() && syms[i] == l && syms[i + 1] == r) {
+          merged.emplace_back(l + r);
+          i += 2;
+        } else {
+          merged.emplace_back(syms[i]);
+          i += 1;
+        }
+      }
+      syms.swap(merged);
+    }
+    std::vector<int32_t> ids;
+    ids.reserve(syms.size());
+    for (const auto& s : syms) {
+      auto it = e.vocab.find(s);
+      ids.push_back(it != e.vocab.end() ? it->second : e.unk);
+    }
+    hit = e.cache.emplace(std::move(key), std::move(ids)).first;
+  }
+  const std::vector<int32_t>& ids = hit->second;
+  if (static_cast<int32_t>(ids.size()) > cap) return -1;
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int32_t>(ids.size());
+}
+
+}  // extern "C"

@@ -86,11 +86,21 @@ def _hf_tokenizer(path: str):
 
 
 def _tokenizer_or_fallback(path: str):
-    """Real CLIP tokenizer, or the hash tokenizer with a LOUD warning.
+    """Native BPE tokenizer, else transformers, else the hash tokenizer with
+    a LOUD warning.
 
-    The fallback keeps weightless smoke tests running, but on a real snapshot
-    a broken tokenizer dir would silently ruin every generated image — so the
-    degradation must never be silent."""
+    The primary is the in-repo native engine (native/bpe.py + clip_bpe.cc),
+    which reads the snapshot's vocab.json/merges.txt directly — id-level
+    parity with transformers is pinned by tests/test_native_tokenizer.py.
+    The last-resort fallback keeps weightless smoke tests running, but on a
+    real snapshot a broken tokenizer dir would silently ruin every generated
+    image — so the degradation must never be silent."""
+    try:
+        from .native.bpe import NativeCLIPTokenizer
+
+        return NativeCLIPTokenizer(path)
+    except Exception:
+        pass  # fall through to transformers (missing files error below)
     try:
         return _hf_tokenizer(path)
     except Exception as e:

@@ -1,0 +1,130 @@
+"""Native CLIP BPE tokenizer: id-level parity with transformers.
+
+The reference tokenizes via the HF tokenizer stack (diffusers
+from_pretrained); our native engine (native/clip_bpe.cc + native/bpe.py)
+reads the same snapshot vocab.json/merges.txt.  The oracle is
+`CLIPTokenizerFast` — the tokenizer diffusers actually instantiates — built
+from the SAME fabricated vocab files, so every layer is compared: regex
+pre-tokenization, byte->unicode mapping, merge order, framing, padding,
+truncation.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from distrifuser_tpu.native.bpe import NativeCLIPTokenizer, _bytes_to_unicode
+
+
+@pytest.fixture(scope="module")
+def tok_dir(tmp_path_factory):
+    """A small but fully real CLIP-format vocab: all 256 byte symbols, their
+    </w> variants, a handful of ranked merges, and the special tokens."""
+    d = tmp_path_factory.mktemp("tokenizer")
+    chars = list(_bytes_to_unicode().values())
+    vocab = {}
+    for c in chars:
+        vocab[c] = len(vocab)
+    for c in chars:
+        vocab[c + "</w>"] = len(vocab)
+    merges = [
+        ("t", "h"),
+        ("th", "e</w>"),
+        ("a", "n"),
+        ("an", "d</w>"),
+        ("i", "n</w>"),
+        ("c", "o"),
+        ("co", "l"),
+        ("o", "r</w>"),
+        ("'", "s</w>"),
+    ]
+    for l, r in merges:
+        vocab[l + r] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+
+    (d / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{l} {r}" for l, r in merges) + "\n",
+        encoding="utf-8",
+    )
+    return str(d)
+
+
+PROMPTS = [
+    "Astronaut in a jungle, cold color palette, muted colors, detailed, 8k",
+    "THE THEATER and the colors",
+    "  multiple   spaces\tand\nnewlines  ",
+    "it's the cat's color",
+    "",
+    "punctuation!!! (nested), [brackets]; #hash",
+    "digits 123 456",
+    "word " * 120,  # > 77 tokens: truncation framing must match
+    "literal <|endoftext|> inside a prompt",  # added-token splitter parity
+]
+
+
+def test_native_matches_transformers_fast(tok_dir):
+    ours = NativeCLIPTokenizer(tok_dir)
+    theirs = transformers.CLIPTokenizerFast.from_pretrained(tok_dir)
+    a = ours(PROMPTS, padding="max_length", max_length=77, truncation=True,
+             return_tensors="np")["input_ids"]
+    b = theirs(PROMPTS, padding="max_length", max_length=77, truncation=True,
+               return_tensors="np")["input_ids"]
+    np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_framing(tok_dir):
+    tok = NativeCLIPTokenizer(tok_dir)
+    ids = tok(["the"], max_length=77)["input_ids"][0]
+    assert ids[0] == tok.bos_token_id
+    assert ids[2] == tok.eos_token_id
+    assert (ids[3:] == tok.eos_token_id).all()  # pad token is eos
+    # 'the' merged fully: t+h -> th, th+e</w> -> the</w> = one id
+    assert ids[1] != tok.bos_token_id and ids[1] != tok.eos_token_id
+
+
+def test_merge_order_matters(tok_dir):
+    """'color' hits ranked merges c+o -> co, co+l -> col; the remaining
+    'o','r</w>' pair merges via o+r</w>.  Exercises the lowest-rank-first
+    loop rather than left-to-right folding."""
+    tok = NativeCLIPTokenizer(tok_dir)
+    ids = tok.encode("color")
+    with open(f"{tok_dir}/vocab.json", encoding="utf-8") as f:
+        vocab = json.load(f)
+    assert ids == [vocab["col"], vocab["or</w>"]]
+
+
+def test_pipeline_prefers_native(tok_dir):
+    from distrifuser_tpu.pipelines import _tokenizer_or_fallback
+
+    tok = _tokenizer_or_fallback(tok_dir)
+    assert isinstance(tok, NativeCLIPTokenizer)
+
+
+def test_pad_token_from_special_tokens_map(tok_dir, tmp_path):
+    """SDXL's tokenizer_2 declares pad_token '!' (id 0) — pad ids feed
+    unmasked cross-attention, so the native tokenizer must honor the
+    snapshot's declaration instead of assuming pad == eos."""
+    import shutil
+
+    d2 = tmp_path / "tokenizer_2"
+    shutil.copytree(tok_dir, d2)
+    (d2 / "special_tokens_map.json").write_text(
+        json.dumps({"pad_token": "!",
+                    "bos_token": "<|startoftext|>",
+                    "eos_token": "<|endoftext|>"})
+    )
+    ours = NativeCLIPTokenizer(str(d2))
+    with open(d2 / "vocab.json", encoding="utf-8") as f:
+        vocab = json.load(f)
+    assert ours.pad_token_id == vocab["!"]
+    theirs = transformers.CLIPTokenizerFast.from_pretrained(str(d2))
+    a = ours(PROMPTS, padding="max_length", max_length=77, truncation=True,
+             return_tensors="np")["input_ids"]
+    b = theirs(PROMPTS, padding="max_length", max_length=77, truncation=True,
+               return_tensors="np")["input_ids"]
+    np.testing.assert_array_equal(a, np.asarray(b))
