@@ -56,12 +56,16 @@ def _build() -> Optional[ctypes.CDLL]:
     if _lib is not None:
         return _lib
     lib = _compile_and_load(_SRC, _SO, "-pthread")
-    if lib is not None:
-        lib.st_open.restype = ctypes.c_void_p
-        lib.st_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
-        lib.st_prefetch.restype = ctypes.c_uint64
-        lib.st_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
-        lib.st_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    try:
+        # a stale/foreign .so may load but lack a symbol: fall back, not crash
+        if lib is not None:
+            lib.st_open.restype = ctypes.c_void_p
+            lib.st_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.st_prefetch.restype = ctypes.c_uint64
+            lib.st_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+            lib.st_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    except AttributeError:
+        lib = None
     _lib = lib
     return _lib
 
@@ -81,7 +85,9 @@ def _build_bpe() -> Optional[ctypes.CDLL]:
     if _bpe_lib is not None:
         return _bpe_lib
     lib = _compile_and_load(_BPE_SRC, _BPE_SO)
-    if lib is not None:
+    try:
+        if lib is None:
+            raise AttributeError  # no engine; cache the None below
         lib.bpe_new.restype = ctypes.c_void_p
         lib.bpe_free.argtypes = [ctypes.c_void_p]
         lib.bpe_set_unk.argtypes = [ctypes.c_void_p, ctypes.c_int32]
@@ -97,6 +103,8 @@ def _build_bpe() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
         ]
+    except AttributeError:
+        lib = None
     _bpe_lib = lib
     return _bpe_lib
 

@@ -110,6 +110,12 @@ class DistriConfig:
     # wider overlap window; turn on if an ICI profile shows per-collective
     # launch overhead dominating (~60 small collectives/step at 8-way).
     comm_batch: bool = False
+    # Sequence-parallel VAE decode over the sp axis (exact: fresh halo convs,
+    # psum'd GroupNorm, ring mid attention — models/vae.py decode_sp).  The
+    # reference decodes the full latent replicated on every rank; this is n x
+    # faster with 1/n the activation HBM.  Disable to replicate the dense
+    # decode instead.
+    vae_sp: bool = True
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)

@@ -68,6 +68,9 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--comm_batch", action="store_true",
                         help="batch stale-refresh collectives into one flat "
                         "exchange per step (analog of comm_checkpoint batching)")
+    parser.add_argument("--no_vae_sp", action="store_true",
+                        help="disable the sequence-parallel VAE decode "
+                        "(replicate the dense decode on every device instead)")
 
 
 def config_from_args(args) -> DistriConfig:
@@ -94,6 +97,7 @@ def config_from_args(args) -> DistriConfig:
         dp_degree=args.dp_degree,
         attn_impl=args.attn_impl,
         comm_batch=args.comm_batch,
+        vae_sp=not args.no_vae_sp,
     )
 
 
