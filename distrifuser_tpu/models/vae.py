@@ -30,7 +30,7 @@ from ..ops.attention import sdpa
 from ..ops.conv import _conv_valid_h, conv2d
 from ..ops.linear import linear
 from ..ops.normalization import _local_moments, group_norm
-from ..ops.ring_attention import _chunk_scores, _online_merge
+from ..ops.ring_attention import ring_pass
 from ..parallel.collectives import halo_exchange
 from ..utils.config import SP_AXIS
 
@@ -168,7 +168,9 @@ def _group_norm_sp(p, x, n, axis, *, groups, eps):
         return group_norm(p, x, groups=groups, eps=eps)
     b, h, w, c = x.shape
     m = lax.pmean(_local_moments(x, groups), axis)  # [2, B, G], equal shards
-    mean, var = m[0], m[1] - jnp.square(m[0])
+    # clamp: E[x^2]-E[x]^2 can go slightly negative from fp32 cancellation
+    # (the dense path's two-pass formula is non-negative by construction)
+    mean, var = m[0], jnp.maximum(m[1] - jnp.square(m[0]), 0.0)
     xg = x.reshape(b, h, w, groups, c // groups).astype(jnp.float32)
     y = (xg - mean[:, None, None, :, None]) * lax.rsqrt(
         var[:, None, None, :, None] + eps
@@ -203,26 +205,11 @@ def _vae_attention_sp(p, x, n, axis, groups):
     ).reshape(b, l_loc, c)
     q = linear(p["to_q"], hs)
     kv = jnp.concatenate([linear(p["to_k"], hs), linear(p["to_v"], hs)], axis=-1)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def ring(q_rows):
         """Full exact ring pass for an independent block of query rows."""
-        lq = q_rows.shape[1]
-        s, vh = _chunk_scores(q_rows, kv, 1)
-        acc = jnp.zeros((b, 1, lq, c), jnp.float32)
-        m = jnp.full((b, 1, lq, 1), -jnp.inf, jnp.float32)
-        l = jnp.zeros((b, 1, lq, 1), jnp.float32)
-        acc, m, l = _online_merge((acc, m, l), s, vh)
-
-        def body(i, carry):
-            acc, m, l, buf = carry
-            buf = lax.ppermute(buf, axis, perm=perm)
-            s, vh = _chunk_scores(q_rows, buf, 1)
-            acc, m, l = _online_merge((acc, m, l), s, vh)
-            return acc, m, l, buf
-
-        acc, m, l, _ = lax.fori_loop(0, n - 1, body, (acc, m, l, kv))
-        return (acc / l).astype(x.dtype)[:, 0]  # single head
+        out = ring_pass(q_rows, kv, kv, n, axis, heads=1)
+        return out.astype(x.dtype)[:, 0]  # single head
 
     if b * l_loc * l_loc <= _SP_CHUNK_LOGITS_ELEMS or l_loc == 1:
         out = ring(q)

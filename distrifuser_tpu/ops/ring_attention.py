@@ -59,6 +59,33 @@ def _online_merge(carry, s, vh):
     return acc, m_new, l
 
 
+def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int):
+    """The ring online-softmax driver, shared by the UNet's displaced ring
+    attention (below) and the VAE's exact sp mid attention
+    (models/vae.py): merge the own KV chunk fresh, then stream the rotating
+    buffer around the axis for n-1 hops, merging each arrival.  Returns the
+    normalized fp32 accumulator [B, heads, Lq, D] (callers cast/reshape)."""
+    b, lq, c = q.shape
+    d = c // heads
+    s, vh = _chunk_scores(q, kv_own, heads)
+    acc = jnp.zeros((b, heads, lq, d), jnp.float32)
+    m = jnp.full((b, heads, lq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, heads, lq, 1), jnp.float32)
+    acc, m, l = _online_merge((acc, m, l), s, vh)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        acc, m, l, buf = carry
+        buf = lax.ppermute(buf, axis, perm=perm)
+        s, vh = _chunk_scores(q, buf, heads)
+        acc, m, l = _online_merge((acc, m, l), s, vh)
+        return acc, m, l, buf
+
+    acc, m, l, _ = lax.fori_loop(0, n - 1, body, (acc, m, l, kv_rotating))
+    return acc / l
+
+
 def ring_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
     """Sequence-parallel self-attention with ring-streamed remote KV.
 
@@ -91,28 +118,10 @@ def ring_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
     if ctx.refresh:
         ctx.emit(name, kv_local)
 
-    # own (always fresh) contribution first
-    s, vh = _chunk_scores(q, kv_local, heads)
-    acc = jnp.zeros((b, heads, lq, d), jnp.float32)
-    m = jnp.full((b, heads, lq, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, heads, lq, 1), jnp.float32)
-    acc, m, l = _online_merge((acc, m, l), s, vh)
-
-    perm = [(i, (i + 1) % ctx.n) for i in range(ctx.n)]
-    buf = rotating
-
-    def body(i, carry):
-        # n-1 hops deliver every *peer* chunk exactly once (hop i brings the
-        # chunk of device r-i-1 mod n); the own chunk was merged fresh above
-        # and never arrives, matching attn.py:135-138.
-        acc, m, l, buf = carry
-        buf = lax.ppermute(buf, ctx.axis, perm=perm)
-        s, vh = _chunk_scores(q, buf, heads)
-        acc, m, l = _online_merge((acc, m, l), s, vh)
-        return acc, m, l, buf
-
-    acc, m, l, _ = lax.fori_loop(0, ctx.n - 1, body, (acc, m, l, buf))
-
-    out = (acc / l).astype(x.dtype)  # [B, H, Lq, D]
+    # own (always fresh) contribution merged first; then n-1 hops deliver
+    # every *peer* chunk exactly once (hop i brings the chunk of device
+    # r-i-1 mod n) — the own chunk never arrives, matching attn.py:135-138.
+    out = ring_pass(q, kv_local, rotating, ctx.n, ctx.axis, heads=heads)
+    out = out.astype(x.dtype)  # [B, H, Lq, D]
     out = out.transpose(0, 2, 1, 3).reshape(b, lq, c)
     return linear(p["to_out"], out)

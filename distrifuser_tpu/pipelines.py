@@ -183,7 +183,12 @@ class _DistriPipelineBase:
         self.text_encoders = text_encoders
         self.runner = make_runner(distri_config, unet_config, unet_params, scheduler)
         cfg = distri_config
-        if cfg.is_sp and cfg.vae_sp and cfg.latent_height % cfg.n_device_per_batch == 0:
+        # public introspection: which decode path was installed
+        self.vae_decode_parallel = (
+            cfg.is_sp and cfg.vae_sp
+            and cfg.latent_height % cfg.n_device_per_batch == 0
+        )
+        if self.vae_decode_parallel:
             # Sequence-parallel decode over the same sp axis as the UNet
             # (beyond the reference, which decodes replicated on every rank):
             # exact, n x faster, 1/n activation footprint.

@@ -13,7 +13,6 @@ diffusers composes them (diffusers itself is not installed here).
 
 import numpy as np
 import torch
-import torch.nn.functional as F
 import pytest
 
 from distrifuser_tpu.models.unet import (
@@ -23,6 +22,12 @@ from distrifuser_tpu.models.unet import (
     transformer_2d,
 )
 from distrifuser_tpu.models.weights import _convert, _fuse_kv
+
+from torch_ref import (
+    TorchBasicTransformerBlock,
+    TorchResnetBlock2D,
+    TorchTransformer2D,
+)
 
 RTOL, ATOL = 1e-4, 1e-5
 
@@ -41,91 +46,6 @@ def _assert_close(jax_out_nhwc, torch_out_nchw):
         torch_out_nchw.detach().numpy(),
         rtol=RTOL, atol=ATOL,
     )
-
-
-class TorchAttn(torch.nn.Module):
-    """diffusers Attention core: q/k/v proj, SDPA, out proj (residual lives
-    in the caller, residual_connection=False there)."""
-
-    def __init__(self, c, heads, c_enc=None, d=None):
-        super().__init__()
-        d = d or c // heads
-        inner = heads * d
-        self.heads, self.d = heads, d
-        self.to_q = torch.nn.Linear(c, inner, bias=False)
-        self.to_k = torch.nn.Linear(c_enc or c, inner, bias=False)
-        self.to_v = torch.nn.Linear(c_enc or c, inner, bias=False)
-        self.to_out = torch.nn.ModuleList([torch.nn.Linear(inner, c)])
-
-    def forward(self, x, enc=None):
-        enc = x if enc is None else enc
-        b, l, _ = x.shape
-
-        def split(t):
-            return t.view(b, -1, self.heads, self.d).transpose(1, 2)
-
-        y = F.scaled_dot_product_attention(
-            split(self.to_q(x)), split(self.to_k(enc)), split(self.to_v(enc))
-        )
-        return self.to_out[0](y.transpose(1, 2).reshape(b, l, -1))
-
-
-class TorchGEGLUFF(torch.nn.Module):
-    """diffusers FeedForward with GEGLU: net.0.proj -> chunk -> a*gelu(g) -> net.2."""
-
-    def __init__(self, c, mult=4):
-        super().__init__()
-        inner = c * mult
-        proj = torch.nn.Linear(c, inner * 2)
-        self.net = torch.nn.ModuleList(
-            [torch.nn.Module(), torch.nn.Identity(), torch.nn.Linear(inner, c)]
-        )
-        self.net[0].proj = proj
-
-    def forward(self, x):
-        a, g = self.net[0].proj(x).chunk(2, dim=-1)
-        return self.net[2](a * F.gelu(g))
-
-
-class TorchBasicTransformerBlock(torch.nn.Module):
-    """LN -> self-attn -> +res; LN -> cross-attn -> +res; LN -> FF -> +res."""
-
-    def __init__(self, c, heads, c_enc):
-        super().__init__()
-        self.norm1 = torch.nn.LayerNorm(c)
-        self.attn1 = TorchAttn(c, heads)
-        self.norm2 = torch.nn.LayerNorm(c)
-        self.attn2 = TorchAttn(c, heads, c_enc=c_enc)
-        self.norm3 = torch.nn.LayerNorm(c)
-        self.ff = TorchGEGLUFF(c)
-
-    def forward(self, x, enc):
-        x = x + self.attn1(self.norm1(x))
-        x = x + self.attn2(self.norm2(x), enc)
-        x = x + self.ff(self.norm3(x))
-        return x
-
-
-class TorchResnetBlock2D(torch.nn.Module):
-    """GN -> silu -> conv -> +time proj -> GN -> silu -> conv -> +shortcut."""
-
-    def __init__(self, cin, cout, temb_dim, groups):
-        super().__init__()
-        self.norm1 = torch.nn.GroupNorm(groups, cin)
-        self.conv1 = torch.nn.Conv2d(cin, cout, 3, padding=1)
-        self.time_emb_proj = torch.nn.Linear(temb_dim, cout)
-        self.norm2 = torch.nn.GroupNorm(groups, cout)
-        self.conv2 = torch.nn.Conv2d(cout, cout, 3, padding=1)
-        if cin != cout:
-            self.conv_shortcut = torch.nn.Conv2d(cin, cout, 1)
-
-    def forward(self, x, temb):
-        h = self.conv1(F.silu(self.norm1(x)))
-        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
-        h = self.conv2(F.silu(self.norm2(h)))
-        if hasattr(self, "conv_shortcut"):
-            x = self.conv_shortcut(x)
-        return x + h
 
 
 def _randomize_norms(module):
@@ -177,42 +97,7 @@ def test_transformer_2d_parity(use_linear):
     +residual."""
     torch.manual_seed(2)
     c, heads, c_enc, groups = 32, 4, 20, 8
-
-    class TorchTransformer2D(torch.nn.Module):
-        def __init__(self):
-            super().__init__()
-            self.norm = torch.nn.GroupNorm(groups, c, eps=1e-6)
-            if use_linear:
-                self.proj_in = torch.nn.Linear(c, c)
-                self.proj_out = torch.nn.Linear(c, c)
-            else:
-                self.proj_in = torch.nn.Conv2d(c, c, 1)
-                self.proj_out = torch.nn.Conv2d(c, c, 1)
-            self.transformer_blocks = torch.nn.ModuleList(
-                [TorchBasicTransformerBlock(c, heads, c_enc)]
-            )
-
-        def forward(self, x, enc):
-            b, _, h, w = x.shape
-            res = x
-            hs = self.norm(x)
-            if use_linear:
-                hs = hs.permute(0, 2, 3, 1).reshape(b, h * w, c)
-                hs = self.proj_in(hs)
-            else:
-                hs = self.proj_in(hs)
-                hs = hs.permute(0, 2, 3, 1).reshape(b, h * w, c)
-            for blk in self.transformer_blocks:
-                hs = blk(hs, enc)
-            if use_linear:
-                hs = self.proj_out(hs)
-                hs = hs.reshape(b, h, w, c).permute(0, 3, 1, 2)
-            else:
-                hs = hs.reshape(b, h, w, c).permute(0, 3, 1, 2)
-                hs = self.proj_out(hs)
-            return hs + res
-
-    m = TorchTransformer2D().eval()
+    m = TorchTransformer2D(c, heads, c_enc, groups, use_linear).eval()
     _randomize_norms(m)
     p = _fuse_kv(_convert(_sd(m, "t")))["t"]
     x = torch.randn(2, c, 6, 8)

@@ -87,6 +87,8 @@ def test_pipeline_uses_sp_decode(devices8):
             dcfg, ucfg, uparams, vcfg, vparams, [ccfg], [cparams],
             scheduler=get_scheduler("ddim"),
         )
+        # the parity check below is vacuous unless the branch really flips
+        assert pipe.vae_decode_parallel == vae_sp
         out = pipe(prompt="a photo", num_inference_steps=2,
                    guidance_scale=5.0, seed=0, output_type="np")
         imgs[vae_sp] = np.asarray(out.images[0])

@@ -1,0 +1,317 @@
+"""Hand-assembled torch reference modules with diffusers' composition and
+state_dict naming — the ground truth for converter/architecture parity tests
+(diffusers itself is not installed in this image; these are plain torch.nn
+recreations of its module graph, built from the published architecture).
+
+Used by tests/test_torch_parity_blocks.py (block level) and
+tests/test_torch_parity_unet.py (the full UNet2DConditionModel graph incl.
+skip-connection routing, down/upsampling placement, and time/added
+embeddings).
+"""
+
+import math
+
+import torch
+import torch.nn.functional as F
+
+
+class TorchAttn(torch.nn.Module):
+    """diffusers Attention core: q/k/v proj, SDPA, out proj (residual lives
+    in the caller, residual_connection=False there)."""
+
+    def __init__(self, c, heads, c_enc=None, d=None):
+        super().__init__()
+        d = d or c // heads
+        inner = heads * d
+        self.heads, self.d = heads, d
+        self.to_q = torch.nn.Linear(c, inner, bias=False)
+        self.to_k = torch.nn.Linear(c_enc or c, inner, bias=False)
+        self.to_v = torch.nn.Linear(c_enc or c, inner, bias=False)
+        self.to_out = torch.nn.ModuleList([torch.nn.Linear(inner, c)])
+
+    def forward(self, x, enc=None):
+        enc = x if enc is None else enc
+        b, l, _ = x.shape
+
+        def split(t):
+            return t.view(b, -1, self.heads, self.d).transpose(1, 2)
+
+        y = F.scaled_dot_product_attention(
+            split(self.to_q(x)), split(self.to_k(enc)), split(self.to_v(enc))
+        )
+        return self.to_out[0](y.transpose(1, 2).reshape(b, l, -1))
+
+
+class TorchGEGLUFF(torch.nn.Module):
+    """diffusers FeedForward with GEGLU: net.0.proj -> chunk -> a*gelu(g) -> net.2."""
+
+    def __init__(self, c, mult=4):
+        super().__init__()
+        inner = c * mult
+        proj = torch.nn.Linear(c, inner * 2)
+        self.net = torch.nn.ModuleList(
+            [torch.nn.Module(), torch.nn.Identity(), torch.nn.Linear(inner, c)]
+        )
+        self.net[0].proj = proj
+
+    def forward(self, x):
+        a, g = self.net[0].proj(x).chunk(2, dim=-1)
+        return self.net[2](a * F.gelu(g))
+
+
+class TorchBasicTransformerBlock(torch.nn.Module):
+    """LN -> self-attn -> +res; LN -> cross-attn -> +res; LN -> FF -> +res."""
+
+    def __init__(self, c, heads, c_enc):
+        super().__init__()
+        self.norm1 = torch.nn.LayerNorm(c)
+        self.attn1 = TorchAttn(c, heads)
+        self.norm2 = torch.nn.LayerNorm(c)
+        self.attn2 = TorchAttn(c, heads, c_enc=c_enc)
+        self.norm3 = torch.nn.LayerNorm(c)
+        self.ff = TorchGEGLUFF(c)
+
+    def forward(self, x, enc):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), enc)
+        x = x + self.ff(self.norm3(x))
+        return x
+
+
+class TorchTransformer2D(torch.nn.Module):
+    """Transformer2DModel wrapper: GN(eps=1e-6) -> proj_in (linear or 1x1
+    conv; flatten order differs between the modes) -> blocks -> proj_out ->
+    +residual."""
+
+    def __init__(self, c, heads, c_enc, groups, use_linear, n_layers=1):
+        super().__init__()
+        self.use_linear = use_linear
+        self.norm = torch.nn.GroupNorm(groups, c, eps=1e-6)
+        if use_linear:
+            self.proj_in = torch.nn.Linear(c, c)
+            self.proj_out = torch.nn.Linear(c, c)
+        else:
+            self.proj_in = torch.nn.Conv2d(c, c, 1)
+            self.proj_out = torch.nn.Conv2d(c, c, 1)
+        self.transformer_blocks = torch.nn.ModuleList(
+            [TorchBasicTransformerBlock(c, heads, c_enc) for _ in range(n_layers)]
+        )
+
+    def forward(self, x, enc):
+        b, c, h, w = x.shape
+        res = x
+        hs = self.norm(x)
+        if self.use_linear:
+            hs = hs.permute(0, 2, 3, 1).reshape(b, h * w, c)
+            hs = self.proj_in(hs)
+        else:
+            hs = self.proj_in(hs)
+            hs = hs.permute(0, 2, 3, 1).reshape(b, h * w, c)
+        for blk in self.transformer_blocks:
+            hs = blk(hs, enc)
+        if self.use_linear:
+            hs = self.proj_out(hs)
+            hs = hs.reshape(b, h, w, c).permute(0, 3, 1, 2)
+        else:
+            hs = hs.reshape(b, h, w, c).permute(0, 3, 1, 2)
+            hs = self.proj_out(hs)
+        return hs + res
+
+
+class TorchResnetBlock2D(torch.nn.Module):
+    """GN -> silu -> conv -> +time proj -> GN -> silu -> conv -> +shortcut."""
+
+    def __init__(self, cin, cout, temb_dim, groups):
+        super().__init__()
+        self.norm1 = torch.nn.GroupNorm(groups, cin)
+        self.conv1 = torch.nn.Conv2d(cin, cout, 3, padding=1)
+        self.time_emb_proj = torch.nn.Linear(temb_dim, cout)
+        self.norm2 = torch.nn.GroupNorm(groups, cout)
+        self.conv2 = torch.nn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.conv_shortcut = torch.nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "conv_shortcut"):
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+def torch_timestep_embedding(t, dim, flip_sin_to_cos=True, freq_shift=0,
+                             max_period=10000):
+    """diffusers get_timestep_embedding, transcribed in torch."""
+    half = dim // 2
+    exponent = -math.log(max_period) * torch.arange(half, dtype=torch.float32)
+    exponent = exponent / (half - freq_shift)
+    emb = t.float()[:, None] * torch.exp(exponent)[None, :]
+    emb = torch.cat([torch.sin(emb), torch.cos(emb)], dim=-1)
+    if flip_sin_to_cos:
+        emb = torch.cat([emb[:, half:], emb[:, :half]], dim=-1)
+    return emb
+
+
+class TorchTimestepEmbedding(torch.nn.Module):
+    def __init__(self, cin, temb_dim):
+        super().__init__()
+        self.linear_1 = torch.nn.Linear(cin, temb_dim)
+        self.linear_2 = torch.nn.Linear(temb_dim, temb_dim)
+
+    def forward(self, x):
+        return self.linear_2(F.silu(self.linear_1(x)))
+
+
+class TorchUNet(torch.nn.Module):
+    """The full UNet2DConditionModel graph for a distrifuser_tpu UNetConfig,
+    with diffusers state_dict naming throughout so convert_unet_state_dict
+    digests self.state_dict() directly."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        ch0 = cfg.block_out_channels[0]
+        temb_dim = cfg.time_embed_dim
+        groups = cfg.norm_num_groups
+        cross = cfg.cross_attention_dim
+
+        self.conv_in = torch.nn.Conv2d(cfg.in_channels, ch0, 3, padding=1)
+        self.time_embedding = TorchTimestepEmbedding(ch0, temb_dim)
+        if cfg.addition_embed_type == "text_time":
+            self.add_embedding = TorchTimestepEmbedding(
+                cfg.projection_class_embeddings_input_dim, temb_dim
+            )
+
+        def transformer(c, heads, n_layers):
+            return TorchTransformer2D(
+                c, heads, cross, groups, cfg.use_linear_projection, n_layers
+            )
+
+        self.down_blocks = torch.nn.ModuleList()
+        out_ch = ch0
+        for i, btype in enumerate(cfg.down_block_types):
+            in_ch, out_ch = out_ch, cfg.block_out_channels[i]
+            block = torch.nn.Module()
+            block.resnets = torch.nn.ModuleList(
+                [
+                    TorchResnetBlock2D(
+                        in_ch if j == 0 else out_ch, out_ch, temb_dim, groups
+                    )
+                    for j in range(cfg.layers_per_block)
+                ]
+            )
+            if btype == "CrossAttnDownBlock2D":
+                block.attentions = torch.nn.ModuleList(
+                    [
+                        transformer(out_ch, cfg.heads_for_block(i),
+                                    cfg.transformer_layers_per_block[i])
+                        for _ in range(cfg.layers_per_block)
+                    ]
+                )
+            if i < len(cfg.down_block_types) - 1:
+                ds = torch.nn.Module()
+                ds.conv = torch.nn.Conv2d(out_ch, out_ch, 3, stride=2, padding=1)
+                block.downsamplers = torch.nn.ModuleList([ds])
+            self.down_blocks.append(block)
+
+        mid_ch = cfg.block_out_channels[-1]
+        self.mid_block = torch.nn.Module()
+        self.mid_block.resnets = torch.nn.ModuleList(
+            [
+                TorchResnetBlock2D(mid_ch, mid_ch, temb_dim, groups),
+                TorchResnetBlock2D(mid_ch, mid_ch, temb_dim, groups),
+            ]
+        )
+        self.mid_block.attentions = torch.nn.ModuleList(
+            [
+                transformer(
+                    mid_ch,
+                    cfg.heads_for_block(len(cfg.block_out_channels) - 1),
+                    cfg.transformer_layers_per_block[-1],
+                )
+            ]
+        )
+
+        self.up_blocks = torch.nn.ModuleList()
+        rev = list(reversed(cfg.block_out_channels))
+        rev_tf = list(reversed(cfg.transformer_layers_per_block))
+        prev_out = rev[0]
+        for i, btype in enumerate(cfg.up_block_types):
+            out_ch = rev[i]
+            in_ch = rev[min(i + 1, len(rev) - 1)]
+            block = torch.nn.Module()
+            resnets = []
+            for j in range(cfg.layers_per_block + 1):
+                skip_ch = in_ch if j == cfg.layers_per_block else out_ch
+                res_in = prev_out if j == 0 else out_ch
+                resnets.append(
+                    TorchResnetBlock2D(res_in + skip_ch, out_ch, temb_dim, groups)
+                )
+            block.resnets = torch.nn.ModuleList(resnets)
+            if btype == "CrossAttnUpBlock2D":
+                block.attentions = torch.nn.ModuleList(
+                    [
+                        transformer(out_ch, cfg.heads_for_block(len(rev) - 1 - i),
+                                    rev_tf[i])
+                        for _ in range(cfg.layers_per_block + 1)
+                    ]
+                )
+            if i < len(cfg.up_block_types) - 1:
+                us = torch.nn.Module()
+                us.conv = torch.nn.Conv2d(out_ch, out_ch, 3, padding=1)
+                block.upsamplers = torch.nn.ModuleList([us])
+            prev_out = out_ch
+            self.up_blocks.append(block)
+
+        self.conv_norm_out = torch.nn.GroupNorm(groups, ch0)
+        self.conv_out = torch.nn.Conv2d(ch0, cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, enc, added_cond=None):
+        cfg = self.cfg
+        temb = torch_timestep_embedding(
+            timesteps, cfg.block_out_channels[0],
+            flip_sin_to_cos=cfg.flip_sin_to_cos, freq_shift=cfg.freq_shift,
+        )
+        temb = self.time_embedding(temb)
+        if cfg.addition_embed_type == "text_time":
+            b = sample.shape[0]
+            tid = torch_timestep_embedding(
+                added_cond["time_ids"].reshape(-1), cfg.addition_time_embed_dim,
+                flip_sin_to_cos=cfg.flip_sin_to_cos, freq_shift=cfg.freq_shift,
+            ).reshape(b, -1)
+            temb = temb + self.add_embedding(
+                torch.cat([added_cond["text_embeds"], tid], dim=-1)
+            )
+
+        x = self.conv_in(sample)
+        skips = [x]
+        for i, btype in enumerate(cfg.down_block_types):
+            block = self.down_blocks[i]
+            for j in range(cfg.layers_per_block):
+                x = block.resnets[j](x, temb)
+                if btype == "CrossAttnDownBlock2D":
+                    x = block.attentions[j](x, enc)
+                skips.append(x)
+            if i < len(cfg.down_block_types) - 1:
+                x = block.downsamplers[0].conv(x)
+                skips.append(x)
+
+        x = self.mid_block.resnets[0](x, temb)
+        x = self.mid_block.attentions[0](x, enc)
+        x = self.mid_block.resnets[1](x, temb)
+
+        for i, btype in enumerate(cfg.up_block_types):
+            block = self.up_blocks[i]
+            for j in range(cfg.layers_per_block + 1):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = block.resnets[j](x, temb)
+                if btype == "CrossAttnUpBlock2D":
+                    x = block.attentions[j](x, enc)
+            if i < len(cfg.up_block_types) - 1:
+                x = F.interpolate(x, scale_factor=2, mode="nearest")
+                x = block.upsamplers[0].conv(x)
+
+        assert not skips
+        x = F.silu(self.conv_norm_out(x))
+        return self.conv_out(x)
