@@ -98,12 +98,6 @@ def _vae_attention(p, x, groups):
     return x + out
 
 
-def _mid_block(p, x, groups):
-    x = _vae_resnet(p["resnets"][0], x, groups)
-    x = _vae_attention(p["attentions"][0], x, groups)
-    return _vae_resnet(p["resnets"][1], x, groups)
-
-
 def decode(params, cfg: VAEConfig, latents, *, tile: int = 0):
     """Latent [B, h, w, 4] (already divided by scaling_factor) -> image
     [B, 8h, 8w, 3] in [-1, 1].  ``tile``: latent rows per tile (0 = whole).
@@ -268,32 +262,67 @@ def decode_sp(params, cfg: VAEConfig, latents, n: int, axis: str = SP_AXIS):
     return _conv_sp(p["conv_out"], x, n, axis)
 
 
-def encode(params, cfg: VAEConfig, images, *, rng=None):
-    """Image [B, H, W, 3] in [-1,1] -> latent sample [B, H/8, W/8, 4]
-    (multiply by scaling_factor for the diffusion space)."""
+def _downsample_sp(p, x, n, axis):
+    """diffusers' VAE downsample — pad (0,1,0,1) then 3x3 stride-2 VALID —
+    on row-sharded input.  The 3-row window of the last local output row
+    reaches one row past the shard, so the halo is one-sided: one fresh row
+    from the NEXT device (the last device gets the zero bottom-pad).  Local
+    rows are even (pow-2 shard counts on pow-2 sizes), so output windows
+    never straddle two shards beyond that single row."""
+    if n == 1:
+        x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+    else:
+        _, from_next = halo_exchange(x, 1, n, axis)  # next device's top row
+        x = jnp.pad(
+            jnp.concatenate([x, from_next], axis=1), ((0, 0), (0, 0), (0, 1), (0, 0))
+        )
+    # width pad is materialized above, height rows carry the halo: a VALID
+    # stride-2 conv (shared helper, ops/conv.py)
+    return _conv_valid_h(p["conv"], x, 2, 0)
+
+
+def encode_sp(params, cfg: VAEConfig, images, n: int, axis: str = SP_AXIS,
+              *, rng=None):
+    """Sequence-parallel encode: this device's image row shard
+    [B, H/n, W, 3] -> latent row shard [B, H/8n, W/8, 4].  The mean path
+    (rng=None) is exact like decode_sp; with ``rng`` each shard samples from
+    a per-device fold of the key (statistically equivalent to, but not the
+    same draw as, the dense encode).  Rows must stay divisible by 2 per
+    downsample (H % 8n == 0)."""
     p = params["encoder"]
     groups = cfg.norm_num_groups
+    n_down = sum(1 for d in p["down_blocks"] if "downsamplers" in d)
+    assert images.shape[1] % (1 << n_down) == 0, (
+        f"local rows {images.shape[1]} not divisible by 2^{n_down} "
+        f"(need image height % {n << n_down} == 0 for {n}-way sp encode)"
+    )
+    if rng is not None and n > 1:
+        rng = jax.random.fold_in(rng, lax.axis_index(axis))
     images = images.astype(p["conv_in"]["kernel"].dtype)
-    x = conv2d(p["conv_in"], images)
+    x = _conv_sp(p["conv_in"], images, n, axis)
     for down in p["down_blocks"]:
         for rp in down["resnets"]:
-            x = _vae_resnet(rp, x, groups)
+            x = _vae_resnet_sp(rp, x, n, axis, groups)
         if "downsamplers" in down:
-            # diffusers pads (0,1,0,1) then strides 2 with VALID padding
-            x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
-            x = jax.lax.conv_general_dilated(
-                x, down["downsamplers"][0]["conv"]["kernel"], (2, 2),
-                ((0, 0), (0, 0)), dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            ) + down["downsamplers"][0]["conv"]["bias"]
-    x = _mid_block(p["mid_block"], x, groups)
-    x = silu(group_norm(p["conv_norm_out"], x, groups=groups, eps=1e-6))
-    x = conv2d(p["conv_out"], x)  # [B, h, w, 8]
-    moments = conv2d(params["quant_conv"], x)
+            x = _downsample_sp(down["downsamplers"][0], x, n, axis)
+    x = _vae_resnet_sp(p["mid_block"]["resnets"][0], x, n, axis, groups)
+    x = _vae_attention_sp(p["mid_block"]["attentions"][0], x, n, axis, groups)
+    x = _vae_resnet_sp(p["mid_block"]["resnets"][1], x, n, axis, groups)
+    x = silu(_group_norm_sp(p["conv_norm_out"], x, n, axis, groups=groups, eps=1e-6))
+    x = _conv_sp(p["conv_out"], x, n, axis)  # [B, h/n, w, 8]
+    moments = conv2d(params["quant_conv"], x)  # 1x1: local
     mean, logvar = jnp.split(moments, 2, axis=-1)
     if rng is None:
         return mean
     std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
     return mean + std * jax.random.normal(rng, mean.shape, mean.dtype)
+
+
+def encode(params, cfg: VAEConfig, images, *, rng=None):
+    """Image [B, H, W, 3] in [-1,1] -> latent sample [B, H/8, W/8, 4]
+    (multiply by scaling_factor for the diffusion space).  Dense path ==
+    encode_sp at n == 1, one encoder topology for both modes."""
+    return encode_sp(params, cfg, images, 1, rng=rng)
 
 
 # ---------------------------------------------------------------------------

@@ -60,6 +60,24 @@ def test_decode_sp_chunked_ring_matches_dense(vae, devices8, monkeypatch):
     np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("n", [2, 4])
+def test_encode_sp_matches_dense(vae, devices8, n):
+    """Encoder: one-sided downsample halo + shared sp helpers, exact."""
+    cfg, params, _ = vae
+    img = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 24, 3))
+    dense = np.asarray(vae_mod.encode(params, cfg, img))
+
+    mesh = Mesh(np.array(devices8[:n]), axis_names=("sp",))
+    out = shard_map(
+        lambda p, im: jax.lax.all_gather(
+            vae_mod.encode_sp(p, cfg, im, n, axis="sp"), "sp", axis=1, tiled=True
+        ),
+        mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(),
+        check_vma=False,
+    )(params, img)
+    np.testing.assert_allclose(np.asarray(out), dense, rtol=2e-4, atol=2e-4)
+
+
 def test_pipeline_uses_sp_decode(devices8):
     """End-to-end: the same generation with vae_sp on and off must produce
     identical images (the decode is exact), and the sp path must actually be
