@@ -1,0 +1,350 @@
+"""Functional Diffusion-Transformer (DiT / PixArt-style) in JAX.
+
+The reference framework (mit-han-lab/distrifuser) targets the SD/SDXL UNet
+only; its successor line of work (PipeFusion, arXiv 2405.14430 — PAPERS.md)
+applies patch-level *pipeline* parallelism to diffusion transformers, where
+the uniform block stack makes layer pipelining natural.  This module is the
+model side of that extension: a PixArt-alpha-style DiT (arXiv 2310.00426
+block structure: adaLN-single conditioning, self-attn -> cross-attn -> MLP)
+written the TPU way —
+
+* every block has identical shapes, so the whole stack is ONE stacked param
+  pytree with a leading ``depth`` axis, consumed by `lax.scan` (dense path)
+  or sharded over the ``sp`` mesh axis as pipeline stages
+  (parallel/pipefusion.py);
+* activations are token-major ``[B, N, hidden]``; patchify/unpatchify are
+  reshapes + one linear, so a "patch" of the image is a contiguous token
+  range — the same contract the displaced-patch UNet uses for row shards;
+* the attention core is ops.attention.sdpa (Pallas flash on TPU, chunked XLA
+  fallback elsewhere); K/V projections are fused into one matmul.
+
+The block math (t2i modulation) follows the PixArt-alpha paper: with
+``(s1, sc1, g1, s2, sc2, g2) = table + adaln(t)`` per block,
+
+    x = x + g1 * attn(ln(x) * (1 + sc1) + s1)
+    x = x + cross_attn(x, text)
+    x = x + g2 * mlp(ln(x) * (1 + sc2) + s2)
+
+and the final layer applies ``ln(x) * (1 + sc) + s`` from a 2-entry table
+before the linear projection to patch pixels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import sdpa
+from ..ops.linear import linear
+
+silu = jax.nn.silu
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DiTConfig:
+    """Static architecture description (PixArt-alpha-style DiT)."""
+
+    sample_size: int = 128          # latent H = W (1024 px / 8)
+    patch_size: int = 2
+    in_channels: int = 4
+    out_channels: int = 4           # epsilon only (learned-sigma heads unused)
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: int = 4
+    caption_dim: int = 2048         # text-encoder hidden size fed to cross-attn
+    frequency_embedding_size: int = 256
+
+    @property
+    def tokens_per_side(self) -> int:
+        return self.sample_size // self.patch_size
+
+    @property
+    def num_tokens(self) -> int:
+        return self.tokens_per_side ** 2
+
+    @property
+    def token_dim(self) -> int:
+        """Pixels carried by one token of the patchified latent."""
+        return self.patch_size * self.patch_size * self.in_channels
+
+    @property
+    def token_out_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.out_channels
+
+    def __post_init__(self):
+        if self.sample_size % self.patch_size != 0:
+            raise ValueError("sample_size must be divisible by patch_size")
+        if self.hidden_size % self.num_heads != 0:
+            raise ValueError("hidden_size must be divisible by num_heads")
+
+
+def pixart_config() -> DiTConfig:
+    """PixArt-alpha-XL/2-1024 geometry (caption_dim kept at the CLIP-bigG
+    width so the in-repo text encoders drive it; PixArt itself uses T5)."""
+    return DiTConfig()
+
+
+def tiny_dit_config(depth: int = 8) -> DiTConfig:
+    """Small config for tests: real structure, toy widths."""
+    return DiTConfig(
+        sample_size=16,
+        patch_size=2,
+        hidden_size=64,
+        depth=depth,
+        num_heads=4,
+        mlp_ratio=2,
+        caption_dim=32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _init_linear(key, d_in, d_out, dtype):
+    k1, _ = jax.random.split(key)
+    scale = 1.0 / math.sqrt(d_in)
+    return {
+        "kernel": jax.random.uniform(k1, (d_in, d_out), dtype, -scale, scale),
+        "bias": jnp.zeros((d_out,), dtype),
+    }
+
+
+def _init_block(key, cfg: DiTConfig, dtype):
+    h = cfg.hidden_size
+    keys = jax.random.split(key, 8)
+    return {
+        "scale_shift_table": jax.random.normal(keys[0], (6, h), dtype) / h**0.5,
+        "attn_q": _init_linear(keys[1], h, h, dtype),
+        "attn_kv": _init_linear(keys[2], h, 2 * h, dtype),
+        "attn_out": _init_linear(keys[3], h, h, dtype),
+        "cross_q": _init_linear(keys[4], h, h, dtype),
+        "cross_kv": _init_linear(keys[5], h, 2 * h, dtype),
+        "cross_out": _init_linear(keys[6], h, h, dtype),
+        "mlp_fc1": _init_linear(keys[7], h, cfg.mlp_ratio * h, dtype),
+        "mlp_fc2": _init_linear(jax.random.fold_in(key, 99), cfg.mlp_ratio * h, h, dtype),
+    }
+
+
+def init_dit_params(key, cfg: DiTConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    """Random-init parameter pytree.
+
+    ``blocks`` leaves carry a leading ``[depth]`` axis (stacked uniform
+    blocks) — the layout `lax.scan` consumes directly and the pipefusion
+    runner shards over the ``sp`` axis.
+    """
+    h = cfg.hidden_size
+    keys = jax.random.split(key, 8)
+    block_keys = jax.random.split(keys[7], cfg.depth)
+    blocks = jax.vmap(lambda k: _init_block(k, cfg, dtype))(block_keys)
+    return {
+        "proj_in": _init_linear(keys[0], cfg.token_dim, h, dtype),
+        "t_fc1": _init_linear(keys[1], cfg.frequency_embedding_size, h, dtype),
+        "t_fc2": _init_linear(keys[2], h, h, dtype),
+        "adaln": _init_linear(keys[3], h, 6 * h, dtype),
+        "cap_fc1": _init_linear(keys[4], cfg.caption_dim, h, dtype),
+        "cap_fc2": _init_linear(keys[5], h, h, dtype),
+        "final_table": jax.random.normal(keys[6], (2, h), dtype) / h**0.5,
+        "final_out": _init_linear(jax.random.fold_in(keys[6], 1), h,
+                                  cfg.token_out_dim, dtype),
+        "blocks": blocks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Pieces shared by the dense forward and the pipeline runner
+# ---------------------------------------------------------------------------
+
+
+def patchify(cfg: DiTConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """NHWC latent [B, H, W, C] -> tokens [B, N, ps*ps*C], row-major over the
+    token grid so a contiguous token range is a horizontal image band."""
+    b, hgt, wid, c = x.shape
+    ps = cfg.patch_size
+    x = x.reshape(b, hgt // ps, ps, wid // ps, ps, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (hgt // ps) * (wid // ps), ps * ps * c)
+
+
+def unpatchify(cfg: DiTConfig, tokens: jnp.ndarray, channels: int) -> jnp.ndarray:
+    """tokens [B, N, ps*ps*C] -> NHWC [B, H, W, C]."""
+    b, n, _ = tokens.shape
+    ps = cfg.patch_size
+    side_w = cfg.tokens_per_side
+    side_h = n // side_w
+    x = tokens.reshape(b, side_h, side_w, ps, ps, channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, side_h * ps, side_w * ps, channels)
+
+
+def pos_embed_table(cfg: DiTConfig, dtype=jnp.float32) -> jnp.ndarray:
+    """2D sin-cos position table [N, hidden] (DiT convention: half the
+    channels encode the row coordinate, half the column)."""
+    h = cfg.hidden_size
+    side = cfg.tokens_per_side
+    dim = h // 2
+
+    def axis_embed(pos, dim):
+        omega = jnp.arange(dim // 2, dtype=jnp.float32)
+        omega = 1.0 / (10000.0 ** (omega / (dim // 2)))
+        out = pos[:, None] * omega[None, :]
+        return jnp.concatenate([jnp.sin(out), jnp.cos(out)], axis=-1)
+
+    coords = jnp.arange(side, dtype=jnp.float32)
+    row = axis_embed(coords, dim)  # [side, dim]
+    col = axis_embed(coords, dim)
+    grid_row = jnp.repeat(row, side, axis=0)            # [N, dim]
+    grid_col = jnp.tile(col, (side, 1))                 # [N, dim]
+    return jnp.concatenate([grid_row, grid_col], axis=-1).astype(dtype)
+
+
+def timestep_embedding(cfg: DiTConfig, t: jnp.ndarray) -> jnp.ndarray:
+    """Sinusoidal timestep features [freq_dim] (DiT convention)."""
+    half = cfg.frequency_embedding_size // 2
+    freqs = jnp.exp(
+        -math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = t.astype(jnp.float32) * freqs
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def t_embed(params, cfg: DiTConfig, t: jnp.ndarray) -> jnp.ndarray:
+    """Timestep -> conditioning vector [hidden]."""
+    f = timestep_embedding(cfg, t).astype(params["t_fc1"]["kernel"].dtype)
+    return linear(params["t_fc2"], silu(linear(params["t_fc1"], f)))
+
+
+def caption_project(params, enc: jnp.ndarray) -> jnp.ndarray:
+    """Text-encoder states [B, Lt, caption_dim] -> [B, Lt, hidden]."""
+    return linear(
+        params["cap_fc2"],
+        jax.nn.gelu(linear(params["cap_fc1"], enc), approximate=True),
+    )
+
+
+def adaln_table(params, cfg: DiTConfig, temb: jnp.ndarray) -> jnp.ndarray:
+    """Global adaLN-single output for one timestep embedding: [6, hidden]."""
+    return linear(params["adaln"], silu(temb)).reshape(6, cfg.hidden_size)
+
+
+def _ln(x):
+    """LayerNorm without learnable affine (the modulation supplies it)."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + 1e-6)).astype(x.dtype)
+
+
+def precompute_caption_kv(params, cfg: DiTConfig, enc: jnp.ndarray) -> jnp.ndarray:
+    """Per-block cross-attention K/V, computed once per generation:
+    [depth, B, Lt, 2*hidden].  The text tokens are constant across the
+    denoise loop (same reasoning as the UNet's precompute_text_kv)."""
+    y = caption_project(params, enc)
+    return jax.vmap(lambda kvp: linear(kvp, y))(params["blocks"]["cross_kv"])
+
+
+def dit_block(
+    bp: Dict[str, Any],
+    cfg: DiTConfig,
+    x: jnp.ndarray,            # [B, Lq, hidden] — the tokens this call computes
+    c6: jnp.ndarray,           # [6, hidden] adaLN-single for this timestep
+    cap_kv: jnp.ndarray,       # [B, Lt, 2*hidden] precomputed text K/V
+    self_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    patch_start: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One transformer block.
+
+    Dense mode (``self_kv is None``): self-attention over ``x`` itself.
+    Pipeline mode: ``self_kv = (K, V)`` is the full-sequence stale cache
+    ``[B, N, hidden]``; this call's fresh K/V overwrite the ``Lq`` rows at
+    ``patch_start`` before attending (PipeFusion's newest-available KV), and
+    are returned so the runner can commit them to the carried cache.
+    """
+    table = bp["scale_shift_table"]  # [6, hidden]
+    mods = table[None] + c6[None]    # [1, 6, hidden] broadcast over batch
+    s1, sc1, g1, s2, sc2, g2 = [mods[:, i][:, None, :] for i in range(6)]
+
+    hn = _ln(x) * (1.0 + sc1) + s1
+    q = linear(bp["attn_q"], hn)
+    kv = linear(bp["attn_kv"], hn)
+    k, v = jnp.split(kv, 2, axis=-1)
+    if self_kv is None:
+        full_k, full_v = k, v
+    else:
+        full_k = lax.dynamic_update_slice(self_kv[0], k, (0, patch_start, 0))
+        full_v = lax.dynamic_update_slice(self_kv[1], v, (0, patch_start, 0))
+    att = sdpa(q, full_k, full_v, heads=cfg.num_heads)
+    x = x + g1 * linear(bp["attn_out"], att)
+
+    cq = linear(bp["cross_q"], x)
+    ck, cv = jnp.split(cap_kv, 2, axis=-1)
+    x = x + linear(bp["cross_out"], sdpa(cq, ck, cv, heads=cfg.num_heads))
+
+    hn2 = _ln(x) * (1.0 + sc2) + s2
+    x = x + g2 * linear(
+        bp["mlp_fc2"], jax.nn.gelu(linear(bp["mlp_fc1"], hn2), approximate=True)
+    )
+    return x, (k, v)
+
+
+def final_layer(params, cfg: DiTConfig, x: jnp.ndarray, temb: jnp.ndarray) -> jnp.ndarray:
+    """Final modulated projection: [B, L, hidden] -> [B, L, ps*ps*out_ch].
+
+    Modulation = learned 2-entry table + the timestep embedding (PixArt's
+    T2IFinalLayer shape: table-plus-conditioning, no extra projection).
+    """
+    mods = params["final_table"] + temb[None]        # [2, hidden]
+    shift, scale = mods[0][None, None], mods[1][None, None]
+    h = _ln(x) * (1.0 + scale) + shift
+    return linear(params["final_out"], h)
+
+
+def embed_tokens(params, cfg: DiTConfig, tokens: jnp.ndarray,
+                 pos: jnp.ndarray) -> jnp.ndarray:
+    """Patchified latent tokens [B, L, ps*ps*C] (+ their pos rows [L, hidden])
+    -> block-space activations."""
+    return linear(params["proj_in"], tokens) + pos[None].astype(tokens.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense forward (single device / full sequence)
+# ---------------------------------------------------------------------------
+
+
+def dit_forward(
+    params: Dict[str, Any],
+    cfg: DiTConfig,
+    x: jnp.ndarray,                  # [B, H, W, C] NHWC latent
+    t: jnp.ndarray,                  # scalar timestep
+    enc: jnp.ndarray,                # [B, Lt, caption_dim]
+    cap_kv: Optional[jnp.ndarray] = None,   # [depth, B, Lt, 2*hidden]
+) -> jnp.ndarray:
+    """Full DiT evaluation; returns the epsilon prediction as NHWC."""
+    tokens = patchify(cfg, x).astype(params["proj_in"]["kernel"].dtype)
+    pos = pos_embed_table(cfg, tokens.dtype)
+    h = embed_tokens(params, cfg, tokens, pos)
+    temb = t_embed(params, cfg, t)
+    c6 = adaln_table(params, cfg, temb)
+    if cap_kv is None:
+        cap_kv = precompute_caption_kv(params, cfg, enc)
+
+    def body(hc, xs):
+        bp, kv = xs
+        out, _ = dit_block(bp, cfg, hc, c6, kv)
+        return out, None
+
+    h, _ = lax.scan(body, h, (params["blocks"], cap_kv))
+    out_tokens = final_layer(params, cfg, h, temb)
+    return unpatchify(cfg, out_tokens.astype(jnp.float32), cfg.out_channels)

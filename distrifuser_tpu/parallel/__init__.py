@@ -9,4 +9,8 @@ def __getattr__(name):
         from . import runner
 
         return getattr(runner, name)
+    if name == "PipeFusionRunner":
+        from . import pipefusion
+
+        return pipefusion.PipeFusionRunner
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

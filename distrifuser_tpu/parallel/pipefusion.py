@@ -1,0 +1,414 @@
+"""Patch-level pipeline parallelism (PipeFusion) as one XLA program.
+
+The displaced-patch runner (parallel/runner.py) keeps every weight on every
+device and shards the *sequence*; this runner shards the *depth*: the DiT's
+stacked blocks are split over the ``sp`` mesh axis into P pipeline stages,
+and the image's M token-chunks ("patches") stream through the stages like
+micro-batches — patch-level pipeline parallelism for diffusion transformers
+(PipeFusion, arXiv 2405.14430; PAPERS.md).  Weights per device shrink to
+``depth/P`` blocks, and the per-hop traffic is ONE activation chunk
+``[B, N/M, hidden]`` between mesh neighbors per tick — O(L/M) point-to-point
+instead of the O(L) all-gather the displaced-patch layout refreshes.
+
+Staleness makes the pipeline dense: a patch's self-attention at stage p
+attends over the full sequence using each block's carried KV cache, where
+its own rows are fresh-this-tick and other patches' rows are
+newest-available (fresh-this-step for patches already through stage p this
+step, previous-step otherwise) — the same input-temporal-redundancy argument
+as DistriFusion's displaced patches, applied along the depth axis.
+
+Schedule (steady state, item q = (step - warmup)*M + patch):
+* stage p computes item q at tick ``q + p``; a ring `ppermute` hands its
+  output to stage p+1 for tick q+p+1;
+* stage P-1's output is the epsilon chunk; the same ring delivers it to
+  stage 0 at tick ``q + P``, which CFG-combines it (all_gather over the
+  ``cfg`` axis), scheduler-steps that patch's latent rows, and — in the very
+  same tick with M == P — embeds the patch for its next step.  ``M >= P`` is
+  exactly the condition that the refreshed latent is ready when re-embedding
+  needs it.
+* Warmup steps (reference counter <= warmup_steps semantics) run the full
+  sequence as ONE mega-patch through the pipeline — serial across stages but
+  numerically exact, and each stage's pass leaves fresh full-sequence KV in
+  its caches, so the first displaced item is one-step-stale, never colder.
+
+Everything — warmup, steady ticks, drain — is two `lax.scan`s inside one
+`shard_map`/`jit` program over the (dp, cfg, sp) mesh; there is no host
+round-trip per tick.  The per-tick KV commit is a `dynamic_update_slice`
+into the scan carry, which XLA aliases in place.
+
+Composition: the ``cfg`` axis still batch-parallelizes classifier-free
+guidance (epsilon chunks are gathered and combined at stage 0), ``dp`` still
+shards independent images, and the scheduler family (DDIM/Euler/DPM++ 2M)
+steps patch-wise — its state is carried stacked per patch so DPM's
+cross-step scalars stay correct while patches of adjacent steps interleave.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models import dit as dit_mod
+from ..models.dit import DiTConfig
+from ..ops.linear import linear
+from ..schedulers import BaseScheduler
+from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
+
+
+def _tree_dynamic_index(tree, i):
+    return jax.tree.map(
+        lambda l: lax.dynamic_index_in_dim(l, i, axis=0, keepdims=False), tree
+    )
+
+
+def _tree_dynamic_update(tree, sub, i, pred):
+    """Write ``sub`` at index ``i`` of stacked ``tree`` where ``pred``."""
+
+    def upd(l, s):
+        new = lax.dynamic_update_index_in_dim(l, s.astype(l.dtype), i, axis=0)
+        return jnp.where(pred, new, l)
+
+    return jax.tree.map(upd, tree, sub)
+
+
+class PipeFusionRunner:
+    """Compiled PipeFusion generation loop for a DiT.
+
+    API mirrors DenoiseRunner.generate: latents/enc in, final latent out,
+    every device returning the full denoised latent.
+    """
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        dit_config: DiTConfig,
+        params,
+        scheduler: BaseScheduler,
+        pipe_patches: Optional[int] = None,
+    ):
+        self.cfg = distri_config
+        self.dcfg = dit_config
+        self.params = params
+        self.scheduler = scheduler
+        cfg, dcfg = distri_config, dit_config
+        self.stages = cfg.n_device_per_batch
+        self.patches = pipe_patches or max(self.stages, 1)
+        n_tok = dcfg.num_tokens
+        if dcfg.depth % self.stages != 0:
+            raise ValueError(
+                f"DiT depth {dcfg.depth} must divide evenly into "
+                f"{self.stages} pipeline stages"
+            )
+        if self.patches < self.stages:
+            raise ValueError(
+                f"pipe_patches ({self.patches}) must be >= pipeline stages "
+                f"({self.stages}): the scheduler refresh of a patch returns to "
+                "stage 0 exactly P ticks after it left, so fewer patches than "
+                "stages would re-embed a latent that is not yet stepped"
+            )
+        if n_tok % self.patches != 0:
+            raise ValueError(
+                f"token count {n_tok} must be divisible by pipe_patches "
+                f"({self.patches})"
+            )
+        if dcfg.hidden_size < dcfg.token_out_dim:
+            raise ValueError(
+                "hidden_size must be >= patch_size^2*out_channels so the "
+                "epsilon chunk rides the activation ring payload"
+            )
+        if (cfg.height // 8 != dcfg.sample_size) or (cfg.width // 8 != dcfg.sample_size):
+            raise ValueError(
+                f"DistriConfig {cfg.height}x{cfg.width} implies latent "
+                f"{cfg.latent_height}, but DiTConfig.sample_size is "
+                f"{dcfg.sample_size} (square latents only for the DiT)"
+            )
+        self._compiled: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    # pieces
+    # ------------------------------------------------------------------
+
+    def _branch_enc(self, enc):
+        """Select this device's CFG branch of the text encoding [2, B, Lt, D]
+        (same contract as DenoiseRunner._branch_inputs)."""
+        cfg = self.cfg
+        if cfg.cfg_split:
+            br = lax.axis_index(CFG_AXIS)
+            return jnp.take(enc, br, axis=0)
+        if cfg.do_classifier_free_guidance:
+            return enc.reshape(-1, *enc.shape[2:])  # fold branches into batch
+        return enc[0]
+
+    def _combine_eps(self, eps, gs, batch):
+        """Guided epsilon from per-branch epsilon (chunk or full)."""
+        cfg = self.cfg
+        if cfg.cfg_split:
+            both = lax.all_gather(eps, CFG_AXIS)  # [2, B, L, D]
+            u, c = both[0], both[1]
+            return u + gs * (c - u)
+        if cfg.do_classifier_free_guidance:
+            u, c = eps[:batch], eps[batch:]
+            return u + gs * (c - u)
+        return eps
+
+    def _run_stage(self, blocks_local, cap_kv_local, kv_cache, h, c6, offset, valid):
+        """Run this device's Lp blocks on ``h`` [B, Lq, hid] against the
+        full-sequence stale caches; returns (h_out, committed kv_cache)."""
+
+        def body(carry, xs):
+            hcur = carry
+            bp, ckv, cache = xs
+            h_out, (k_new, v_new) = dit_mod.dit_block(
+                bp, self.dcfg, hcur, c6, ckv,
+                self_kv=(cache[0], cache[1]), patch_start=offset,
+            )
+            return h_out, jnp.stack([k_new, v_new])
+
+        h_out, fresh = lax.scan(body, h, (blocks_local, cap_kv_local, kv_cache))
+        # fresh: [Lp, 2, B, Lq, hid] -> commit at the patch rows
+        committed = lax.dynamic_update_slice(
+            kv_cache, fresh.astype(kv_cache.dtype), (0, 0, 0, offset, 0)
+        )
+        kv_cache = jnp.where(valid, committed, kv_cache)
+        return h_out, kv_cache
+
+    # ------------------------------------------------------------------
+    # the device program
+    # ------------------------------------------------------------------
+
+    def _device_loop(self, params, latents, enc, gs, num_steps):
+        cfg, dcfg = self.cfg, self.dcfg
+        sched = self.scheduler
+        n_stage = self.stages
+        n_patch = self.patches
+        n_tok = dcfg.num_tokens
+        chunk = n_tok // n_patch
+        hid = dcfg.hidden_size
+        d_out = dcfg.token_out_dim
+        p_idx = lax.axis_index(SP_AXIS)
+        is_first = p_idx == 0
+        is_last = p_idx == n_stage - 1
+
+        my_enc = self._branch_enc(enc)
+        batch = latents.shape[0]
+        bloc = my_enc.shape[0]  # batch inside the pipeline (2B when folded)
+
+        compute_dtype = params["proj_in"]["kernel"].dtype
+        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))  # [B, N, D_in]
+        pos = dit_mod.pos_embed_table(dcfg, compute_dtype)
+
+        blocks_local = params["blocks"]  # leaves [Lp, ...] (sharded over sp)
+        y_cap = dit_mod.caption_project(params, my_enc)  # loop-invariant
+        cap_kv_local = jax.vmap(lambda kvp: linear(kvp, y_cap))(
+            blocks_local["cross_kv"]
+        )  # [Lp, Bl, Lt, 2*hid]
+
+        ts = sched.timesteps()
+        temb_all = jax.vmap(lambda t: dit_mod.t_embed(params, dcfg, t))(ts)  # [T, hid]
+        c6_all = jax.vmap(lambda e: dit_mod.adaln_table(params, dcfg, e))(temb_all)
+
+        l_per = dcfg.depth // n_stage
+        kv_cache = jnp.zeros((l_per, 2, bloc, n_tok, hid), compute_dtype)
+
+        # scheduler state stacked per patch (DPM's scalars must advance with
+        # each patch's own step sequence while steps interleave in flight)
+        sstate = jax.vmap(
+            lambda _: sched.init_state((batch, chunk, x.shape[-1]))
+        )(jnp.arange(n_patch))
+
+        def embed_chunk(x_full, m, s):
+            """Patch m of the latent, scaled + embedded for step s."""
+            rows = lax.dynamic_slice(
+                x_full, (0, m * chunk, 0), (batch, chunk, x.shape[-1])
+            )
+            rows = sched.scale_model_input(rows, s)
+            tok = rows.astype(compute_dtype)
+            if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+                tok = jnp.concatenate([tok, tok], axis=0)
+            pos_rows = lax.dynamic_slice(pos, (m * chunk, 0), (chunk, hid))
+            return dit_mod.embed_tokens(params, dcfg, tok, pos_rows)
+
+        def sched_patch(x_full, sstate, eps_guided, m, s, pred):
+            """Scheduler-step patch m's rows with its stacked state slice."""
+            rows = lax.dynamic_slice(
+                x_full, (0, m * chunk, 0), (batch, chunk, x.shape[-1])
+            )
+            st = _tree_dynamic_index(sstate, m)
+            new_rows, new_st = sched.step(rows, eps_guided.astype(jnp.float32), s, st)
+            x_new = lax.dynamic_update_slice(
+                x_full, new_rows.astype(x_full.dtype), (0, m * chunk, 0)
+            )
+            x_full = jnp.where(pred, x_new, x_full)
+            sstate = _tree_dynamic_update(sstate, new_st, m, pred)
+            return x_full, sstate
+
+        n_sync = min(cfg.warmup_steps + 1, num_steps)
+
+        # ---------------- phase 1: synchronous mega-patch warmup ----------
+        def warmup_tick(carry, tau):
+            x_full, sstate, kv_cache, ring = carry
+            active = tau % n_stage
+            s = tau // n_stage  # step being fed through the pipeline
+
+            # stage-0 receive: epsilon of step s-1 completes as step s starts
+            eps_full = ring[..., :d_out]
+            guided = self._combine_eps(eps_full, gs, batch)
+            do_recv = is_first & (active == 0) & (s >= 1) & (s <= num_steps)
+
+            def step_all(args):
+                x_full, sstate = args
+                xs = x_full.reshape(batch, n_patch, chunk, -1).transpose(1, 0, 2, 3)
+                gch = guided.reshape(batch, n_patch, chunk, -1).transpose(1, 0, 2, 3)
+                new_xs, new_st = jax.vmap(
+                    lambda xr, gr, st: sched.step(xr, gr, s - 1, st)
+                )(xs, gch, sstate)
+                x_new = new_xs.transpose(1, 0, 2, 3).reshape(x_full.shape)
+                return x_new.astype(x_full.dtype), jax.tree.map(
+                    lambda a, b: b.astype(a.dtype), sstate, new_st
+                )
+
+            x_new, st_new = step_all((x_full, sstate))
+            x_full = jnp.where(do_recv, x_new, x_full)
+            sstate = jax.tree.map(
+                lambda old, new: jnp.where(do_recv, new, old), sstate, st_new
+            )
+
+            # stage-0 embed of step s (only when a fresh step enters)
+            s_c = jnp.clip(s, 0, num_steps - 1)
+            x_in = sched.scale_model_input(x_full, s_c).astype(compute_dtype)
+            if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+                x_in = jnp.concatenate([x_in, x_in], axis=0)
+            h0 = dit_mod.embed_tokens(params, dcfg, x_in, pos)
+
+            h_in = jnp.where(is_first, h0, ring.astype(compute_dtype))
+            valid = (p_idx == active) & (s < n_sync)
+            c6 = c6_all[s_c]
+            h_out, kv_cache = self._run_stage(
+                blocks_local, cap_kv_local, kv_cache, h_in, c6, 0, valid
+            )
+
+            eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_c])
+            pad = jnp.zeros((bloc, n_tok, hid - d_out), eps_out.dtype)
+            payload = jnp.where(
+                is_last, jnp.concatenate([eps_out, pad], axis=-1), h_out
+            )
+            ring = lax.ppermute(
+                payload, SP_AXIS,
+                [(i, (i + 1) % n_stage) for i in range(n_stage)],
+            )
+            return (x_full, sstate, kv_cache, ring), None
+
+        ring0 = jnp.zeros((bloc, n_tok, hid), compute_dtype)
+        carry = (x, sstate, kv_cache, ring0)
+        n_warm_ticks = n_sync * n_stage + 1
+        carry, _ = lax.scan(warmup_tick, carry, jnp.arange(n_warm_ticks))
+        x, sstate, kv_cache, _ = carry
+
+        if n_sync >= num_steps:
+            x_full = lax.psum(jnp.where(is_first, x, 0.0), SP_AXIS)
+            return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
+
+        # ---------------- phase 2: displaced patch streaming --------------
+        n_items = (num_steps - n_sync) * n_patch
+
+        def steady_tick(carry, tau):
+            x_full, sstate, kv_cache, ring = carry
+
+            # stage-0 receive: epsilon chunk of item tau - n_stage
+            q_arr = tau - n_stage
+            ok_arr = (q_arr >= 0) & (q_arr < n_items)
+            q_arr_c = jnp.clip(q_arr, 0, n_items - 1)
+            s_arr = n_sync + q_arr_c // n_patch
+            m_arr = q_arr_c % n_patch
+            eps_chunk = ring[..., :d_out]
+            guided = self._combine_eps(eps_chunk, gs, batch)
+            x_full, sstate = sched_patch(
+                x_full, sstate, guided, m_arr, s_arr, is_first & ok_arr
+            )
+
+            # stage-0 embed: item tau enters the pipeline
+            q_in = jnp.clip(tau, 0, n_items - 1)
+            s_in = n_sync + q_in // n_patch
+            m_in = q_in % n_patch
+            h0 = embed_chunk(x_full, m_in, s_in)
+
+            h_in = jnp.where(is_first, h0, ring.astype(compute_dtype))
+
+            # my item this tick
+            q_my = tau - p_idx
+            ok_my = (q_my >= 0) & (q_my < n_items)
+            q_my_c = jnp.clip(q_my, 0, n_items - 1)
+            s_my = n_sync + q_my_c // n_patch
+            m_my = q_my_c % n_patch
+            c6 = c6_all[s_my]
+            h_out, kv_cache = self._run_stage(
+                blocks_local, cap_kv_local, kv_cache, h_in, c6,
+                m_my * chunk, ok_my,
+            )
+
+            eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_my])
+            pad = jnp.zeros((bloc, chunk, hid - d_out), eps_out.dtype)
+            payload = jnp.where(
+                is_last, jnp.concatenate([eps_out, pad], axis=-1), h_out
+            )
+            ring = lax.ppermute(
+                payload, SP_AXIS,
+                [(i, (i + 1) % n_stage) for i in range(n_stage)],
+            )
+            return (x_full, sstate, kv_cache, ring), None
+
+        ring0 = jnp.zeros((bloc, chunk, hid), compute_dtype)
+        carry = (x, sstate, kv_cache, ring0)
+        carry, _ = lax.scan(
+            steady_tick, carry, jnp.arange(n_items + n_stage)
+        )
+        x, _, _, _ = carry
+
+        x_full = lax.psum(jnp.where(is_first, x, 0.0), SP_AXIS)
+        return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def _build(self, num_steps: int):
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps)
+
+        block_specs = jax.tree.map(lambda _: P(SP_AXIS), self.params["blocks"])
+        param_specs = {
+            k: (block_specs if k == "blocks" else jax.tree.map(lambda _: P(), v))
+            for k, v in self.params.items()
+        }
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+
+        def loop(params, latents, enc, gs):
+            return shard_map(
+                device_loop,
+                mesh=cfg.mesh,
+                in_specs=(param_specs, lat_spec, enc_spec, P()),
+                out_specs=lat_spec,
+                check_vma=False,
+            )(params, latents, enc, gs)
+
+        return jax.jit(loop)
+
+    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20):
+        """latents [B, H/8, W/8, C] fp32, enc [2, B, Lt, caption_dim]
+        (uncond, cond branch-major, like DenoiseRunner).  Returns the final
+        latent, full on every device."""
+        # Re-pin the scheduler tables every call: a cached program can
+        # re-trace later and must not read tables left by a different step
+        # count (see DenoiseRunner.generate).
+        self.scheduler.set_timesteps(num_inference_steps)
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(num_inference_steps)
+        gs = jnp.asarray(guidance_scale, jnp.float32)
+        return self._compiled[num_inference_steps](self.params, latents, enc, gs)

@@ -569,6 +569,11 @@ class DenoiseRunner:
                 jnp.asarray(guidance_scale, jnp.float32),
                 num_inference_steps,
             )
+        # Re-pin the scheduler tables on every call, not just at build time:
+        # a cached jitted loop can RE-trace later (new input shapes), and the
+        # trace reads the mutable scheduler — which a generate() with a
+        # different step count may have re-tabled in between.
+        self.scheduler.set_timesteps(num_inference_steps)
         if num_inference_steps not in self._compiled:
             self._compiled[num_inference_steps] = self._build(num_inference_steps)
         fn = self._compiled[num_inference_steps]
