@@ -56,6 +56,7 @@ from jax.sharding import PartitionSpec as P
 from ..models import dit as dit_mod
 from ..models.dit import DiTConfig
 from ..ops.linear import linear
+from .guidance import branch_select, combine_guidance
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 
@@ -97,7 +98,7 @@ class PipeFusionRunner:
         self.scheduler = scheduler
         cfg, dcfg = distri_config, dit_config
         self.stages = cfg.n_device_per_batch
-        self.patches = pipe_patches or max(self.stages, 1)
+        self.patches = self.stages if pipe_patches is None else pipe_patches
         n_tok = dcfg.num_tokens
         if dcfg.depth % self.stages != 0:
             raise ValueError(
@@ -136,25 +137,12 @@ class PipeFusionRunner:
     def _branch_enc(self, enc):
         """Select this device's CFG branch of the text encoding [2, B, Lt, D]
         (same contract as DenoiseRunner._branch_inputs)."""
-        cfg = self.cfg
-        if cfg.cfg_split:
-            br = lax.axis_index(CFG_AXIS)
-            return jnp.take(enc, br, axis=0)
-        if cfg.do_classifier_free_guidance:
-            return enc.reshape(-1, *enc.shape[2:])  # fold branches into batch
-        return enc[0]
+        my_enc, _, _ = branch_select(self.cfg, enc)
+        return my_enc
 
     def _combine_eps(self, eps, gs, batch):
         """Guided epsilon from per-branch epsilon (chunk or full)."""
-        cfg = self.cfg
-        if cfg.cfg_split:
-            both = lax.all_gather(eps, CFG_AXIS)  # [2, B, L, D]
-            u, c = both[0], both[1]
-            return u + gs * (c - u)
-        if cfg.do_classifier_free_guidance:
-            u, c = eps[:batch], eps[batch:]
-            return u + gs * (c - u)
-        return eps
+        return combine_guidance(self.cfg, eps, gs, batch)
 
     def _run_stage(self, blocks_local, cap_kv_local, kv_cache, h, c6, offset, valid):
         """Run this device's Lp blocks on ``h`` [B, Lq, hid] against the

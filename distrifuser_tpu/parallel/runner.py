@@ -48,6 +48,7 @@ from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import gather_cols, gather_rows
 from .context import PHASE_STALE, PHASE_SYNC, PatchContext
+from .guidance import branch_select, combine_guidance
 
 
 def _check_geometry(cfg: DistriConfig, ucfg: UNetConfig) -> None:
@@ -111,29 +112,7 @@ class DenoiseRunner:
     def _branch_inputs(self, enc, added):
         """Select this device's CFG branch (cfg_split) or fold branches into
         the batch dim (single-device CFG, reference world_size==1 path)."""
-        cfg = self.cfg
-        if cfg.cfg_split:
-            br = lax.axis_index(CFG_AXIS)
-            my_enc = jnp.take(enc, br, axis=0)
-            my_added = (
-                {k: jnp.take(v, br, axis=0) for k, v in added.items()}
-                if added is not None
-                else None
-            )
-            batch_mult = 1
-        elif cfg.do_classifier_free_guidance:
-            my_enc = enc.reshape(-1, *enc.shape[2:])
-            my_added = (
-                {k: v.reshape(-1, *v.shape[2:]) for k, v in added.items()}
-                if added is not None
-                else None
-            )
-            batch_mult = enc.shape[0]
-        else:
-            my_enc = enc[0]
-            my_added = {k: v[0] for k, v in added.items()} if added is not None else None
-            batch_mult = 1
-        return my_enc, my_added, batch_mult
+        return branch_select(self.cfg, enc, added)
 
     def _unet_local(self, params, x_in, t, my_enc, my_added, text_kv, phase, pstate):
         """One UNet evaluation on this device; returns (full-latent output
@@ -203,15 +182,7 @@ class DenoiseRunner:
         return out, step_or_state
 
     def _cfg_combine(self, out, gs, batch):
-        cfg = self.cfg
-        if cfg.cfg_split:
-            both = lax.all_gather(out, CFG_AXIS)  # [2, B, H, W, C]
-            u, c = both[0], both[1]
-            return u + gs * (c - u)
-        if cfg.do_classifier_free_guidance:
-            u, c = out[:batch], out[batch:]
-            return u + gs * (c - u)
-        return out
+        return combine_guidance(self.cfg, out, gs, batch)
 
     def _make_step(self, phase):
         sched = self.scheduler
