@@ -85,19 +85,30 @@ def main():
     parser.add_argument("--test_times", type=int, default=3)
     parser.add_argument("--preset", type=str, default=None,
                         choices=[None, "sdxl", "tiny"], nargs="?")
-    parser.add_argument("--watchdog_s", type=float, default=1500.0)
+    # 40 min: the remote-compile service has been observed taking 15-25 min
+    # for the 50-step program (2026-07-29); a watchdog that fires mid-compile
+    # both loses the run and risks wedging the lease it then re-claims
+    parser.add_argument("--watchdog_s", type=float, default=2400.0)
     parser.add_argument(_RETRY_FLAG, action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
     disarm_watchdog = _arm_watchdog(args.watchdog_s)
 
     # persistent compilation cache: a watchdog-retry (or a repeated bench run)
     # skips the multi-minute 50-step SDXL compile
-    os.environ.setdefault(
+    cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
     import jax
     import jax.numpy as jnp
+
+    # the env var alone has not populated the cache under the axon plugin;
+    # set it through the config API as well (harmless if redundant)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:
+        pass
 
     from distrifuser_tpu import DistriConfig
     from distrifuser_tpu.models import unet as unet_mod

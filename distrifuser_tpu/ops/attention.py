@@ -32,6 +32,7 @@ from .linear import linear
 
 
 import os
+import sys
 
 _FLASH_MIN_LEN = 1024
 
@@ -78,12 +79,38 @@ def sdpa(q, k, v, *, heads: int):
     2048x2048, where materializing L^2 logits cannot fit).
     """
     if _flash_eligible(q, k, heads):
-        from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_sdpa
+        from .flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            flash_sdpa,
+            upstream_flash_sdpa,
+        )
 
         # Forcing via env on a non-TPU backend means interpret mode (tests):
         # Mosaic kernels only compile for TPU.
         interpret = jax.devices()[0].platform == "cpu"
-        # block sizes tunable per chip without code changes (scripts/tune_flash.py)
+        # DISTRIFUSER_TPU_FLASH_IMPL: "upstream" (default on TPU —
+        # jax.experimental's tuned kernel) or "inrepo" (the kernel above;
+        # also the interpret-mode test path, upstream has no interpret knob).
+        # Explicit BQ/BK tile tuning (scripts/tune_flash.py) targets the
+        # in-repo kernel, so setting either knob selects it.
+        tuned = ("DISTRIFUSER_TPU_FLASH_BQ" in os.environ
+                 or "DISTRIFUSER_TPU_FLASH_BK" in os.environ)
+        impl = os.environ.get(
+            "DISTRIFUSER_TPU_FLASH_IMPL",
+            "inrepo" if (interpret or tuned) else "upstream",
+        )
+        if impl == "upstream" and not interpret:
+            try:
+                return upstream_flash_sdpa(q, k, v, heads=heads)
+            except Exception as e:  # unstable jax.experimental surface:
+                # degrade to the in-repo kernel instead of dying at trace time
+                print(
+                    "upstream flash kernel unavailable "
+                    f"({type(e).__name__}: {e}); using in-repo Pallas kernel",
+                    file=sys.stderr,
+                )
+        # block sizes tunable per chip without code changes
         bq = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BQ", DEFAULT_BLOCK_Q))
         bk = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BK", DEFAULT_BLOCK_K))
         lq, lk = q.shape[1], k.shape[1]

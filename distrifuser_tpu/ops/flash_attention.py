@@ -67,6 +67,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("heads",))
+def upstream_flash_sdpa(q, k, v, *, heads: int):
+    """jax.experimental's tuned TPU flash kernel under the sdpa signature.
+
+    The upstream kernel (pallas/ops/tpu/flash_attention) carries
+    per-generation block-size tuning the in-repo kernel lacks;
+    scripts/bench_attention.py measures both against the XLA path at real
+    SDXL shapes and DISTRIFUSER_TPU_FLASH_IMPL selects the winner.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    d = c // heads
+
+    def to_heads(x, l):
+        return x.reshape(b, l, heads, d).transpose(0, 2, 1, 3)
+
+    o = flash_attention(
+        to_heads(q, lq), to_heads(k, lk), to_heads(v, lk),
+        causal=False, sm_scale=1.0 / d**0.5,
+    )
+    return o.transpose(0, 2, 1, 3).reshape(b, lq, c)
+
+
 @functools.partial(jax.jit, static_argnames=("heads", "block_q", "block_k", "interpret"))
 def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
                block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):

@@ -50,6 +50,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -359,6 +360,46 @@ class PipeFusionRunner:
 
         x_full = lax.psum(jnp.where(is_first, x, 0.0), SP_AXIS)
         return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
+        """Per-device memory/traffic accounting (counterpart of
+        DenoiseRunner.comm_volume_report for the pipeline layout).
+
+        Static arithmetic — no device work: PipeFusion's whole point is that
+        weights shrink depth/P-fold and the per-hop wire traffic is one
+        [B, N/M, hidden] chunk instead of the displaced-patch O(L) gathers.
+        """
+        dcfg = self.dcfg
+        n_tok = dcfg.num_tokens
+        hid = dcfg.hidden_size
+        l_per = dcfg.depth // self.stages
+        bloc = batch_size * (
+            2 if (self.cfg.do_classifier_free_guidance and not self.cfg.cfg_split)
+            else 1
+        )
+        one_block_params = sum(
+            int(np.prod(l.shape[1:]))  # leading axis is the depth stack
+            for l in jax.tree.leaves(self.params["blocks"])
+        )
+        shared_params = sum(
+            int(np.prod(np.shape(l)))
+            for k, v in self.params.items() if k != "blocks"
+            for l in jax.tree.leaves(v)
+        )
+        return {
+            "stages": self.stages,
+            "patches": self.patches,
+            "params_per_device": shared_params + one_block_params * l_per,
+            "params_replicated_equiv": shared_params + one_block_params * dcfg.depth,
+            "kv_cache_elems_per_device": l_per * 2 * bloc * n_tok * hid,
+            "ring_payload_elems_per_tick": bloc * (n_tok // self.patches) * hid,
+            "ticks_per_step_steady": self.patches,
+            "bubble_ticks": self.stages,
+        }
 
     # ------------------------------------------------------------------
     # public API

@@ -267,6 +267,31 @@ def test_more_patches_than_stages():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_dp_composes():
+    """dp(2) x cfg(2) x pipe(2) on 8 devices: each image group must match
+    the single-group run of its own batch element."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg, batch=2)
+    cfg = DistriConfig(
+        devices=jax.devices()[:8], height=128, width=128,
+        do_classifier_free_guidance=True, split_batch=True,
+        warmup_steps=1, dp_degree=2, batch_size=2,
+    )
+    assert cfg.dp_degree == 2 and cfg.n_device_per_batch == 2
+    runner = PipeFusionRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = np.asarray(
+        runner.generate(lat, enc, guidance_scale=3.0, num_inference_steps=4)
+    )
+    for i in range(2):
+        ref = oracle_generate(
+            params, dcfg, get_scheduler("ddim"),
+            lat[i:i + 1], enc[:, i:i + 1], 3.0, 4,
+            warmup_steps=1, n_stage=2, n_patch=2, do_cfg=True,
+        )
+        np.testing.assert_allclose(out[i:i + 1], np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_geometry_validation():
     dcfg, params = make_model(depth=6)  # 6 % 4 != 0
     cfg = pipe_config(4, do_cfg=False)
