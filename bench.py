@@ -85,6 +85,10 @@ def main():
     parser.add_argument("--test_times", type=int, default=3)
     parser.add_argument("--preset", type=str, default=None,
                         choices=[None, "sdxl", "tiny"], nargs="?")
+    parser.add_argument("--mode", type=str, default="auto",
+                        choices=["auto", "fused", "stepwise"],
+                        help="auto: fused loop, falling back to per-step "
+                        "compiled calls on the watchdog retry")
     # 40 min: the remote-compile service has been observed taking 15-25 min
     # for the 50-step program (2026-07-29); a watchdog that fires mid-compile
     # both loses the run and risks wedging the lease it then re-claims
@@ -125,7 +129,9 @@ def main():
         devices = jax.devices()
     except RuntimeError as e:
         if _RETRY_FLAG not in sys.argv:
-            time.sleep(30)  # give a stale grant a moment to clear
+            # a wedged lease has been observed to need tens of minutes to
+            # clear; give the retry a real chance without blowing the budget
+            time.sleep(120)
         _reexec_once(f"backend init failed ({e})")
         print(json.dumps({
             "metric": "bench_backend_unavailable",
@@ -147,13 +153,26 @@ def main():
         size = 256
         metric = f"tiny_unet_{args.steps}step_{size}px_latency"
 
+    # A watchdog retry means the fused 50-step loop did not come back within
+    # the budget (slow remote-compile days, observed 2026-07-29).  The
+    # stepwise mode (use_cuda_graph=False, the reference's --no_cuda_graph)
+    # compiles two small per-step programs instead of the whole loop —
+    # minutes, not tens of minutes — and its steady-state latency matches the
+    # fused loop to within host-dispatch noise, so the retry still records a
+    # real number instead of a timeout line.
+    stepwise = args.mode == "stepwise" or (
+        args.mode == "auto" and _RETRY_FLAG in sys.argv
+    )
     cfg = DistriConfig(
         devices=devices[:1],  # single-chip headline number
         height=size,
         width=size,
         warmup_steps=4,
         parallelism="patch",
+        use_cuda_graph=not stepwise,
     )
+    if stepwise:
+        metric += "_stepwise"
     dtype = cfg.dtype
     params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dtype)
     runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))

@@ -263,14 +263,25 @@ def dit_block(
     cap_kv: jnp.ndarray,       # [B, Lt, 2*hidden] precomputed text K/V
     self_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     patch_start: Optional[jnp.ndarray] = None,
+    kv_assemble=None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One transformer block.
 
-    Dense mode (``self_kv is None``): self-attention over ``x`` itself.
-    Pipeline mode: ``self_kv = (K, V)`` is the full-sequence stale cache
-    ``[B, N, hidden]``; this call's fresh K/V overwrite the ``Lq`` rows at
-    ``patch_start`` before attending (PipeFusion's newest-available KV), and
-    are returned so the runner can commit them to the carried cache.
+    Self-attention K/V assembly is mode-pluggable — only this op ever
+    crosses patch boundaries in a DiT (LayerNorm, MLP, and text
+    cross-attention are per-token):
+
+    * dense (``self_kv is None, kv_assemble is None``): attend over ``x``;
+    * cache mode (``self_kv=(K, V)`` [B, N, hidden] + ``patch_start``):
+      fresh K/V overwrite the ``Lq`` rows before attending — PipeFusion's
+      newest-available cache (parallel/pipefusion.py);
+    * hook mode (``kv_assemble``): ``(K, V) = kv_assemble(k, v)`` builds the
+      attended KV any other way (fresh all-gather for the sync phase of
+      displaced patch parallelism, carried-stale with a fresh own slot for
+      its steady state — parallel/dit_sp.py).
+
+    Returns ``(x_out, (k, v))`` — the fresh local K/V, so runners can
+    commit/exchange them.
     """
     table = bp["scale_shift_table"]  # [6, hidden]
     mods = table[None] + c6[None]    # [1, 6, hidden] broadcast over batch
@@ -280,7 +291,9 @@ def dit_block(
     q = linear(bp["attn_q"], hn)
     kv = linear(bp["attn_kv"], hn)
     k, v = jnp.split(kv, 2, axis=-1)
-    if self_kv is None:
+    if kv_assemble is not None:
+        full_k, full_v = kv_assemble(k, v)
+    elif self_kv is None:
         full_k, full_v = k, v
     else:
         full_k = lax.dynamic_update_slice(self_kv[0], k, (0, patch_start, 0))

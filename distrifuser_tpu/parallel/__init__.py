@@ -13,4 +13,8 @@ def __getattr__(name):
         from . import pipefusion
 
         return pipefusion.PipeFusionRunner
+    if name == "DiTDenoiseRunner":
+        from . import dit_sp
+
+        return dit_sp.DiTDenoiseRunner
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,233 @@
+"""Displaced patch parallelism for the DiT — DistriFusion's method on the
+transformer model family.
+
+The reference implements displaced patches for the UNet only (its whole
+module zoo exists to make convs/GroupNorm/attention patch-aware,
+modules/pp/*).  A DiT needs none of that machinery: LayerNorm, the MLP, and
+text cross-attention are strictly per-token, so **self-attention is the only
+op that crosses patch boundaries**.  Sharding the token sequence over the
+``sp`` axis therefore reduces DistriFusion to exactly one exchange:
+
+* sync phase (steps <= warmup, reference counter semantics §2.3): each
+  block's fresh local K/V are all-gathered — exact full attention;
+* stale phase: each block attends over the *previous step's* gathered K/V
+  with this device's own slot overwritten fresh (pp/attn.py:135-140
+  semantics), and all-gathers its fresh K/V into the scan carry — consumed
+  only next step, so XLA's latency-hiding scheduler overlaps the collective
+  with the remaining blocks' compute, the role of the reference's async
+  NCCL gathers (utils.py:170-190).
+
+Per-block stale state is the gathered [depth, B, N, hidden] K/V pair —
+O(L) like the reference's buffers; the pipeline runner (pipefusion.py) and
+this runner are complementary points on the memory/traffic trade
+(weights/depth-sharded + O(N/M) hops vs weights-replicated + O(N) gathers).
+
+Every device returns the full latent and steps the scheduler replicated —
+the same contract as DenoiseRunner, so pipelines can treat both
+interchangeably.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models import dit as dit_mod
+from ..models.dit import DiTConfig
+from ..schedulers import BaseScheduler
+from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
+from .collectives import all_gather_seq
+from .guidance import branch_select, combine_guidance
+
+
+class DiTDenoiseRunner:
+    """Compiled displaced-patch generation loop for a DiT.
+
+    API mirrors DenoiseRunner/PipeFusionRunner.generate.
+    """
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        dit_config: DiTConfig,
+        params,
+        scheduler: BaseScheduler,
+    ):
+        self.cfg = distri_config
+        self.dcfg = dit_config
+        self.params = params
+        self.scheduler = scheduler
+        if distri_config.attn_impl != "gather":
+            raise ValueError(
+                "DiTDenoiseRunner supports attn_impl='gather' only (O(L/n) "
+                "ring-layout state for the DiT is not implemented yet)"
+            )
+        if distri_config.comm_batch:
+            raise ValueError(
+                "comm_batch applies to the UNet's per-layer halo/moment "
+                "exchanges; the DiT path has one collective kind already"
+            )
+        n = distri_config.n_device_per_batch
+        if dit_config.num_tokens % n != 0:
+            raise ValueError(
+                f"token count {dit_config.num_tokens} must be divisible by "
+                f"the sp degree {n}"
+            )
+        if (distri_config.height // 8 != dit_config.sample_size) or (
+            distri_config.width // 8 != dit_config.sample_size
+        ):
+            raise ValueError(
+                f"DistriConfig {distri_config.height}x{distri_config.width} "
+                f"implies latent {distri_config.latent_height}, but "
+                f"DiTConfig.sample_size is {dit_config.sample_size}"
+            )
+        self._compiled: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+
+    def _eval_model(self, params, x_full, s, kv_state, phase_sync,
+                    cap_kv, c6_all, temb_all, pos):
+        """One DiT evaluation on this device's token rows.
+
+        Returns (full guided-input epsilon [Bl, N, D_out], new kv_state).
+        ``kv_state``: [depth, 2, Bl, N, hidden] gathered stale K/V.
+        """
+        cfg, dcfg = self.cfg, self.dcfg
+        sched = self.scheduler
+        n = cfg.n_device_per_batch
+        n_tok = dcfg.num_tokens
+        chunk = n_tok // n
+        sp_idx = lax.axis_index(SP_AXIS)
+        offset = sp_idx * chunk
+        compute_dtype = params["proj_in"]["kernel"].dtype
+
+        x_in = sched.scale_model_input(x_full, s)
+        rows = lax.dynamic_slice(
+            x_in, (0, offset, 0), (x_in.shape[0], chunk, x_in.shape[2])
+        ).astype(compute_dtype)
+        if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+            rows = jnp.concatenate([rows, rows], axis=0)
+        pos_rows = lax.dynamic_slice(pos, (offset, 0), (chunk, pos.shape[1]))
+        h = dit_mod.embed_tokens(params, dcfg, rows, pos_rows)
+        c6 = c6_all[s]
+
+        no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
+
+        def block_body(carry, xs):
+            hcur = carry
+            bp, ckv, kv_blk = xs  # kv_blk [2, Bl, N, hid] stale gathered
+            assembled = {}
+
+            def assemble(k_fresh, v_fresh):
+                if phase_sync:
+                    kv = (all_gather_seq(k_fresh), all_gather_seq(v_fresh))
+                else:
+                    kv = (
+                        lax.dynamic_update_slice(kv_blk[0], k_fresh, (0, offset, 0)),
+                        lax.dynamic_update_slice(kv_blk[1], v_fresh, (0, offset, 0)),
+                    )
+                assembled["kv"] = kv
+                return kv
+
+            h_out, (k, v) = dit_mod.dit_block(
+                bp, dcfg, hcur, c6, ckv, kv_assemble=assemble
+            )
+            # refresh for the NEXT step: fresh gathered K/V flow only into
+            # the carry (deferred consumption = overlappable collective).
+            # Sync phase reuses the already-assembled gather; no_sync keeps
+            # the carried state untouched after warmup.
+            if phase_sync:
+                fresh = jnp.stack(list(assembled["kv"]))
+            elif no_refresh:
+                fresh = kv_blk
+            else:
+                fresh = jnp.stack([all_gather_seq(k), all_gather_seq(v)])
+            return h_out, fresh
+
+        h, kv_new = lax.scan(
+            block_body, h, (params["blocks"], cap_kv, kv_state)
+        )
+        eps_rows = dit_mod.final_layer(params, dcfg, h, temb_all[s])
+        eps_full = all_gather_seq(eps_rows)
+        return eps_full, kv_new
+
+    def _device_loop(self, params, latents, enc, gs, num_steps):
+        cfg, dcfg = self.cfg, self.dcfg
+        sched = self.scheduler
+        my_enc, _, _ = branch_select(cfg, enc)
+        batch = latents.shape[0]
+        compute_dtype = params["proj_in"]["kernel"].dtype
+
+        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+        pos = dit_mod.pos_embed_table(dcfg, compute_dtype)
+        cap_kv = dit_mod.precompute_caption_kv(params, dcfg, my_enc)
+        ts = sched.timesteps()
+        temb_all = jax.vmap(lambda t: dit_mod.t_embed(params, dcfg, t))(ts)
+        c6_all = jax.vmap(lambda e: dit_mod.adaln_table(params, dcfg, e))(temb_all)
+
+        bloc = my_enc.shape[0]
+        sstate = sched.init_state(x.shape)
+        kv0 = jnp.zeros(
+            (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
+            compute_dtype,
+        )
+
+        def step(x, sstate, kv, s, phase_sync):
+            eps, kv = self._eval_model(
+                params, x, s, kv, phase_sync, cap_kv, c6_all, temb_all, pos
+            )
+            guided = combine_guidance(cfg, eps, gs, batch)
+            x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
+            return x, sstate, kv
+
+        full_sync = cfg.mode == "full_sync" or not cfg.is_sp
+        n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
+
+        def sync_body(i, carry):
+            x, ss, kv = carry
+            return step(x, ss, kv, i, True)
+
+        x, sstate, kv = lax.fori_loop(0, n_sync, sync_body, (x, sstate, kv0))
+
+        if n_sync < num_steps:
+            def stale_body(carry, i):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, False), None
+
+            (x, _, _), _ = lax.scan(
+                stale_body, (x, sstate, kv), jnp.arange(n_sync, num_steps)
+            )
+        return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+    # ------------------------------------------------------------------
+
+    def _build(self, num_steps: int):
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps)
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+
+        def loop(params, latents, enc, gs):
+            return shard_map(
+                device_loop,
+                mesh=cfg.mesh,
+                in_specs=(P(), lat_spec, enc_spec, P()),
+                out_specs=lat_spec,
+                check_vma=False,
+            )(params, latents, enc, gs)
+
+        return jax.jit(loop)
+
+    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20):
+        """Same contract as PipeFusionRunner.generate."""
+        self.scheduler.set_timesteps(num_inference_steps)
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(num_inference_steps)
+        gs = jnp.asarray(guidance_scale, jnp.float32)
+        return self._compiled[num_inference_steps](self.params, latents, enc, gs)

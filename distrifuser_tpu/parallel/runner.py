@@ -102,6 +102,12 @@ class DenoiseRunner:
         self.param_specs = param_specs if param_specs is not None else P()
         if distri_config.parallelism == "tensor" and tp_dispatch_factory is None:
             raise ValueError("tensor parallelism needs a tp_dispatch_factory")
+        if distri_config.parallelism == "pipefusion":
+            raise ValueError(
+                "pipefusion is a DiT strategy (parallel/pipefusion.py); the "
+                "UNet's heterogeneous stages cannot pipeline — use "
+                "parallelism='patch' here"
+            )
         _check_geometry(distri_config, unet_config)
         self._compiled: Dict[int, Any] = {}
 

@@ -51,7 +51,7 @@ SYNC_MODES = (
     "full_sync",
     "no_sync",
 )
-PARALLELISMS = ("patch", "tensor", "naive_patch")
+PARALLELISMS = ("patch", "tensor", "naive_patch", "pipefusion")
 SPLIT_SCHEMES = ("row", "col", "alternate")
 
 

@@ -50,7 +50,9 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         choices=["separate_gn", "stale_gn", "corrected_async_gn",
                                  "sync_gn", "full_sync", "no_sync"])
     parser.add_argument("--parallelism", type=str, default="patch",
-                        choices=["patch", "tensor", "naive_patch"])
+                        choices=["patch", "tensor", "naive_patch", "pipefusion"],
+                        help="pipefusion applies to the DiT family only "
+                        "(dit_example.py)")
     parser.add_argument("--no_cuda_graph", action="store_true",
                         help="parity alias: disable the fused compiled loop")
     parser.add_argument("--split_scheme", type=str, default="row",

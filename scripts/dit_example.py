@@ -1,6 +1,7 @@
-"""DiT + PipeFusion usage example (extension beyond the reference: patch-
-level pipeline parallelism for diffusion transformers, PipeFusion
-arXiv 2405.14430 — see docs/DESIGN.md).
+"""DiT usage example: displaced patch parallelism (--parallelism patch,
+default — the reference's method on the transformer family) or patch-level
+pipeline parallelism (--parallelism pipefusion, PipeFusion arXiv 2405.14430)
+— see docs/DESIGN.md.
 
 No public DiT checkpoint is mountable on this box, so the script runs the
 PixArt-style architecture with random weights (structure/latency demo, the
@@ -27,6 +28,11 @@ def main():
                         help="override DiT depth (must divide into stages)")
     args = parser.parse_args()
     args.image_size = args.image_size or [1024, 1024]
+    if args.parallelism not in ("patch", "pipefusion"):
+        parser.error(
+            f"--parallelism {args.parallelism} is a UNet strategy; the DiT "
+            "supports 'patch' (displaced) or 'pipefusion'"
+        )
 
     import jax
     import jax.numpy as jnp
@@ -56,10 +62,17 @@ def main():
     params = dit_mod.init_dit_params(
         jax.random.PRNGKey(args.seed), dcfg, distri_config.dtype
     )
-    runner = PipeFusionRunner(
-        distri_config, dcfg, params, get_scheduler(args.scheduler),
-        pipe_patches=args.pipe_patches,
-    )
+    if args.parallelism == "pipefusion":
+        runner = PipeFusionRunner(
+            distri_config, dcfg, params, get_scheduler(args.scheduler),
+            pipe_patches=args.pipe_patches,
+        )
+    else:  # displaced patch parallelism on the DiT (the reference's method)
+        from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+
+        runner = DiTDenoiseRunner(
+            distri_config, dcfg, params, get_scheduler(args.scheduler)
+        )
 
     key = jax.random.PRNGKey(args.seed)
     lat = jax.random.normal(

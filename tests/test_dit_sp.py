@@ -1,0 +1,212 @@
+"""Displaced patch parallelism on the DiT (parallel/dit_sp.py).
+
+Oracle: per-patch sequential evaluation with per-block gathered KV caches —
+step s attends over step s-1's cache with the patch's own rows fresh
+(pp/attn.py:135-140 semantics), and the cache refreshes to step s's fresh
+K/V afterwards.  Patches are independent within a stale step, so the oracle
+runs them one by one on a single device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu.models import dit as dit_mod
+from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.utils.config import DistriConfig
+
+from test_pipefusion import dense_loop, make_inputs, make_model
+
+
+def oracle_displaced(params, dcfg, sched, latents, enc, gs, num_steps,
+                     warmup_steps, n, do_cfg=True, refresh=True):
+    sched.set_timesteps(num_steps)
+    ts = sched.timesteps()
+    x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+    batch, n_tok, _ = x.shape
+    chunk = n_tok // n
+    n_sync = min(warmup_steps + 1, num_steps)
+    hid = dcfg.hidden_size
+    pos = dit_mod.pos_embed_table(dcfg, jnp.float32)
+    branches = (0, 1) if do_cfg else (0,)
+
+    cap_kv = {br: dit_mod.precompute_caption_kv(params, dcfg, enc[br])
+              for br in branches}
+    cache = {br: [(jnp.zeros((batch, n_tok, hid)),
+                   jnp.zeros((batch, n_tok, hid)))
+                  for _ in range(dcfg.depth)] for br in branches}
+    sstate = sched.init_state(x.shape)
+
+    def blocks(br, tokens, s, assemble_for):
+        """Run the stack on `tokens`; assemble_for(l, k, v) -> (K, V).
+        Returns (eps_tokens, fresh [list over blocks of (k, v)])."""
+        temb = dit_mod.t_embed(params, dcfg, ts[s])
+        c6 = dit_mod.adaln_table(params, dcfg, temb)
+        start = assemble_for["offset"]
+        pos_rows = jax.lax.dynamic_slice_in_dim(pos, start, tokens.shape[1], 0)
+        h = dit_mod.embed_tokens(params, dcfg, tokens, pos_rows)
+        fresh = []
+        for l in range(dcfg.depth):
+            bp = jax.tree.map(lambda a: a[l], params["blocks"])
+
+            def assemble(k, v, l=l):
+                if assemble_for["sync"]:
+                    return k, v  # full-seq tokens: fresh IS the full KV
+                ck, cv = cache[br][l]
+                return (
+                    jax.lax.dynamic_update_slice(ck, k, (0, start, 0)),
+                    jax.lax.dynamic_update_slice(cv, v, (0, start, 0)),
+                )
+
+            h, (k, v) = dit_mod.dit_block(bp, dcfg, h, c6, cap_kv[br][l],
+                                          kv_assemble=assemble)
+            fresh.append((k, v))
+        return dit_mod.final_layer(params, dcfg, h, temb), fresh
+
+    def combine(eps):
+        if not do_cfg:
+            return eps[0]
+        return eps[0] + gs * (eps[1] - eps[0])
+
+    for s in range(num_steps):
+        x_in = sched.scale_model_input(x, s)
+        if s < n_sync:
+            eps, fr = {}, {}
+            for br in branches:
+                eps[br], fr[br] = blocks(
+                    br, x_in, s, {"sync": True, "offset": 0}
+                )
+                cache[br] = fr[br]
+        else:
+            eps = {br: [] for br in branches}
+            fresh_all = {br: [[] for _ in range(dcfg.depth)] for br in branches}
+            for p in range(n):
+                rows = x_in[:, p * chunk:(p + 1) * chunk]
+                for br in branches:
+                    e, fr = blocks(
+                        br, rows, s, {"sync": False, "offset": p * chunk}
+                    )
+                    eps[br].append(e)
+                    for l in range(dcfg.depth):
+                        fresh_all[br][l].append(fr[l])
+            eps = {br: jnp.concatenate(v, axis=1) for br, v in eps.items()}
+            if refresh:
+                for br in branches:
+                    cache[br] = [
+                        (jnp.concatenate([kv[0] for kv in fresh_all[br][l]], axis=1),
+                         jnp.concatenate([kv[1] for kv in fresh_all[br][l]], axis=1))
+                        for l in range(dcfg.depth)
+                    ]
+        x, sstate = sched.step(x, combine(eps).astype(jnp.float32), s, sstate)
+
+    return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+
+def sp_config(n_dev, do_cfg, **kw):
+    return DistriConfig(
+        devices=jax.devices()[:n_dev], height=128, width=128,
+        do_classifier_free_guidance=do_cfg, split_batch=do_cfg, **kw,
+    )
+
+
+def test_full_sync_matches_dense():
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(4, do_cfg=False, mode="full_sync")
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=3)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 3,
+                     do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("scheduler", ["ddim", "dpm-solver"])
+def test_displaced_matches_oracle(scheduler):
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1)
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler(scheduler))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=6)
+    ref = oracle_displaced(
+        params, dcfg, get_scheduler(scheduler), lat, enc, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cfg_split_composes():
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(8, do_cfg=True, warmup_steps=1)
+    assert cfg.cfg_split and cfg.n_device_per_batch == 4
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=3.5, num_inference_steps=5)
+    ref = oracle_displaced(
+        params, dcfg, get_scheduler("ddim"), lat, enc, 3.5, 5,
+        warmup_steps=1, n=4, do_cfg=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cfg_folded():
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = DistriConfig(
+        devices=jax.devices()[:2], height=128, width=128,
+        do_classifier_free_guidance=True, split_batch=False, warmup_steps=1,
+    )
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=3.5, num_inference_steps=4)
+    ref = oracle_displaced(
+        params, dcfg, get_scheduler("ddim"), lat, enc, 3.5, 4,
+        warmup_steps=1, n=2, do_cfg=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_no_sync_mode():
+    """mode='no_sync': the KV state freezes at the warmup snapshot."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1, mode="no_sync")
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=6)
+    ref = oracle_displaced(
+        params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False, refresh=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # and it must NOT equal the refreshing path
+    ref_refresh = oracle_displaced(
+        params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False, refresh=True,
+    )
+    assert not np.allclose(np.asarray(out), np.asarray(ref_refresh),
+                           rtol=2e-4, atol=2e-4)
+
+
+def test_rejected_knobs():
+    dcfg, params = make_model()
+    with pytest.raises(ValueError, match="ring"):
+        DiTDenoiseRunner(sp_config(4, do_cfg=False, attn_impl="ring"),
+                         dcfg, params, get_scheduler("ddim"))
+    with pytest.raises(ValueError, match="comm_batch"):
+        DiTDenoiseRunner(sp_config(4, do_cfg=False, comm_batch=True),
+                         dcfg, params, get_scheduler("ddim"))
+
+
+def test_geometry_validation():
+    dcfg, params = make_model()
+    with pytest.raises(ValueError, match="sample_size"):
+        DiTDenoiseRunner(
+            DistriConfig(devices=jax.devices()[:4], height=256, width=256,
+                         do_classifier_free_guidance=False, split_batch=False),
+            dcfg, params, get_scheduler("ddim"),
+        )

@@ -292,6 +292,25 @@ def test_dp_composes():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_comm_report():
+    """Static accounting invariants of the pipeline layout report."""
+    dcfg, params = make_model()
+    cfg = pipe_config(4, do_cfg=False, warmup_steps=1)
+    runner = PipeFusionRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    rep = runner.comm_report()
+    total = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+    assert rep["params_replicated_equiv"] == total
+    shared = sum(
+        int(np.prod(np.shape(l)))
+        for k, v in params.items() if k != "blocks"
+        for l in jax.tree.leaves(v)
+    )
+    # 4 stages x depth 8 -> each device holds shared + 2 blocks
+    assert rep["params_per_device"] == shared + (total - shared) // 4
+    assert rep["ring_payload_elems_per_tick"] == dcfg.num_tokens // 4 * dcfg.hidden_size
+    assert rep["kv_cache_elems_per_device"] == 2 * 2 * dcfg.num_tokens * dcfg.hidden_size
+
+
 def test_geometry_validation():
     dcfg, params = make_model(depth=6)  # 6 % 4 != 0
     cfg = pipe_config(4, do_cfg=False)
