@@ -264,6 +264,7 @@ def dit_block(
     self_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     patch_start: Optional[jnp.ndarray] = None,
     kv_assemble=None,
+    attn_core=None,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One transformer block.
 
@@ -278,7 +279,10 @@ def dit_block(
     * hook mode (``kv_assemble``): ``(K, V) = kv_assemble(k, v)`` builds the
       attended KV any other way (fresh all-gather for the sync phase of
       displaced patch parallelism, carried-stale with a fresh own slot for
-      its steady state — parallel/dit_sp.py).
+      its steady state — parallel/dit_sp.py);
+    * core mode (``attn_core``): replaces the sdpa call entirely with
+      ``attn_core(q, K, V) -> [B, Lq, hidden]`` — the ring-streamed online
+      softmax uses this (parallel/dit_sp.py attn_impl="ring").
 
     Returns ``(x_out, (k, v))`` — the fresh local K/V, so runners can
     commit/exchange them.
@@ -298,7 +302,10 @@ def dit_block(
     else:
         full_k = lax.dynamic_update_slice(self_kv[0], k, (0, patch_start, 0))
         full_v = lax.dynamic_update_slice(self_kv[1], v, (0, patch_start, 0))
-    att = sdpa(q, full_k, full_v, heads=cfg.num_heads)
+    if attn_core is None:
+        att = sdpa(q, full_k, full_v, heads=cfg.num_heads)
+    else:
+        att = attn_core(q, full_k, full_v)
     x = x + g1 * linear(bp["attn_out"], att)
 
     cq = linear(bp["cross_q"], x)

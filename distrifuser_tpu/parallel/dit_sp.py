@@ -17,10 +17,14 @@ op that crosses patch boundaries**.  Sharding the token sequence over the
   with the remaining blocks' compute, the role of the reference's async
   NCCL gathers (utils.py:170-190).
 
-Per-block stale state is the gathered [depth, B, N, hidden] K/V pair —
-O(L) like the reference's buffers; the pipeline runner (pipefusion.py) and
-this runner are complementary points on the memory/traffic trade
-(weights/depth-sharded + O(N/M) hops vs weights-replicated + O(N) gathers).
+Per-block stale state depends on ``attn_impl``: "gather" carries the full
+gathered [depth, 2, B, N, hidden] K/V (O(L), the reference's buffer
+layout); "ring" carries only the own [depth, B, N/n, 2*hidden] chunk and
+streams peers through the shared ``ring_pass`` online softmax — O(L/n)
+state and no refresh collective at all.  The pipeline runner
+(pipefusion.py) and this runner are complementary points on the
+memory/traffic trade (weights/depth-sharded + O(N/M) ring hops vs
+weights-replicated + KV exchange).
 
 Every device returns the full latent and steps the scheduler replicated —
 the same contract as DenoiseRunner, so pipelines can treat both
@@ -62,11 +66,10 @@ class DiTDenoiseRunner:
         self.dcfg = dit_config
         self.params = params
         self.scheduler = scheduler
-        if distri_config.attn_impl != "gather":
-            raise ValueError(
-                "DiTDenoiseRunner supports attn_impl='gather' only (O(L/n) "
-                "ring-layout state for the DiT is not implemented yet)"
-            )
+        # attn_impl="gather" carries full gathered KV per block (reference
+        # layout); "ring" carries only the local chunk and streams peers
+        # through the online-softmax ring (O(L/n) state, no refresh
+        # collective) — the same pair of layouts the UNet offers.
         if distri_config.comm_batch:
             raise ValueError(
                 "comm_batch applies to the UNet's per-layer halo/moment "
@@ -95,7 +98,9 @@ class DiTDenoiseRunner:
         """One DiT evaluation on this device's token rows.
 
         Returns (full guided-input epsilon [Bl, N, D_out], new kv_state).
-        ``kv_state``: [depth, 2, Bl, N, hidden] gathered stale K/V.
+        ``kv_state``: gathered [depth, 2, Bl, N, hidden] stale K/V
+        (attn_impl="gather") or the own [depth, Bl, N/n, 2*hidden] chunk
+        (attn_impl="ring").
         """
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
@@ -117,8 +122,9 @@ class DiTDenoiseRunner:
         c6 = c6_all[s]
 
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
+        ring = cfg.attn_impl == "ring"
 
-        def block_body(carry, xs):
+        def block_body_gather(carry, xs):
             hcur = carry
             bp, ckv, kv_blk = xs  # kv_blk [2, Bl, N, hid] stale gathered
             assembled = {}
@@ -149,6 +155,39 @@ class DiTDenoiseRunner:
                 fresh = jnp.stack([all_gather_seq(k), all_gather_seq(v)])
             return h_out, fresh
 
+        def block_body_ring(carry, xs):
+            from ..ops.ring_attention import ring_pass
+
+            hcur = carry
+            bp, ckv, kv_blk = xs  # kv_blk [Bl, chunk, 2*hid] own stale chunk
+
+            def core(q, k, v):
+                # with no kv_assemble/self_kv, dit_block hands the fresh
+                # local (k, v) straight through — exactly the own chunk
+                kv_local = jnp.concatenate([k, v], axis=-1)
+                # sync phase rotates fresh chunks (exact); stale phase
+                # rotates each peer's previous-step chunk from the carry
+                rotating = kv_local if phase_sync else kv_blk
+                out = ring_pass(q, kv_local, rotating, n, SP_AXIS,
+                                heads=dcfg.num_heads)
+                b_, lq_ = q.shape[0], q.shape[1]
+                out = out.astype(q.dtype).transpose(0, 2, 1, 3)
+                return out.reshape(b_, lq_, dcfg.hidden_size)
+
+            h_out, (k, v) = dit_mod.dit_block(
+                bp, dcfg, hcur, c6, ckv, attn_core=core
+            )
+            # next step's stale state is just this step's own fresh chunk —
+            # no collective at all (ring_attention.py semantics).  Sync steps
+            # always commit (that snapshot IS what no_sync freezes).
+            if phase_sync or not no_refresh:
+                fresh = jnp.concatenate([k, v], axis=-1)
+            else:
+                fresh = kv_blk
+            return h_out, fresh
+
+        block_body = block_body_ring if ring else block_body_gather
+
         h, kv_new = lax.scan(
             block_body, h, (params["blocks"], cap_kv, kv_state)
         )
@@ -172,10 +211,16 @@ class DiTDenoiseRunner:
 
         bloc = my_enc.shape[0]
         sstate = sched.init_state(x.shape)
-        kv0 = jnp.zeros(
-            (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
-            compute_dtype,
-        )
+        if cfg.attn_impl == "ring":
+            chunk = dcfg.num_tokens // cfg.n_device_per_batch
+            kv0 = jnp.zeros(
+                (dcfg.depth, bloc, chunk, 2 * dcfg.hidden_size), compute_dtype
+            )
+        else:
+            kv0 = jnp.zeros(
+                (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
+                compute_dtype,
+            )
 
         def step(x, sstate, kv, s, phase_sync):
             eps, kv = self._eval_model(
