@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models import dit as dit_mod
 from ..models.dit import DiTConfig
+from ..ops.attention import sdpa
 from ..schedulers import BaseScheduler
 from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import all_gather_seq
@@ -76,6 +77,14 @@ class DiTDenoiseRunner:
                 "exchanges; the DiT path has one collective kind already"
             )
         n = distri_config.n_device_per_batch
+        if (
+            distri_config.attn_impl == "ulysses"
+            and dit_config.num_heads % n != 0
+        ):
+            raise ValueError(
+                f"ulysses needs num_heads ({dit_config.num_heads}) divisible "
+                f"by the sp degree ({n})"
+            )
         if dit_config.num_tokens % n != 0:
             raise ValueError(
                 f"token count {dit_config.num_tokens} must be divisible by "
@@ -123,6 +132,47 @@ class DiTDenoiseRunner:
 
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
         ring = cfg.attn_impl == "ring"
+        ulysses = cfg.attn_impl == "ulysses"
+
+        def block_body_ulysses(carry, xs):
+            """Ulysses SP (exact, stateless): all_to_all re-shards the
+            sequence-sharded q/k/v to head-sharded full sequences, runs full
+            attention on H/n heads, and re-shards back — the DeepSpeed-
+            Ulysses layout (SURVEY §2.1 lists it absent in the reference).
+            No staleness, so sync and stale phases are identical and the
+            carry passes through untouched."""
+            hcur = carry
+            bp, ckv, kv_blk = xs
+            heads = dcfg.num_heads
+            d = dcfg.hidden_size // heads
+
+            def core(q, k, v):
+                b_, lq_ = q.shape[0], q.shape[1]
+
+                def to_headshard(t):
+                    th = t.reshape(b_, lq_, heads, d)
+                    # split heads over sp, concat tokens -> [B, N, H/n, D]
+                    return lax.all_to_all(
+                        th, SP_AXIS, split_axis=2, concat_axis=1, tiled=True
+                    )
+
+                qg, kg, vg = to_headshard(q), to_headshard(k), to_headshard(v)
+                n_full = qg.shape[1]
+                h_loc = heads // n
+                att = sdpa(
+                    qg.reshape(b_, n_full, h_loc * d),
+                    kg.reshape(b_, n_full, h_loc * d),
+                    vg.reshape(b_, n_full, h_loc * d),
+                    heads=h_loc,
+                )
+                att = att.reshape(b_, n_full, h_loc, d)
+                back = lax.all_to_all(
+                    att, SP_AXIS, split_axis=1, concat_axis=2, tiled=True
+                )  # [B, chunk, H, D]
+                return back.reshape(b_, lq_, dcfg.hidden_size)
+
+            h_out, _ = dit_mod.dit_block(bp, dcfg, hcur, c6, ckv, attn_core=core)
+            return h_out, kv_blk
 
         def block_body_gather(carry, xs):
             hcur = carry
@@ -186,7 +236,10 @@ class DiTDenoiseRunner:
                 fresh = kv_blk
             return h_out, fresh
 
-        block_body = block_body_ring if ring else block_body_gather
+        if ulysses:
+            block_body = block_body_ulysses
+        else:
+            block_body = block_body_ring if ring else block_body_gather
 
         h, kv_new = lax.scan(
             block_body, h, (params["blocks"], cap_kv, kv_state)
@@ -211,7 +264,11 @@ class DiTDenoiseRunner:
 
         bloc = my_enc.shape[0]
         sstate = sched.init_state(x.shape)
-        if cfg.attn_impl == "ring":
+        if cfg.attn_impl == "ulysses":
+            # exact and stateless: a minimal placeholder keeps the block
+            # scan's xs structure uniform
+            kv0 = jnp.zeros((dcfg.depth, 1), compute_dtype)
+        elif cfg.attn_impl == "ring":
             chunk = dcfg.num_tokens // cfg.n_device_per_batch
             kv0 = jnp.zeros(
                 (dcfg.depth, bloc, chunk, 2 * dcfg.hidden_size), compute_dtype

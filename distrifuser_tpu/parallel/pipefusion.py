@@ -98,6 +98,12 @@ class PipeFusionRunner:
         self.params = params
         self.scheduler = scheduler
         cfg, dcfg = distri_config, dit_config
+        if cfg.attn_impl != "gather":
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} applies to the displaced DiT "
+                "runner (parallel/dit_sp.py); the pipeline's per-block KV "
+                "cache is its own attention layout"
+            )
         self.stages = cfg.n_device_per_batch
         self.patches = self.stages if pipe_patches is None else pipe_patches
         n_tok = dcfg.num_tokens

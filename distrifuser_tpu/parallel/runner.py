@@ -108,6 +108,12 @@ class DenoiseRunner:
                 "UNet's heterogeneous stages cannot pipeline — use "
                 "parallelism='patch' here"
             )
+        if distri_config.attn_impl == "ulysses":
+            raise ValueError(
+                "attn_impl='ulysses' is a DiT strategy (parallel/dit_sp.py): "
+                "head counts vary per UNet level, so the all-to-all head "
+                "shard does not apply — use 'gather' or 'ring' here"
+            )
         _check_geometry(distri_config, unet_config)
         self._compiled: Dict[int, Any] = {}
 

@@ -144,9 +144,10 @@ class DistriConfig:
             raise ValueError(
                 f"split_scheme must be one of {SPLIT_SCHEMES}, got {self.split_scheme!r}"
             )
-        if self.attn_impl not in ("gather", "ring"):
+        if self.attn_impl not in ("gather", "ring", "ulysses"):
             raise ValueError(
-                f"attn_impl must be 'gather' or 'ring', got {self.attn_impl!r}"
+                "attn_impl must be 'gather', 'ring', or 'ulysses' (ulysses: "
+                f"DiT only), got {self.attn_impl!r}"
             )
         if self.height % 8 != 0 or self.width % 8 != 0:
             # Same constraint as the reference pipelines (pipelines.py:71).

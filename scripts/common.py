@@ -65,7 +65,7 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--dp_degree", type=int, default=1,
                         help="data-parallel image groups (extra mesh axis)")
     parser.add_argument("--attn_impl", type=str, default="gather",
-                        choices=["gather", "ring"],
+                        choices=["gather", "ring", "ulysses"],
                         help="patch attention layout (ring: O(L/n) state)")
     parser.add_argument("--comm_batch", action="store_true",
                         help="batch stale-refresh collectives into one flat "

@@ -221,6 +221,41 @@ def test_ring_no_sync_matches_gather_no_sync():
     np.testing.assert_allclose(out["ring"], out["gather"], rtol=2e-4, atol=2e-4)
 
 
+def test_ulysses_exact():
+    """attn_impl='ulysses' is exact: equals the dense loop at EVERY step
+    count and warmup setting (no staleness exists)."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=0, attn_impl="ulysses")
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=5)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 5,
+                     do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_cfg_split():
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(8, do_cfg=True, warmup_steps=0, attn_impl="ulysses")
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=3.5, num_inference_steps=4)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 3.5, 4,
+                     do_cfg=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_head_divisibility():
+    dcfg, params = make_model()  # 4 heads
+    with pytest.raises(ValueError, match="num_heads"):
+        DiTDenoiseRunner(
+            sp_config(8, do_cfg=False, attn_impl="ulysses"),
+            dcfg, params, get_scheduler("ddim"),
+        )
+
+
 def test_rejected_knobs():
     dcfg, params = make_model()
     with pytest.raises(ValueError, match="comm_batch"):
