@@ -124,10 +124,19 @@ def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * heads, lq, d), q.dtype),
         scratch_shapes=[
+            # (block_q, 128): fp32 lane width — same layout the upstream TPU
+            # kernel uses for its m/l scratch (MIN_BLOCK_SIZE=128)
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max
             pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
+        # batch*heads and q-blocks are independent; only the KV walk carries
+        # the online-softmax state.  Without this, Mosaic treats every grid
+        # dim as sequential ("arbitrary"), which blocks its cross-iteration
+        # pipelining — the prime suspect in the round-2 2x slowdown vs XLA.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qh, kh, vh)
 
