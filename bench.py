@@ -303,7 +303,8 @@ def main():
                 try:
                     fused = measure(stepwise=False)
                     if fused["value"] > 0:
-                        _BEST.clear()
+                        # plain update (same four keys): no instant where the
+                        # watchdog could observe an empty _BEST
                         _BEST.update(fused)
                 except Exception as e:
                     print(f"fused attempt failed ({type(e).__name__}: {e}); "

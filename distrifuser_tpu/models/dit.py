@@ -32,7 +32,9 @@ before the linear projection to patch pixels.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -62,8 +64,12 @@ class DiTConfig:
     depth: int = 28
     num_heads: int = 16
     mlp_ratio: int = 4
-    caption_dim: int = 2048         # text-encoder hidden size fed to cross-attn
+    caption_dim: int = 4096         # text-encoder hidden size fed to cross-attn
     frequency_embedding_size: int = 256
+    # PixArt 1024-class checkpoints micro-condition on (resolution, aspect
+    # ratio); the embedders live in the param tree and fold_size_condition
+    # applies them (exactly) ahead of the denoise loop
+    use_additional_conditions: bool = False
 
     @property
     def tokens_per_side(self) -> int:
@@ -89,10 +95,43 @@ class DiTConfig:
             raise ValueError("hidden_size must be divisible by num_heads")
 
 
-def pixart_config() -> DiTConfig:
-    """PixArt-alpha-XL/2-1024 geometry (caption_dim kept at the CLIP-bigG
-    width so the in-repo text encoders drive it; PixArt itself uses T5)."""
-    return DiTConfig()
+def pixart_config(sample_size: int = 128) -> DiTConfig:
+    """PixArt-alpha-XL/2 geometry: T5-v1.1-XXL caption width (models/t5.py
+    is the matching in-repo encoder); 1024-class checkpoints (latent side
+    128) additionally micro-condition on resolution/aspect."""
+    return DiTConfig(
+        sample_size=sample_size,
+        use_additional_conditions=sample_size == 128,
+    )
+
+
+def dit_config_from_json(source) -> DiTConfig:
+    """diffusers PixArtTransformer2DModel config.json -> DiTConfig.
+
+    ``out_channels`` collapses to ``in_channels``: diffusers' 2x head is
+    (epsilon, learned sigma) and the learned-sigma rows are dropped at
+    conversion (weights.convert_pixart_state_dict), since the runners use
+    fixed variance like the reference's SDXL path."""
+    if isinstance(source, (str, os.PathLike)):
+        with open(source) as f:
+            source = json.load(f)
+    d = dict(source)
+    heads = d.get("num_attention_heads", 16)
+    sample = d.get("sample_size", 128)
+    return DiTConfig(
+        sample_size=sample,
+        patch_size=d.get("patch_size", 2),
+        in_channels=d.get("in_channels", 4),
+        out_channels=d.get("in_channels", 4),
+        hidden_size=heads * d.get("attention_head_dim", 72),
+        depth=d.get("num_layers", 28),
+        num_heads=heads,
+        mlp_ratio=4,
+        caption_dim=d.get("caption_channels", 4096),
+        use_additional_conditions=d.get(
+            "use_additional_conditions", sample == 128
+        ),
+    )
 
 
 def tiny_dit_config(depth: int = 8) -> DiTConfig:
@@ -149,7 +188,21 @@ def init_dit_params(key, cfg: DiTConfig, dtype=jnp.float32) -> Dict[str, Any]:
     keys = jax.random.split(key, 8)
     block_keys = jax.random.split(keys[7], cfg.depth)
     blocks = jax.vmap(lambda k: _init_block(k, cfg, dtype))(block_keys)
+    extra = {}
+    if cfg.use_additional_conditions:
+        if h % 3 != 0:
+            raise ValueError(
+                "use_additional_conditions needs hidden_size % 3 == 0 "
+                "(resolution h+w and aspect embeddings concatenate to hidden)"
+            )
+        for i, name in enumerate(("resolution_embedder", "aspect_ratio_embedder")):
+            k = jax.random.fold_in(keys[6], 10 + i)
+            extra[name] = {
+                "fc1": _init_linear(k, cfg.frequency_embedding_size, h // 3, dtype),
+                "fc2": _init_linear(jax.random.fold_in(k, 1), h // 3, h // 3, dtype),
+            }
     return {
+        **extra,
         "proj_in": _init_linear(keys[0], cfg.token_dim, h, dtype),
         "t_fc1": _init_linear(keys[1], cfg.frequency_embedding_size, h, dtype),
         "t_fc2": _init_linear(keys[2], h, h, dtype),
@@ -226,6 +279,45 @@ def t_embed(params, cfg: DiTConfig, t: jnp.ndarray) -> jnp.ndarray:
     return linear(params["t_fc2"], silu(linear(params["t_fc1"], f)))
 
 
+def size_condition_embed(
+    params, cfg: DiTConfig, height: float, width: float
+) -> jnp.ndarray:
+    """PixArt micro-conditioning vector [hidden]: sinusoidal features of the
+    original (height, width) and the aspect ratio, each through its own
+    2-layer embedder, concatenated (so 3 * size_emb_dim == hidden)."""
+
+    def embed(emb_p, vals):
+        f = jnp.stack([
+            timestep_embedding(cfg, jnp.asarray(v, jnp.float32)) for v in vals
+        ])
+        f = f.astype(emb_p["fc1"]["kernel"].dtype)
+        return linear(emb_p["fc2"], silu(linear(emb_p["fc1"], f))).reshape(-1)
+
+    res = embed(params["resolution_embedder"], (height, width))
+    ar = embed(params["aspect_ratio_embedder"], (height / width,))
+    return jnp.concatenate([res, ar])
+
+
+def fold_size_condition(params, cfg: DiTConfig, height: float, width: float):
+    """Return params with the micro-conditioning folded into ``t_fc2.bias``.
+
+    The size embedding is timestep-independent and enters purely additively
+    on t_embed's output — which feeds adaln_table AND final_layer — so
+    adding it to the last bias is exact, costs nothing per step, and leaves
+    every runner untouched.  No-op when the config (or checkpoint) has no
+    additional conditions.
+    """
+    if not cfg.use_additional_conditions or "resolution_embedder" not in params:
+        return params
+    cond = size_condition_embed(params, cfg, height, width)
+    out = dict(params)
+    out["t_fc2"] = dict(params["t_fc2"])
+    out["t_fc2"]["bias"] = params["t_fc2"]["bias"] + cond.astype(
+        params["t_fc2"]["bias"].dtype
+    )
+    return out
+
+
 def caption_project(params, enc: jnp.ndarray) -> jnp.ndarray:
     """Text-encoder states [B, Lt, caption_dim] -> [B, Lt, hidden]."""
     return linear(
@@ -255,6 +347,33 @@ def precompute_caption_kv(params, cfg: DiTConfig, enc: jnp.ndarray) -> jnp.ndarr
     return jax.vmap(lambda kvp: linear(kvp, y))(params["blocks"]["cross_kv"])
 
 
+def caption_mask_bias(mask: jnp.ndarray) -> jnp.ndarray:
+    """Tokenizer attention mask [..., Lt] (1 = real token) -> additive
+    cross-attention bias [..., 1, 1, Lt].  PixArt masks padded T5 caption
+    tokens out of cross-attention; a -1e9 logit offset removes a key exactly
+    (its softmax weight underflows to 0)."""
+    return jnp.where(mask[..., None, None, :].astype(bool), 0.0, -1e9).astype(
+        jnp.float32
+    )
+
+
+def _masked_cross_sdpa(q, k, v, bias, heads: int):
+    """Cross-attention with an additive key bias.  Caption sequences are
+    tiny (77-300 tokens) so the plain XLA einsum path is the right kernel;
+    the flash kernels never engage for cross-attention anyway
+    (ops/attention.py routes by key length)."""
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    d = c // heads
+    qh = q.reshape(b, lq, heads, d)
+    kh = k.reshape(b, lk, heads, d)
+    vh = v.reshape(b, lk, heads, d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / math.sqrt(d)
+    w = jax.nn.softmax(logits.astype(jnp.float32) + bias, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), vh)
+    return out.reshape(b, lq, c)
+
+
 def dit_block(
     bp: Dict[str, Any],
     cfg: DiTConfig,
@@ -265,6 +384,7 @@ def dit_block(
     patch_start: Optional[jnp.ndarray] = None,
     kv_assemble=None,
     attn_core=None,
+    cap_bias: Optional[jnp.ndarray] = None,  # [B, 1, 1, Lt] additive
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """One transformer block.
 
@@ -310,7 +430,11 @@ def dit_block(
 
     cq = linear(bp["cross_q"], x)
     ck, cv = jnp.split(cap_kv, 2, axis=-1)
-    x = x + linear(bp["cross_out"], sdpa(cq, ck, cv, heads=cfg.num_heads))
+    if cap_bias is None:
+        catt = sdpa(cq, ck, cv, heads=cfg.num_heads)
+    else:
+        catt = _masked_cross_sdpa(cq, ck, cv, cap_bias, cfg.num_heads)
+    x = x + linear(bp["cross_out"], catt)
 
     hn2 = _ln(x) * (1.0 + sc2) + s2
     x = x + g2 * linear(
@@ -350,6 +474,7 @@ def dit_forward(
     t: jnp.ndarray,                  # scalar timestep
     enc: jnp.ndarray,                # [B, Lt, caption_dim]
     cap_kv: Optional[jnp.ndarray] = None,   # [depth, B, Lt, 2*hidden]
+    cap_mask: Optional[jnp.ndarray] = None,  # [B, Lt], 1 = real token
 ) -> jnp.ndarray:
     """Full DiT evaluation; returns the epsilon prediction as NHWC."""
     tokens = patchify(cfg, x).astype(params["proj_in"]["kernel"].dtype)
@@ -359,10 +484,11 @@ def dit_forward(
     c6 = adaln_table(params, cfg, temb)
     if cap_kv is None:
         cap_kv = precompute_caption_kv(params, cfg, enc)
+    cap_bias = None if cap_mask is None else caption_mask_bias(cap_mask)
 
     def body(hc, xs):
         bp, kv = xs
-        out, _ = dit_block(bp, cfg, hc, c6, kv)
+        out, _ = dit_block(bp, cfg, hc, c6, kv, cap_bias=cap_bias)
         return out, None
 
     h, _ = lax.scan(body, h, (params["blocks"], cap_kv))

@@ -206,6 +206,159 @@ def convert_clip_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
     return _cast(_convert(out), dtype)
 
 
+def _stack_layers(layers: List[Dict[str, Any]]):
+    """Per-layer trees -> one tree with a leading [depth] axis (the
+    lax.scan / pipeline-stage layout of models/dit.py and models/t5.py)."""
+    import jax
+
+    return jax.tree.map(lambda *ls: np.stack(ls), *layers)
+
+
+def convert_t5_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
+    """transformers T5EncoderModel state_dict -> t5.py param tree.
+
+    Linear kernels transpose [O, I] -> [I, O]; the relative-position bias
+    embedding (owned by block 0, shared by all layers in transformers) maps
+    to the single top-level table t5_encode reads; per-block leaves stack
+    into the leading [num_layers] axis.
+    """
+    get = lambda k: np.asarray(sd[k])
+    n_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd if k.startswith("encoder.block.")
+    )
+    gated = "encoder.block.0.layer.1.DenseReluDense.wi_0.weight" in sd
+
+    def lin(key):
+        return {"kernel": get(key).T}
+
+    layers = []
+    for i in range(n_layers):
+        a = f"encoder.block.{i}.layer.0"
+        f = f"encoder.block.{i}.layer.1"
+        ff = (
+            {"wi_0": lin(f"{f}.DenseReluDense.wi_0.weight"),
+             "wi_1": lin(f"{f}.DenseReluDense.wi_1.weight"),
+             "wo": lin(f"{f}.DenseReluDense.wo.weight")}
+            if gated
+            else {"wi": lin(f"{f}.DenseReluDense.wi.weight"),
+                  "wo": lin(f"{f}.DenseReluDense.wo.weight")}
+        )
+        layers.append({
+            "attn": {
+                "q": lin(f"{a}.SelfAttention.q.weight"),
+                "k": lin(f"{a}.SelfAttention.k.weight"),
+                "v": lin(f"{a}.SelfAttention.v.weight"),
+                "o": lin(f"{a}.SelfAttention.o.weight"),
+            },
+            "attn_norm": get(f"{a}.layer_norm.weight"),
+            "ff": ff,
+            "ff_norm": get(f"{f}.layer_norm.weight"),
+        })
+    tree = {
+        "shared": get("shared.weight"),
+        "relative_attention_bias": get(
+            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ),
+        "layers": _stack_layers(layers),
+        "final_norm": get("encoder.final_layer_norm.weight"),
+    }
+    return _cast(tree, dtype)
+
+
+def convert_pixart_state_dict(
+    sd: Dict[str, np.ndarray], *, patch_size: int = 2, eps_channels: int = 4,
+    dtype=jnp.float32,
+):
+    """diffusers PixArtTransformer2DModel state_dict -> dit.py param tree.
+
+    Key moves beyond the mechanical transpose:
+
+    * ``pos_embed.proj`` (the ps x ps patch-embed conv) becomes the
+      ``proj_in`` linear over patchify's (p, q, c)-ordered token vector;
+    * per-block ``attn{1,2}.to_k/to_v`` fuse into ``attn_kv``/``cross_kv``
+      (same layout convert_unet_state_dict produces);
+    * ``proj_out`` [ps*ps*2C, hidden] carries PixArt's learned-sigma head;
+      the epsilon rows (channel-innermost token layout, matching
+      dit.unpatchify) are kept, sigma discarded (our runners use fixed
+      variance, like the reference's SDXL path);
+    * blocks stack into the leading [depth] scan axis.
+    """
+    get = lambda k: np.asarray(sd[k])
+
+    def lin(key):
+        w = {"kernel": get(f"{key}.weight").T}
+        if f"{key}.bias" in sd:
+            w["bias"] = get(f"{key}.bias")
+        return w
+
+    def fused(key_k, key_v):
+        out = {"kernel": np.concatenate(
+            [get(f"{key_k}.weight").T, get(f"{key_v}.weight").T], axis=1)}
+        if f"{key_k}.bias" in sd:
+            out["bias"] = np.concatenate(
+                [get(f"{key_k}.bias"), get(f"{key_v}.bias")])
+        return out
+
+    n_blocks = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("transformer_blocks.")
+    )
+    blocks = []
+    for i in range(n_blocks):
+        b = f"transformer_blocks.{i}"
+        blocks.append({
+            "scale_shift_table": get(f"{b}.scale_shift_table"),
+            "attn_q": lin(f"{b}.attn1.to_q"),
+            "attn_kv": fused(f"{b}.attn1.to_k", f"{b}.attn1.to_v"),
+            "attn_out": lin(f"{b}.attn1.to_out.0"),
+            "cross_q": lin(f"{b}.attn2.to_q"),
+            "cross_kv": fused(f"{b}.attn2.to_k", f"{b}.attn2.to_v"),
+            "cross_out": lin(f"{b}.attn2.to_out.0"),
+            "mlp_fc1": lin(f"{b}.ff.net.0.proj"),
+            "mlp_fc2": lin(f"{b}.ff.net.2"),
+        })
+
+    ps = patch_size
+    # conv [hidden, C, ps, ps] -> linear [(p, q, c) -> hidden]
+    pw = get("pos_embed.proj.weight")
+    hidden = pw.shape[0]
+    proj_in = {
+        "kernel": pw.transpose(2, 3, 1, 0).reshape(-1, hidden),
+        "bias": get("pos_embed.proj.bias"),
+    }
+    # learned-sigma head: keep the eps channels of the (p, q, c) output layout
+    ow = get("proj_out.weight")      # [ps*ps*out2, hidden]
+    ob = get("proj_out.bias")
+    out2 = ow.shape[0] // (ps * ps)
+    ow = ow.reshape(ps, ps, out2, hidden)[:, :, :eps_channels]
+    ob = ob.reshape(ps, ps, out2)[:, :, :eps_channels]
+    final_out = {
+        "kernel": ow.reshape(ps * ps * eps_channels, hidden).T,
+        "bias": ob.reshape(-1),
+    }
+
+    tree = {
+        "proj_in": proj_in,
+        "t_fc1": lin("adaln_single.emb.timestep_embedder.linear_1"),
+        "t_fc2": lin("adaln_single.emb.timestep_embedder.linear_2"),
+        "adaln": lin("adaln_single.linear"),
+        "cap_fc1": lin("caption_projection.linear_1"),
+        "cap_fc2": lin("caption_projection.linear_2"),
+        "final_table": get("scale_shift_table"),
+        "final_out": final_out,
+        "blocks": _stack_layers(blocks),
+    }
+    # 1024-class checkpoints micro-condition on resolution/aspect
+    # (use_additional_conditions; dit.py applies them when cfg enables it)
+    for name in ("resolution_embedder", "aspect_ratio_embedder"):
+        k1 = f"adaln_single.emb.{name}.linear_1"
+        if f"{k1}.weight" in sd:
+            tree[name] = {
+                "fc1": lin(k1),
+                "fc2": lin(f"adaln_single.emb.{name}.linear_2"),
+            }
+    return _cast(tree, dtype)
+
+
 # ---------------------------------------------------------------------------
 # on-disk cache of converted trees
 # ---------------------------------------------------------------------------

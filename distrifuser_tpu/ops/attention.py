@@ -36,6 +36,35 @@ import sys
 
 _FLASH_MIN_LEN = 1024
 
+# One-shot probe verdict for jax.experimental's flash kernel (None = untested).
+_UPSTREAM_PROBE_OK = None
+
+
+def _upstream_flash_available() -> bool:
+    """Probe-compile the upstream kernel once on a tiny shape, caching the
+    verdict.  A Mosaic/backend failure of the upstream kernel otherwise
+    surfaces only when the WHOLE jitted denoise loop compiles — where the
+    trace-time try/except in sdpa cannot engage and generate() dies instead
+    of degrading.  DISTRIFUSER_TPU_FLASH_IMPL=inrepo is the manual escape
+    hatch if even the probe misjudges.
+    """
+    global _UPSTREAM_PROBE_OK
+    if _UPSTREAM_PROBE_OK is None:
+        from .flash_attention import upstream_flash_sdpa
+
+        try:
+            x = jnp.zeros((1, 256, 64), jnp.bfloat16)
+            jax.block_until_ready(upstream_flash_sdpa(x, x, x, heads=1))
+            _UPSTREAM_PROBE_OK = True
+        except Exception as e:
+            print(
+                "upstream flash kernel failed its probe compile "
+                f"({type(e).__name__}: {e}); using in-repo Pallas kernel",
+                file=sys.stderr,
+            )
+            _UPSTREAM_PROBE_OK = False
+    return _UPSTREAM_PROBE_OK
+
 
 def _flash_eligible(q, k, heads: int) -> bool:
     """Route to the Pallas flash kernel: TPU, long block-aligned sequences,
@@ -100,7 +129,7 @@ def sdpa(q, k, v, *, heads: int):
             "DISTRIFUSER_TPU_FLASH_IMPL",
             "inrepo" if (interpret or tuned) else "upstream",
         )
-        if impl == "upstream" and not interpret:
+        if impl == "upstream" and not interpret and _upstream_flash_available():
             try:
                 return upstream_flash_sdpa(q, k, v, heads=heads)
             except Exception as e:  # unstable jax.experimental surface:

@@ -103,7 +103,7 @@ class DiTDenoiseRunner:
     # ------------------------------------------------------------------
 
     def _eval_model(self, params, x_full, s, kv_state, phase_sync,
-                    cap_kv, c6_all, temb_all, pos):
+                    cap_kv, c6_all, temb_all, pos, cap_bias):
         """One DiT evaluation on this device's token rows.
 
         Returns (full guided-input epsilon [Bl, N, D_out], new kv_state).
@@ -171,7 +171,9 @@ class DiTDenoiseRunner:
                 )  # [B, chunk, H, D]
                 return back.reshape(b_, lq_, dcfg.hidden_size)
 
-            h_out, _ = dit_mod.dit_block(bp, dcfg, hcur, c6, ckv, attn_core=core)
+            h_out, _ = dit_mod.dit_block(
+                bp, dcfg, hcur, c6, ckv, attn_core=core, cap_bias=cap_bias
+            )
             return h_out, kv_blk
 
         def block_body_gather(carry, xs):
@@ -191,7 +193,7 @@ class DiTDenoiseRunner:
                 return kv
 
             h_out, (k, v) = dit_mod.dit_block(
-                bp, dcfg, hcur, c6, ckv, kv_assemble=assemble
+                bp, dcfg, hcur, c6, ckv, kv_assemble=assemble, cap_bias=cap_bias
             )
             # refresh for the NEXT step: fresh gathered K/V flow only into
             # the carry (deferred consumption = overlappable collective).
@@ -225,7 +227,7 @@ class DiTDenoiseRunner:
                 return out.reshape(b_, lq_, dcfg.hidden_size)
 
             h_out, (k, v) = dit_mod.dit_block(
-                bp, dcfg, hcur, c6, ckv, attn_core=core
+                bp, dcfg, hcur, c6, ckv, attn_core=core, cap_bias=cap_bias
             )
             # next step's stale state is just this step's own fresh chunk —
             # no collective at all (ring_attention.py semantics).  Sync steps
@@ -248,10 +250,12 @@ class DiTDenoiseRunner:
         eps_full = all_gather_seq(eps_rows)
         return eps_full, kv_new
 
-    def _device_loop(self, params, latents, enc, gs, num_steps):
+    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
         my_enc, _, _ = branch_select(cfg, enc)
+        my_mask, _, _ = branch_select(cfg, cap_mask)
+        cap_bias = dit_mod.caption_mask_bias(my_mask)
         batch = latents.shape[0]
         compute_dtype = params["proj_in"]["kernel"].dtype
 
@@ -281,7 +285,8 @@ class DiTDenoiseRunner:
 
         def step(x, sstate, kv, s, phase_sync):
             eps, kv = self._eval_model(
-                params, x, s, kv, phase_sync, cap_kv, c6_all, temb_all, pos
+                params, x, s, kv, phase_sync, cap_kv, c6_all, temb_all, pos,
+                cap_bias,
             )
             guided = combine_guidance(cfg, eps, gs, batch)
             x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
@@ -315,21 +320,28 @@ class DiTDenoiseRunner:
         lat_spec = P(DP_AXIS)
         enc_spec = P(None, DP_AXIS)
 
-        def loop(params, latents, enc, gs):
+        def loop(params, latents, enc, cap_mask, gs):
             return shard_map(
                 device_loop,
                 mesh=cfg.mesh,
-                in_specs=(P(), lat_spec, enc_spec, P()),
+                in_specs=(P(), lat_spec, enc_spec, enc_spec, P()),
                 out_specs=lat_spec,
                 check_vma=False,
-            )(params, latents, enc, gs)
+            )(params, latents, enc, cap_mask, gs)
 
         return jax.jit(loop)
 
-    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20):
-        """Same contract as PipeFusionRunner.generate."""
+    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
+                 cap_mask=None):
+        """Same contract as PipeFusionRunner.generate.  ``cap_mask``
+        [n_br, B, Lt] (1 = real caption token) masks padded text tokens out
+        of cross-attention (PixArt semantics); None attends to all."""
         self.scheduler.set_timesteps(num_inference_steps)
         if num_inference_steps not in self._compiled:
             self._compiled[num_inference_steps] = self._build(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
-        return self._compiled[num_inference_steps](self.params, latents, enc, gs)
+        if cap_mask is None:
+            cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
+        return self._compiled[num_inference_steps](
+            self.params, latents, enc, jnp.asarray(cap_mask, jnp.float32), gs
+        )

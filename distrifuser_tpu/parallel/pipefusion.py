@@ -59,7 +59,7 @@ from ..models.dit import DiTConfig
 from ..ops.linear import linear
 from .guidance import branch_select, combine_guidance
 from ..schedulers import BaseScheduler
-from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
+from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
 
 
 def _tree_dynamic_index(tree, i):
@@ -103,6 +103,20 @@ class PipeFusionRunner:
                 f"attn_impl={cfg.attn_impl!r} applies to the displaced DiT "
                 "runner (parallel/dit_sp.py); the pipeline's per-block KV "
                 "cache is its own attention layout"
+            )
+        if cfg.mode == "no_sync":
+            raise ValueError(
+                "mode='no_sync' does not apply to the patch pipeline: its KV "
+                "caches refresh every tick by construction (freezing warmup "
+                "KV is the displaced runners' knob); use the displaced DiT "
+                "runner for no_sync"
+            )
+        if not cfg.use_cuda_graph:
+            raise ValueError(
+                "use_cuda_graph=False (--no_cuda_graph) does not apply to "
+                "the patch pipeline: the tick schedule exists only inside "
+                "the fused scan program — there is no per-step host loop to "
+                "fall back to"
             )
         self.stages = cfg.n_device_per_batch
         self.patches = self.stages if pipe_patches is None else pipe_patches
@@ -151,7 +165,8 @@ class PipeFusionRunner:
         """Guided epsilon from per-branch epsilon (chunk or full)."""
         return combine_guidance(self.cfg, eps, gs, batch)
 
-    def _run_stage(self, blocks_local, cap_kv_local, kv_cache, h, c6, offset, valid):
+    def _run_stage(self, blocks_local, cap_kv_local, kv_cache, h, c6, offset,
+                   valid, cap_bias):
         """Run this device's Lp blocks on ``h`` [B, Lq, hid] against the
         full-sequence stale caches; returns (h_out, committed kv_cache)."""
 
@@ -161,6 +176,7 @@ class PipeFusionRunner:
             h_out, (k_new, v_new) = dit_mod.dit_block(
                 bp, self.dcfg, hcur, c6, ckv,
                 self_kv=(cache[0], cache[1]), patch_start=offset,
+                cap_bias=cap_bias,
             )
             return h_out, jnp.stack([k_new, v_new])
 
@@ -176,7 +192,7 @@ class PipeFusionRunner:
     # the device program
     # ------------------------------------------------------------------
 
-    def _device_loop(self, params, latents, enc, gs, num_steps):
+    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
         n_stage = self.stages
@@ -190,6 +206,8 @@ class PipeFusionRunner:
         is_last = p_idx == n_stage - 1
 
         my_enc = self._branch_enc(enc)
+        my_mask, _, _ = branch_select(cfg, cap_mask)
+        cap_bias = dit_mod.caption_mask_bias(my_mask)
         batch = latents.shape[0]
         bloc = my_enc.shape[0]  # batch inside the pipeline (2B when folded)
 
@@ -242,7 +260,13 @@ class PipeFusionRunner:
             sstate = _tree_dynamic_update(sstate, new_st, m, pred)
             return x_full, sstate
 
-        n_sync = min(cfg.warmup_steps + 1, num_steps)
+        # full_sync runs every step as the exact mega-patch (mirroring
+        # dit_sp.py): the displaced schedule below never engages
+        n_sync = (
+            num_steps
+            if cfg.mode == "full_sync"
+            else min(cfg.warmup_steps + 1, num_steps)
+        )
 
         # ---------------- phase 1: synchronous mega-patch warmup ----------
         def warmup_tick(carry, tau):
@@ -284,7 +308,8 @@ class PipeFusionRunner:
             valid = (p_idx == active) & (s < n_sync)
             c6 = c6_all[s_c]
             h_out, kv_cache = self._run_stage(
-                blocks_local, cap_kv_local, kv_cache, h_in, c6, 0, valid
+                blocks_local, cap_kv_local, kv_cache, h_in, c6, 0, valid,
+                cap_bias,
             )
 
             eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_c])
@@ -343,7 +368,7 @@ class PipeFusionRunner:
             c6 = c6_all[s_my]
             h_out, kv_cache = self._run_stage(
                 blocks_local, cap_kv_local, kv_cache, h_in, c6,
-                m_my * chunk, ok_my,
+                m_my * chunk, ok_my, cap_bias,
             )
 
             eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_my])
@@ -424,21 +449,24 @@ class PipeFusionRunner:
         lat_spec = P(DP_AXIS)
         enc_spec = P(None, DP_AXIS)
 
-        def loop(params, latents, enc, gs):
+        def loop(params, latents, enc, cap_mask, gs):
             return shard_map(
                 device_loop,
                 mesh=cfg.mesh,
-                in_specs=(param_specs, lat_spec, enc_spec, P()),
+                in_specs=(param_specs, lat_spec, enc_spec, enc_spec, P()),
                 out_specs=lat_spec,
                 check_vma=False,
-            )(params, latents, enc, gs)
+            )(params, latents, enc, cap_mask, gs)
 
         return jax.jit(loop)
 
-    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20):
+    def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
+                 cap_mask=None):
         """latents [B, H/8, W/8, C] fp32, enc [2, B, Lt, caption_dim]
-        (uncond, cond branch-major, like DenoiseRunner).  Returns the final
-        latent, full on every device."""
+        (uncond, cond branch-major, like DenoiseRunner).  ``cap_mask``
+        [n_br, B, Lt] (1 = real token) masks padded caption tokens out of
+        cross-attention; None attends to all.  Returns the final latent,
+        full on every device."""
         # Re-pin the scheduler tables every call: a cached program can
         # re-trace later and must not read tables left by a different step
         # count (see DenoiseRunner.generate).
@@ -446,4 +474,8 @@ class PipeFusionRunner:
         if num_inference_steps not in self._compiled:
             self._compiled[num_inference_steps] = self._build(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
-        return self._compiled[num_inference_steps](self.params, latents, enc, gs)
+        if cap_mask is None:
+            cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
+        return self._compiled[num_inference_steps](
+            self.params, latents, enc, jnp.asarray(cap_mask, jnp.float32), gs
+        )

@@ -160,6 +160,46 @@ class PipelineOutput:
     images: List[Any]
 
 
+def _build_decoder(cfg: DistriConfig, vae_config: vae_mod.VAEConfig):
+    """(jitted decode fn, parallel?) for the config's geometry: sequence-
+    parallel over sp when the latent divides, row-tiled above 2048px, plain
+    whole-latent otherwise (shared by the UNet and DiT pipelines)."""
+    parallel = (
+        cfg.is_sp and cfg.vae_sp
+        and cfg.latent_height % cfg.n_device_per_batch == 0
+    )
+    if parallel:
+        # Sequence-parallel decode over the same sp axis as the denoiser
+        # (beyond the reference, which decodes replicated on every rank):
+        # exact, n x faster, 1/n activation footprint.
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from .parallel.collectives import gather_rows
+        from .utils.config import DP_AXIS, SP_AXIS
+
+        n = cfg.n_device_per_batch
+
+        def _dec(p, l):
+            return shard_map(
+                lambda p_, l_: gather_rows(
+                    vae_mod.decode_sp(p_, vae_config, l_, n)
+                ),
+                mesh=cfg.mesh,
+                in_specs=(P(), P(DP_AXIS, SP_AXIS)),
+                out_specs=P(DP_AXIS),
+                check_vma=False,
+            )(p, l)
+
+        return jax.jit(_dec), True
+    # Above 2048px the whole-latent decode's activations dominate HBM on one
+    # chip; switch to the row-tiled decoder (models/vae.py).
+    tile = 64 if cfg.latent_height > 128 else 0
+    return jax.jit(
+        lambda p, l: vae_mod.decode(p, vae_config, l, tile=tile)
+    ), False
+
+
 class _DistriPipelineBase:
     """Shared machinery; subclasses define the text-encoding recipe."""
 
@@ -184,41 +224,7 @@ class _DistriPipelineBase:
         self.runner = make_runner(distri_config, unet_config, unet_params, scheduler)
         cfg = distri_config
         # public introspection: which decode path was installed
-        self.vae_decode_parallel = (
-            cfg.is_sp and cfg.vae_sp
-            and cfg.latent_height % cfg.n_device_per_batch == 0
-        )
-        if self.vae_decode_parallel:
-            # Sequence-parallel decode over the same sp axis as the UNet
-            # (beyond the reference, which decodes replicated on every rank):
-            # exact, n x faster, 1/n activation footprint.
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            from .parallel.collectives import gather_rows
-            from .utils.config import DP_AXIS, SP_AXIS
-
-            n = cfg.n_device_per_batch
-
-            def _dec(p, l):
-                return shard_map(
-                    lambda p_, l_: gather_rows(
-                        vae_mod.decode_sp(p_, self.vae_config, l_, n)
-                    ),
-                    mesh=cfg.mesh,
-                    in_specs=(P(), P(DP_AXIS, SP_AXIS)),
-                    out_specs=P(DP_AXIS),
-                    check_vma=False,
-                )(p, l)
-
-            self._decode = jax.jit(_dec)
-        else:
-            # Above 2048px the whole-latent decode's activations dominate HBM
-            # on one chip; switch to the row-tiled decoder (models/vae.py).
-            tile = 64 if cfg.latent_height > 128 else 0
-            self._decode = jax.jit(
-                lambda p, l: vae_mod.decode(p, self.vae_config, l, tile=tile)
-            )
+        self._decode, self.vae_decode_parallel = _build_decoder(cfg, vae_config)
         # jit one encoder forward per text-encoder config (re-encoding the
         # prompt every call would otherwise dispatch hundreds of eager ops)
         self._clip_jitted = [
@@ -521,3 +527,266 @@ class DistriSDPipeline(_DistriPipelineBase):
         out = self._clip(0, ids)
         emb = out["last_hidden_state"]
         return emb.reshape(n_br, b, *emb.shape[1:]), None
+
+
+class DistriPixArtPipeline:
+    """PixArt-alpha (DiT family): T5 text encoder + PixArt transformer + KL
+    VAE, driven by the displaced-patch DiT runner or, with
+    ``parallelism="pipefusion"``, the patch-pipeline runner.
+
+    The model family is beyond the reference (it targets SD/SDXL only); the
+    pipeline surface mirrors DistriSDXLPipeline so framework users switch
+    model families without switching APIs.  Padded caption tokens are masked
+    out of cross-attention (PixArt semantics) and the 1024-class micro-
+    conditioning on (resolution, aspect) is folded into the timestep
+    embedding bias ahead of the loop (models/dit.py fold_size_condition —
+    exact, because the size embedding is timestep-independent).
+    """
+
+    # PixArt-alpha trains with 120 caption tokens
+    max_token_length = 120
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        dit_config,
+        dit_params,
+        vae_config: vae_mod.VAEConfig,
+        vae_params,
+        scheduler: BaseScheduler,
+        tokenizer,
+        t5_config,
+        t5_params,
+    ):
+        from .models import dit as dit_mod
+        from .parallel.dit_sp import DiTDenoiseRunner
+        from .parallel.pipefusion import PipeFusionRunner
+
+        cfg = distri_config
+        self.distri_config = cfg
+        self.dit_config = dit_config
+        self.vae_config = vae_config
+        self.vae_params = vae_params
+        self.scheduler = scheduler
+        self.tokenizer = tokenizer
+        self.t5 = (t5_config, t5_params)
+        dit_params = dit_mod.fold_size_condition(
+            dit_params, dit_config, float(cfg.height), float(cfg.width)
+        )
+        runner_cls = (
+            PipeFusionRunner if cfg.parallelism == "pipefusion"
+            else DiTDenoiseRunner
+        )
+        self.runner = runner_cls(cfg, dit_config, dit_params, scheduler)
+        self._decode, self.vae_decode_parallel = _build_decoder(cfg, vae_config)
+        if t5_params is not None:
+            from .models.t5 import t5_encode
+
+            self._t5_jitted = jax.jit(
+                lambda prm, ids, mask: t5_encode(prm, t5_config, ids, mask)
+            )
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        distri_config: DistriConfig,
+        pretrained_model_name_or_path: str,
+        scheduler: str | BaseScheduler = "dpm-solver",
+        dtype=None,
+        variant: Optional[str] = None,
+        **kwargs,
+    ) -> "DistriPixArtPipeline":
+        """Load a local PixArt snapshot (transformer/, vae/, text_encoder/
+        (T5), tokenizer/)."""
+        from .models import dit as dit_mod
+        from .models import t5 as t5_mod
+        from .models.weights import convert_pixart_state_dict, convert_t5_state_dict
+
+        root = pretrained_model_name_or_path
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root!r} is not a local model directory (no network egress)."
+            )
+        dtype = dtype or distri_config.dtype
+        dcfg = _config_from_snapshot(
+            root, "transformer", dit_mod.dit_config_from_json,
+            dit_mod.pixart_config,
+        )
+        dit_params = convert_pixart_state_dict(
+            load_sharded_safetensors(os.path.join(root, "transformer"),
+                                     variant=variant),
+            patch_size=dcfg.patch_size, eps_channels=dcfg.out_channels,
+            dtype=dtype,
+        )
+        vae_params = convert_vae_state_dict(
+            load_sharded_safetensors(os.path.join(root, "vae"),
+                                     variant=variant), dtype
+        )
+        t5cfg = _config_from_snapshot(
+            root, "text_encoder", t5_mod.t5_config_from_json,
+            t5_mod.t5_v1_1_xxl_config,
+        )
+        t5_params = convert_t5_state_dict(
+            load_sharded_safetensors(os.path.join(root, "text_encoder"),
+                                     variant=variant), dtype
+        )
+        from .native import release_mappings
+
+        release_mappings()
+        tok = _t5_tokenizer_or_fallback(
+            os.path.join(root, "tokenizer"), t5cfg.vocab_size
+        )
+        sched = _scheduler_from_snapshot(root, scheduler)
+        return cls(distri_config, dcfg, dit_params,
+                   _config_from_snapshot(root, "vae",
+                                         vae_mod.vae_config_from_json,
+                                         vae_mod.sd_vae_config),
+                   vae_params, sched, tok, t5cfg, t5_params)
+
+    @classmethod
+    def from_params(cls, distri_config, dit_config, dit_params, vae_config,
+                    vae_params, t5_config=None, t5_params=None,
+                    scheduler="ddim", tokenizer=None):
+        sched = (scheduler if isinstance(scheduler, BaseScheduler)
+                 else get_scheduler(scheduler))
+        tok = tokenizer or SimpleTokenizer(
+            vocab_size=t5_config.vocab_size if t5_config else 32128,
+            eos=1, bos=0,
+        )
+        return cls(distri_config, dit_config, dit_params, vae_config,
+                   vae_params, sched, tok, t5_config, t5_params)
+
+    # -- reference API ----------------------------------------------------
+    def set_progress_bar_config(self, **kwargs):
+        pass
+
+    def prepare(self, num_inference_steps: int = 20, **kwargs) -> None:
+        if num_inference_steps not in self.runner._compiled:
+            self.scheduler.set_timesteps(num_inference_steps)
+            self.runner._compiled[num_inference_steps] = self.runner._build(
+                num_inference_steps
+            )
+
+    def _encode(self, prompts, negs):
+        cfg = self.distri_config
+        texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        b = len(prompts)
+        t5cfg, t5p = self.t5
+        if t5p is None:
+            # weight-free smoke path: deterministic pseudo-embeddings, so the
+            # random-weight runners still exercise the full pipeline surface
+            ids = np.asarray(self.tokenizer(texts, self.max_token_length)
+                             if isinstance(self.tokenizer, SimpleTokenizer)
+                             else _tokenize(self.tokenizer, texts))
+            emb = jnp.stack([
+                jax.random.normal(
+                    jax.random.PRNGKey(int(s) % (2**31)),
+                    (ids.shape[1], self.dit_config.caption_dim), jnp.float32,
+                )
+                for s in ids.sum(axis=1)
+            ])
+            mask = np.ones(ids.shape, np.float32)
+        else:
+            if isinstance(self.tokenizer, SimpleTokenizer):
+                ids = self.tokenizer(texts, self.max_token_length)
+                # real tokens + the first (sentinel) EOS are attended, like a
+                # transformers T5 attention_mask; the eos-padding tail is not
+                mask = (ids != self.tokenizer.eos).astype(np.float32)
+                first_eos = np.argmax(ids == self.tokenizer.eos, axis=1)
+                mask[np.arange(len(ids)), first_eos] = 1.0
+            else:
+                out = self.tokenizer(
+                    texts, padding="max_length",
+                    max_length=self.max_token_length, truncation=True,
+                    return_tensors="np",
+                )
+                ids = np.asarray(out["input_ids"])
+                mask = np.asarray(out["attention_mask"], np.float32)
+            emb = self._t5_jitted(
+                t5p, jnp.asarray(ids, jnp.int32), jnp.asarray(mask)
+            )
+        emb = jnp.asarray(emb)
+        emb = emb.reshape(n_br, b, emb.shape[1], emb.shape[2])
+        mask = jnp.asarray(np.asarray(mask).reshape(n_br, b, -1))
+        return emb, mask
+
+    def __call__(
+        self,
+        prompt: str | List[str],
+        negative_prompt: str | List[str] = "",
+        num_inference_steps: int = 20,
+        guidance_scale: float = 4.5,
+        seed: int = 0,
+        output_type: str = "pil",
+        latents=None,
+        **kwargs,
+    ) -> PipelineOutput:
+        cfg = self.distri_config
+        if "height" in kwargs or "width" in kwargs:
+            raise ValueError(
+                "height and width are fixed in DistriConfig (reference "
+                "pipelines.py:47-55)"
+            )
+        if not cfg.do_classifier_free_guidance:
+            guidance_scale = 1.0
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        negs = (
+            [negative_prompt] * len(prompts)
+            if isinstance(negative_prompt, str)
+            else list(negative_prompt)
+        )
+        assert len(prompts) == cfg.batch_size, (
+            f"config batch_size={cfg.batch_size}, got {len(prompts)} prompts"
+        )
+        emb, mask = self._encode(prompts, negs)
+
+        lat_shape = (len(prompts), cfg.latent_height, cfg.latent_width,
+                     self.dit_config.in_channels)
+        self.scheduler.set_timesteps(num_inference_steps)
+        if latents is None:
+            latents = jax.random.normal(jax.random.PRNGKey(seed), lat_shape,
+                                        jnp.float32)
+            latents = latents * self.scheduler.init_noise_sigma
+        else:
+            latents = jnp.asarray(latents, jnp.float32)
+            assert latents.shape == lat_shape, (latents.shape, lat_shape)
+
+        latent = self.runner.generate(
+            latents, emb, guidance_scale=guidance_scale,
+            num_inference_steps=num_inference_steps, cap_mask=mask,
+        )
+        if output_type == "latent":
+            return PipelineOutput(images=list(np.asarray(latent)))
+        image = self._decode(
+            self.vae_params, latent / self.vae_config.scaling_factor
+        )
+        image = np.asarray(image, np.float32)
+        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
+        if output_type == "np":
+            return PipelineOutput(images=list(image))
+        from PIL import Image
+
+        return PipelineOutput(
+            images=[Image.fromarray((im * 255).round().astype(np.uint8))
+                    for im in image]
+        )
+
+
+def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
+    """transformers T5 tokenizer from the snapshot dir, else the hash
+    fallback with a LOUD warning (same policy as the CLIP loader)."""
+    try:
+        from transformers import AutoTokenizer
+
+        return AutoTokenizer.from_pretrained(path)
+    except Exception as e:
+        print(
+            f"WARNING: failed to load T5 tokenizer from {path!r} "
+            f"({type(e).__name__}: {e}); falling back to the hash-based "
+            "SimpleTokenizer. Generated images will NOT match real-prompt "
+            "outputs.",
+            file=sys.stderr,
+            flush=True,
+        )
+        return SimpleTokenizer(vocab_size=vocab_size, eos=1, bos=0)

@@ -3,13 +3,15 @@ default — the reference's method on the transformer family) or patch-level
 pipeline parallelism (--parallelism pipefusion, PipeFusion arXiv 2405.14430)
 — see docs/DESIGN.md.
 
-No public DiT checkpoint is mountable on this box, so the script runs the
-PixArt-style architecture with random weights (structure/latency demo, the
-same role --random_weights plays for sdxl_example).  The denoised latent is
-saved as .npy; with real weights a VAE decode would follow, exactly as in
-pipelines.py.
+With ``--model <snapshot dir>`` this loads a real PixArt snapshot through
+DistriPixArtPipeline (T5 text encoder, diffusers-format transformer + VAE,
+caption masking, 1024-class micro-conditioning) and writes a PNG.  Without
+it the PixArt-style architecture runs with random weights (structure/latency
+demo, the same role --random_weights plays for sdxl_example) and the
+denoised latent is saved as .npy.
 
     python scripts/dit_example.py --tiny_model --num_inference_steps 8
+    python scripts/dit_example.py --model /data/PixArt-XL-2-1024-MS
 """
 import argparse
 
@@ -26,6 +28,11 @@ def main():
                         "default: one per stage)")
     parser.add_argument("--depth", type=int, default=None,
                         help="override DiT depth (must divide into stages)")
+    parser.add_argument("--model", type=str, default=None,
+                        help="local PixArt snapshot dir (transformer/, vae/, "
+                        "text_encoder/, tokenizer/); omit for random weights")
+    parser.add_argument("--prompt", type=str,
+                        default="an astronaut riding a horse on the moon")
     args = parser.parse_args()
     args.image_size = args.image_size or [1024, 1024]
     if args.parallelism not in ("patch", "pipefusion"):
@@ -46,6 +53,24 @@ def main():
         args.image_size = [128, 128]
     distri_config = config_from_args(args)
     stages = distri_config.n_device_per_batch
+
+    if args.model:
+        from distrifuser_tpu.pipelines import DistriPixArtPipeline
+
+        pipe = DistriPixArtPipeline.from_pretrained(
+            distri_config, args.model, scheduler=args.scheduler
+        )
+        pipe.prepare(num_inference_steps=args.num_inference_steps)
+        out = pipe(
+            prompt=args.prompt,
+            num_inference_steps=args.num_inference_steps,
+            guidance_scale=args.guidance_scale,
+            seed=args.seed,
+        )
+        if is_main_process():
+            out.images[0].save(args.output_path)
+            print(f"image -> {args.output_path}")
+        return
 
     if args.tiny_model:
         dcfg = dit_mod.tiny_dit_config(depth=args.depth or 2 * stages)

@@ -326,3 +326,30 @@ def test_geometry_validation():
                          do_classifier_free_guidance=False, split_batch=False),
             dcfg8, params8, get_scheduler("ddim"),
         )
+
+
+def test_full_sync_mode_runs_every_step_exact():
+    """mode='full_sync' (ADVICE r2): the displaced schedule must never
+    engage — every step runs as the exact mega-patch, matching the dense
+    loop even when warmup_steps alone would hand off after one step."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = pipe_config(4, do_cfg=False, warmup_steps=1, mode="full_sync")
+    runner = PipeFusionRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=5)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 5,
+                     do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_inapplicable_knobs_rejected():
+    """no_sync and --no_cuda_graph have no pipeline semantics: loud errors
+    beat silently ignoring the request (ADVICE r2)."""
+    dcfg, params = make_model()
+    with pytest.raises(ValueError, match="no_sync"):
+        PipeFusionRunner(pipe_config(4, do_cfg=False, mode="no_sync"),
+                         dcfg, params, get_scheduler("ddim"))
+    with pytest.raises(ValueError, match="use_cuda_graph"):
+        PipeFusionRunner(pipe_config(4, do_cfg=False, use_cuda_graph=False),
+                         dcfg, params, get_scheduler("ddim"))
