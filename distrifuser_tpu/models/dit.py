@@ -70,6 +70,13 @@ class DiTConfig:
     # ratio); the embedders live in the param tree and fold_size_condition
     # applies them (exactly) ahead of the denoise loop
     use_additional_conditions: bool = False
+    # Positional-embedding coordinate scaling (diffusers PatchEmbed):
+    # coords = arange(side) / (side / base_size) / interpolation_scale.
+    # PixArt trains 1024-class models with interpolation_scale=2 over a
+    # base grid of 64 — raw arange coords would put every token's embedding
+    # at 2x the trained frequency.  base_size None = tokens_per_side.
+    interpolation_scale: float = 1.0
+    pos_embed_base_size: Optional[int] = None
 
     @property
     def tokens_per_side(self) -> int:
@@ -98,10 +105,13 @@ class DiTConfig:
 def pixart_config(sample_size: int = 128) -> DiTConfig:
     """PixArt-alpha-XL/2 geometry: T5-v1.1-XXL caption width (models/t5.py
     is the matching in-repo encoder); 1024-class checkpoints (latent side
-    128) additionally micro-condition on resolution/aspect."""
+    128) additionally micro-condition on resolution/aspect and train with
+    interpolation_scale=2 positional coordinates."""
     return DiTConfig(
         sample_size=sample_size,
         use_additional_conditions=sample_size == 128,
+        interpolation_scale=float(max(sample_size // 64, 1)),
+        pos_embed_base_size=sample_size // 2,
     )
 
 
@@ -118,9 +128,10 @@ def dit_config_from_json(source) -> DiTConfig:
     d = dict(source)
     heads = d.get("num_attention_heads", 16)
     sample = d.get("sample_size", 128)
+    ps = d.get("patch_size", 2)
     return DiTConfig(
         sample_size=sample,
-        patch_size=d.get("patch_size", 2),
+        patch_size=ps,
         in_channels=d.get("in_channels", 4),
         out_channels=d.get("in_channels", 4),
         hidden_size=heads * d.get("attention_head_dim", 72),
@@ -131,6 +142,11 @@ def dit_config_from_json(source) -> DiTConfig:
         use_additional_conditions=d.get(
             "use_additional_conditions", sample == 128
         ),
+        # diffusers: config value, else max(sample_size // 64, 1)
+        interpolation_scale=float(
+            d.get("interpolation_scale") or max(sample // 64, 1)
+        ),
+        pos_embed_base_size=sample // ps,
     )
 
 
@@ -244,7 +260,13 @@ def unpatchify(cfg: DiTConfig, tokens: jnp.ndarray, channels: int) -> jnp.ndarra
 
 def pos_embed_table(cfg: DiTConfig, dtype=jnp.float32) -> jnp.ndarray:
     """2D sin-cos position table [N, hidden] (DiT convention: half the
-    channels encode the row coordinate, half the column)."""
+    channels encode the row coordinate, half the column).
+
+    Coordinates follow diffusers' PatchEmbed scaling so converted PixArt
+    weights see the frequencies they trained with:
+    ``arange(side) / (side / base_size) / interpolation_scale`` — at the
+    checkpoint's native size side == base_size, reducing to
+    ``arange / interpolation_scale``."""
     h = cfg.hidden_size
     side = cfg.tokens_per_side
     dim = h // 2
@@ -255,7 +277,12 @@ def pos_embed_table(cfg: DiTConfig, dtype=jnp.float32) -> jnp.ndarray:
         out = pos[:, None] * omega[None, :]
         return jnp.concatenate([jnp.sin(out), jnp.cos(out)], axis=-1)
 
-    coords = jnp.arange(side, dtype=jnp.float32)
+    base = cfg.pos_embed_base_size or side
+    coords = (
+        jnp.arange(side, dtype=jnp.float32)
+        / (side / base)
+        / cfg.interpolation_scale
+    )
     row = axis_embed(coords, dim)  # [side, dim]
     col = axis_embed(coords, dim)
     grid_row = jnp.repeat(row, side, axis=0)            # [N, dim]

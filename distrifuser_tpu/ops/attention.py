@@ -125,11 +125,14 @@ def sdpa(q, k, v, *, heads: int):
         # in-repo kernel, so setting either knob selects it.
         tuned = ("DISTRIFUSER_TPU_FLASH_BQ" in os.environ
                  or "DISTRIFUSER_TPU_FLASH_BK" in os.environ)
-        impl = os.environ.get(
-            "DISTRIFUSER_TPU_FLASH_IMPL",
-            "inrepo" if (interpret or tuned) else "upstream",
-        )
-        if impl == "upstream" and not interpret and _upstream_flash_available():
+        explicit = os.environ.get("DISTRIFUSER_TPU_FLASH_IMPL")
+        impl = explicit or ("inrepo" if (interpret or tuned) else "upstream")
+        # the probe gates only the DEFAULT route: an explicit IMPL=upstream
+        # is honored past it (the trace-time except below still guards), so
+        # a probe misjudgment can never override an operator's choice
+        if impl == "upstream" and not interpret and (
+            explicit == "upstream" or _upstream_flash_available()
+        ):
             try:
                 return upstream_flash_sdpa(q, k, v, heads=heads)
             except Exception as e:  # unstable jax.experimental surface:

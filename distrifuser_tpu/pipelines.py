@@ -676,9 +676,18 @@ class DistriPixArtPipeline:
         if t5p is None:
             # weight-free smoke path: deterministic pseudo-embeddings, so the
             # random-weight runners still exercise the full pipeline surface
-            ids = np.asarray(self.tokenizer(texts, self.max_token_length)
-                             if isinstance(self.tokenizer, SimpleTokenizer)
-                             else _tokenize(self.tokenizer, texts))
+            if isinstance(self.tokenizer, SimpleTokenizer):
+                ids = np.asarray(self.tokenizer(texts, self.max_token_length))
+            else:
+                # explicit max_length: tok.model_max_length is 512 (or unset
+                # = effectively unbounded) for T5 tokenizers; the pipeline
+                # contract is 120 caption tokens
+                out = self.tokenizer(
+                    texts, padding="max_length",
+                    max_length=self.max_token_length, truncation=True,
+                    return_tensors="np",
+                )
+                ids = np.asarray(out["input_ids"])
             emb = jnp.stack([
                 jax.random.normal(
                     jax.random.PRNGKey(int(s) % (2**31)),

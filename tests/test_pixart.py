@@ -291,3 +291,35 @@ def test_pixart_from_pretrained_synthetic_snapshot(tmp_path):
                output_type="latent")
     assert np.asarray(out.images[0]).shape == (16, 16, 4)
     assert np.isfinite(np.asarray(out.images[0])).all()
+
+
+def test_pos_embed_interpolation_scale():
+    """Coordinate scaling follows diffusers PatchEmbed: at native size the
+    coords are arange/interpolation_scale, so the 1024-class table must
+    equal a plain table evaluated at halved coordinates."""
+    base = dit_mod.DiTConfig(sample_size=16, hidden_size=64, depth=1,
+                             num_heads=4, caption_dim=32)
+    scaled = dit_mod.DiTConfig(sample_size=16, hidden_size=64, depth=1,
+                               num_heads=4, caption_dim=32,
+                               interpolation_scale=2.0, pos_embed_base_size=8)
+
+    t_scaled = np.asarray(dit_mod.pos_embed_table(scaled))
+    # manual: coords arange(8)/(8/8)/2 = arange(8)/2
+    dim = 32
+    om = 1.0 / (10000.0 ** (np.arange(dim // 2) / (dim // 2)))
+    coords = np.arange(8) / 2.0
+    ax = np.concatenate([np.sin(coords[:, None] * om),
+                         np.cos(coords[:, None] * om)], axis=-1)
+    row = np.repeat(ax, 8, axis=0)
+    col = np.tile(ax, (8, 1))
+    np.testing.assert_allclose(t_scaled, np.concatenate([row, col], axis=-1),
+                               rtol=1e-6, atol=1e-6)
+    # default config unchanged (identity scaling)
+    t_base = np.asarray(dit_mod.pos_embed_table(base))
+    assert not np.allclose(t_base, t_scaled)
+
+    # from_json wires the diffusers rule: 1024-class -> scale 2, base 64
+    cfg = dit_mod.dit_config_from_json({"sample_size": 128})
+    assert cfg.interpolation_scale == 2.0 and cfg.pos_embed_base_size == 64
+    cfg512 = dit_mod.dit_config_from_json({"sample_size": 64})
+    assert cfg512.interpolation_scale == 1.0
