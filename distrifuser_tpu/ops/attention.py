@@ -210,7 +210,7 @@ def patch_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
         full_kv = kv
     elif ctx.is_sync:
         gathered = lax.all_gather(kv, ctx.axis)  # [n, B, L, 2C]
-        ctx.emit(name, gathered)
+        ctx.emit(name, gathered, kind="attn")
         full_kv = _flatten_seq(gathered)
     else:
         gathered = ctx.stale(name)
@@ -218,7 +218,7 @@ def patch_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
         gathered = lax.dynamic_update_index_in_dim(gathered, kv, ctx.split_idx(), 0)
         full_kv = _flatten_seq(gathered)
         if ctx.refresh:
-            ctx.emit_refresh_gather(name, kv)
+            ctx.emit_refresh_gather(name, kv, kind="attn")
     k, v = split_kv(full_kv)
     return linear(p["to_out"], sdpa(q, k, v, heads=heads))
 

@@ -101,7 +101,7 @@ def patch_conv2d(p, x, ctx: PatchContext, name: str, *, stride: int = 1):
     if ctx.is_sync:
         top, bottom = halo_exchange(x, ph, ctx.n, ctx.axis)
         # Fresh halos double as the seed state for the stale phase.
-        ctx.emit(name, jnp.stack([top, bottom]))
+        ctx.emit(name, jnp.stack([top, bottom]), kind="conv2d")
     else:
         halos = ctx.stale(name)  # [2, B, ph, W, C] from the previous step
         top, bottom = halos[0], halos[1]

@@ -82,7 +82,7 @@ def patch_group_norm(
         if ctx.is_sync:
             gathered = lax.all_gather(m, ctx.axis)  # [n, 2, B, G]
             full = gathered.mean(axis=0)
-            ctx.emit(name, gathered)
+            ctx.emit(name, gathered, kind="gn")
         else:
             gathered = ctx.stale(name)
             idx = ctx.split_idx()
@@ -93,7 +93,7 @@ def patch_group_norm(
                 full = gathered.mean(axis=0) + (m - own_stale)
             else:  # stale_gn: stale peers + fresh self (groupnorm.py:52-55)
                 full = (gathered.sum(axis=0) - own_stale + m) / ctx.n
-            ctx.emit_refresh_gather(name, m)
+            ctx.emit_refresh_gather(name, m, kind="gn")
         var = full[1] - jnp.square(full[0])
         if ctx.mode == "corrected_async_gn":
             local_var = m[1] - jnp.square(m[0])

@@ -116,7 +116,7 @@ def ring_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
     # attn-only emit here would change the scan carry structure and fail to
     # trace).
     if ctx.refresh:
-        ctx.emit(name, kv_local)
+        ctx.emit(name, kv_local, kind="attn")
 
     # own (always fresh) contribution merged first; then n-1 hops deliver
     # every *peer* chunk exactly once (hop i brings the chunk of device

@@ -39,6 +39,13 @@ from jax import lax
 
 from ..utils.config import SP_AXIS
 
+# Trace-time registry of state-name -> layer kind ("attn" | "gn" | "conv2d"),
+# filled by the emitting op itself (the only party that KNOWS its kind) so
+# reports never classify by name heuristics.  Populated as a Python side
+# effect during tracing; names are unique per architecture, so a flat map is
+# safe across models.
+KIND_REGISTRY: Dict[str, str] = {}
+
 # Static phases of the denoising loop. ``SYNC`` is the warmup / full_sync
 # path (all collectives blocking-fresh, reference counter <= warmup_steps,
 # e.g. pp/conv2d.py:92); ``STALE`` is the displaced-patch steady state.
@@ -106,19 +113,23 @@ class PatchContext:
             )
         return buf
 
-    def emit(self, name: str, value: Any) -> None:
+    def emit(self, name: str, value: Any, kind: str = None) -> None:
         if name in self.state_out:
             raise ValueError(f"duplicate state emission for layer {name!r}")
+        if kind is not None:
+            KIND_REGISTRY[name] = kind
         self.state_out[name] = value
 
     # ------------------------------------------------------------------
     # refresh emissions (stale phase): immediate or deferred-batched
     # ------------------------------------------------------------------
 
-    def emit_refresh_gather(self, name: str, local: Any) -> None:
+    def emit_refresh_gather(self, name: str, local: Any, kind: str = None) -> None:
         """Record `local` as this layer's next-step gathered state
         ([n, *local.shape] after the all-gather) — immediately, or deferred
         into the step-end batched exchange under ``batch_comm``."""
+        if kind is not None:
+            KIND_REGISTRY[name] = kind
         if self.batch_comm:
             if name in self._def_gather or name in self.state_out:
                 raise ValueError(f"duplicate state emission for layer {name!r}")
@@ -131,6 +142,7 @@ class PatchContext:
         layer's next-step halo state [2, B, halo, W, C] (stacked
         from-prev/from-next, matching the sync-phase emission in
         ops/conv.py)."""
+        KIND_REGISTRY[name] = "conv2d"
         if self.batch_comm:
             if name in self._def_halo or name in self.state_out:
                 raise ValueError(f"duplicate state emission for layer {name!r}")

@@ -47,7 +47,7 @@ from ..models.unet import (
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import gather_cols, gather_rows
-from .context import PHASE_STALE, PHASE_SYNC, PatchContext
+from .context import KIND_REGISTRY, PHASE_STALE, PHASE_SYNC, PatchContext
 from .guidance import branch_select, combine_guidance
 
 
@@ -451,16 +451,12 @@ class DenoiseRunner:
             self.params, lat, enc, added, gs,
         )
 
-        def layer_type(name: str) -> str:
-            if "attn1" in name:
-                return "attn"
-            if "norm" in name:
-                return "gn"
-            return "conv2d"
-
+        # The eval_shape trace above just populated KIND_REGISTRY: each op
+        # declares its own kind at emit time, so classification never falls
+        # back to name heuristics.
         report: Dict[str, int] = {}
         for name, s in shapes.items():
-            t = layer_type(name)
+            t = KIND_REGISTRY.get(name, "other")
             report[t] = report.get(t, 0) + int(np.prod(s.shape))
         if cfg.verbose:
             total = sum(report.values())

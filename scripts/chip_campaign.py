@@ -1,0 +1,262 @@
+"""Serialized real-chip measurement campaign.
+
+One process claims the chip ONCE and runs every measurement phase in
+sequence — the lease rules on this box (BENCH_NOTES.md) forbid concurrent
+claimants and killing mid-run, so a campaign beats N separate scripts.
+Each phase prints one JSON line (flushed) and failures skip forward, so a
+partial run still yields data.  A wall-clock deadline bounds the whole
+campaign; remaining phases emit explicit "skipped" lines.
+
+Phases (cheap compiles first):
+  attn       XLA vs in-repo Pallas vs upstream flash at SDXL shapes
+  tune       (block_q, block_k) sweep for the in-repo kernel
+  b1024_step 50-step stepwise latency @1024 (small programs)
+  b1024      50-step fused latency @1024, default routing
+  b1024_xla  same with DISTRIFUSER_TPU_FLASH=0 (the A/B round 2 never got)
+  b2048      50-step fused latency @2048
+  trace      jax.profiler trace of a short run -> chip_logs/trace_r3
+
+Usage:
+  PYTHONPATH=/root/.axon_site:/root/repo setsid nohup \
+      python scripts/chip_campaign.py > chip_logs/campaign.log 2>&1 &
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = time.time()
+
+
+def emit(phase: str, **kv):
+    print(json.dumps({"phase": phase, "t": round(time.time() - START, 1), **kv}),
+          flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phases", type=str,
+                    default="attn,tune,b1024_step,b1024,b1024_xla,b2048,trace")
+    ap.add_argument("--deadline_s", type=float, default=9000.0,
+                    help="total wall-clock budget; later phases skip")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--test_times", type=int, default=3)
+    args = ap.parse_args()
+    phases = args.phases.split(",")
+
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"),
+    )
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:
+        pass
+
+    t0 = time.time()
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError as e:
+        emit("init", ok=False, error=str(e)[:200])
+        sys.exit(3)
+    emit("init", ok=True, platform=dev.platform,
+         init_s=round(time.time() - t0, 1))
+
+    def left():
+        return args.deadline_s - (time.time() - START)
+
+    def timed(fn, iters):
+        fn().block_until_ready()
+        t = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        out.block_until_ready()
+        return (time.perf_counter() - t) / iters
+
+    # ---------------- attn: impl comparison at SDXL shapes ----------------
+    if "attn" in phases and left() > 600:
+        from distrifuser_tpu.ops.attention import _sdpa_xla
+        from distrifuser_tpu.ops.flash_attention import (
+            flash_sdpa, upstream_flash_sdpa,
+        )
+
+        shapes = [  # (L, C, heads) — SDXL levels at 1024/2048/3840 px
+            (4096, 640, 10), (1024, 1280, 20),
+            (16384, 640, 10), (4096, 1280, 20),
+            (57600, 640, 10),
+        ]
+        for (L, C, H) in shapes:
+            if left() < 300:
+                emit("attn", L=L, skipped="deadline")
+                continue
+            d = C // H
+            ks = jax.random.split(jax.random.PRNGKey(0), 3)
+            q = jax.random.normal(ks[0], (2, L, C), jnp.bfloat16)
+            k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
+            v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
+
+            def xla_path():
+                return _sdpa_xla(
+                    q.reshape(2, L, H, d), k.reshape(2, L, H, d),
+                    v.reshape(2, L, H, d), 1.0 / d**0.5,
+                ).reshape(2, L, C)
+
+            res = {}
+            for name, fn in [
+                ("xla", jax.jit(xla_path)),
+                ("inrepo", jax.jit(lambda: flash_sdpa(q, k, v, heads=H))),
+                ("upstream", jax.jit(
+                    lambda: upstream_flash_sdpa(q, k, v, heads=H))),
+            ]:
+                try:
+                    res[name] = round(timed(fn, 20) * 1e3, 3)
+                except Exception as e:
+                    res[name] = f"failed:{type(e).__name__}"
+            emit("attn", L=L, heads=H, ms=res)
+
+    # ---------------- tune: in-repo kernel tile sweep ----------------------
+    if "tune" in phases and left() > 600:
+        from distrifuser_tpu.ops.flash_attention import flash_sdpa
+
+        for (L, C, H) in [(4096, 640, 10), (16384, 640, 10)]:
+            if left() < 300:
+                emit("tune", L=L, skipped="deadline")
+                continue
+            ks = jax.random.split(jax.random.PRNGKey(0), 3)
+            q = jax.random.normal(ks[0], (2, L, C), jnp.bfloat16)
+            k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
+            v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
+            res = {}
+            for bq in (128, 256, 512):
+                for bk in (128, 256, 512, 1024):
+                    if L % bq or L % bk:
+                        continue
+                    try:
+                        res[f"{bq}x{bk}"] = round(timed(
+                            jax.jit(lambda bq=bq, bk=bk: flash_sdpa(
+                                q, k, v, heads=H, block_q=bq, block_k=bk)),
+                            10,
+                        ) * 1e3, 3)
+                    except Exception as e:
+                        res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
+            emit("tune", L=L, heads=H, ms=res)
+
+    # ---------------- full-model latencies --------------------------------
+    def bench_unet(size, stepwise, label, flash_env=None):
+        if flash_env is not None:
+            os.environ["DISTRIFUSER_TPU_FLASH"] = flash_env
+        elif "DISTRIFUSER_TPU_FLASH" in os.environ:
+            del os.environ["DISTRIFUSER_TPU_FLASH"]
+        from distrifuser_tpu import DistriConfig
+        from distrifuser_tpu.models import unet as unet_mod
+        from distrifuser_tpu.parallel.runner import make_runner
+        from distrifuser_tpu.schedulers import get_scheduler
+
+        ucfg = unet_mod.sdxl_config()
+        cfg = DistriConfig(devices=jax.devices()[:1], height=size, width=size,
+                           warmup_steps=4, parallelism="patch",
+                           use_cuda_graph=not stepwise)
+        params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, cfg.dtype)
+        runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
+        lat = jax.random.normal(jax.random.PRNGKey(1),
+                                (1, size // 8, size // 8, ucfg.in_channels),
+                                jnp.float32)
+        enc = jax.random.normal(jax.random.PRNGKey(2),
+                                (2, 1, 77, ucfg.cross_attention_dim), cfg.dtype)
+        emb = (ucfg.projection_class_embeddings_input_dim
+               - 6 * ucfg.addition_time_embed_dim)
+        added = {"text_embeds": jnp.zeros((2, 1, emb), cfg.dtype),
+                 "time_ids": jnp.tile(jnp.asarray(
+                     [size, size, 0, 0, size, size], jnp.float32)[None, None],
+                     (2, 1, 1))}
+
+        def run():
+            out = runner.generate(lat, enc, guidance_scale=5.0,
+                                  num_inference_steps=args.steps,
+                                  added_cond=added)
+            jax.block_until_ready(out)
+            return out
+
+        tc0 = time.time()
+        run()  # warmup/compile
+        compile_s = round(time.time() - tc0, 1)
+        times = [0.0] * args.test_times
+        for i in range(args.test_times):
+            t = time.perf_counter()
+            run()
+            times[i] = time.perf_counter() - t
+        med = statistics.median(times)
+        emit(label, size=size, steps=args.steps, s=round(med, 4),
+             compile_s=compile_s,
+             vs_a100=round(6.6 * args.steps / 50 / med, 3) if size == 1024 else None)
+        return med
+
+    for label, size, stepwise, flash in [
+        ("b1024_step", 1024, True, None),
+        ("b1024", 1024, False, None),
+        ("b1024_xla", 1024, False, "0"),
+        ("b2048", 2048, False, None),
+    ]:
+        if label not in phases:
+            continue
+        if left() < 900:
+            emit(label, skipped="deadline")
+            continue
+        try:
+            bench_unet(size, stepwise, label, flash)
+        except Exception as e:
+            emit(label, ok=False, error=f"{type(e).__name__}: {str(e)[:200]}")
+
+    # ---------------- trace: profiler capture ------------------------------
+    if "trace" in phases and left() > 300:
+        try:
+            trace_dir = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "chip_logs", "trace_r3",
+            )
+            os.makedirs(trace_dir, exist_ok=True)
+            from distrifuser_tpu import DistriConfig
+            from distrifuser_tpu.models import unet as unet_mod
+            from distrifuser_tpu.parallel.runner import make_runner
+            from distrifuser_tpu.schedulers import get_scheduler
+
+            ucfg = unet_mod.sdxl_config()
+            cfg = DistriConfig(devices=jax.devices()[:1], height=1024,
+                               width=1024, warmup_steps=1, parallelism="patch")
+            params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg,
+                                               cfg.dtype)
+            runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
+            lat = jnp.zeros((1, 128, 128, ucfg.in_channels), jnp.float32)
+            enc = jnp.zeros((2, 1, 77, ucfg.cross_attention_dim), cfg.dtype)
+            emb = (ucfg.projection_class_embeddings_input_dim
+                   - 6 * ucfg.addition_time_embed_dim)
+            added = {"text_embeds": jnp.zeros((2, 1, emb), cfg.dtype),
+                     "time_ids": jnp.zeros((2, 1, 6), jnp.float32)}
+
+            def short():
+                return runner.generate(lat, enc, guidance_scale=5.0,
+                                       num_inference_steps=4, added_cond=added)
+
+            jax.block_until_ready(short())  # compile outside the trace
+            with jax.profiler.trace(trace_dir):
+                jax.block_until_ready(short())
+            emit("trace", ok=True, dir=trace_dir)
+        except Exception as e:
+            emit("trace", ok=False, error=f"{type(e).__name__}: {str(e)[:200]}")
+
+    emit("done", total_s=round(time.time() - START, 1))
+
+
+if __name__ == "__main__":
+    main()
