@@ -29,6 +29,7 @@ from jax import lax
 
 from ..parallel.context import PatchContext
 from .linear import linear
+from .sdpa_routing import Route, lookup
 
 
 import os
@@ -66,22 +67,56 @@ def _upstream_flash_available() -> bool:
     return _UPSTREAM_PROBE_OK
 
 
-def _flash_eligible(q, k, heads: int) -> bool:
-    """Route to the Pallas flash kernel: TPU, long block-aligned sequences,
-    MXU-friendly head_dim.  DISTRIFUSER_TPU_FLASH=0 disables, =1 forces
-    (interpret mode off-TPU is for tests only)."""
-    env = os.environ.get("DISTRIFUSER_TPU_FLASH")
-    if env == "0":
-        return False
+def _resolve_route(q, k, heads: int) -> Route:
+    """Pick the SDPA backend for this shape.
+
+    Resolution order (sdpa_routing module docstring): operator env overrides
+    (DISTRIFUSER_TPU_FLASH=0 disables flash, =1 forces it — interpret mode
+    off-TPU is for tests only; _IMPL/_BQ/_BK select kernel and tiles), then
+    the checked-in measured table, then the analytic default (flash for
+    long block-aligned sequences on TPU).
+    """
     b, lq, c = q.shape
     lk = k.shape[1]
     d = c // heads
     aligned = lq % 128 == 0 and lk % 128 == 0 and d % 8 == 0 and c % heads == 0
-    if env == "1":
-        return aligned
-    if jax.devices()[0].platform == "cpu":
-        return False
-    return aligned and lk >= _FLASH_MIN_LEN
+    cpu = jax.devices()[0].platform == "cpu"
+
+    env = os.environ.get("DISTRIFUSER_TPU_FLASH")
+    explicit_impl = os.environ.get("DISTRIFUSER_TPU_FLASH_IMPL")
+    bq = os.environ.get("DISTRIFUSER_TPU_FLASH_BQ")
+    bk = os.environ.get("DISTRIFUSER_TPU_FLASH_BK")
+    tiles = (int(bq) if bq else None, int(bk) if bk else None)
+
+    if env == "0" or not aligned:
+        return Route("xla")
+    forced = env == "1"
+    if explicit_impl:
+        if explicit_impl == "xla":
+            return Route("xla")
+        if forced or (not cpu and lk >= _FLASH_MIN_LEN):
+            return Route(explicit_impl, *tiles)
+        return Route("xla")
+    if forced:
+        # explicit tile tuning targets the in-repo kernel; CPU = interpret
+        impl = "inrepo" if (cpu or tiles != (None, None)) else "upstream"
+        return Route(impl, *tiles)
+    if cpu:
+        return Route("xla")
+
+    measured = lookup(lk, d)
+    if tiles != (None, None) and lk >= _FLASH_MIN_LEN:
+        # explicit tile tuning selects the in-repo kernel; measured tiles
+        # fill whichever axis the operator left unset
+        inrepo_measured = measured if measured and measured.impl == "inrepo" else None
+        return Route(
+            "inrepo",
+            tiles[0] or (inrepo_measured.block_q if inrepo_measured else None),
+            tiles[1] or (inrepo_measured.block_k if inrepo_measured else None),
+        )
+    if measured is not None:
+        return Route(measured.impl, measured.block_q, measured.block_k)
+    return Route("upstream" if lk >= _FLASH_MIN_LEN else "xla")
 
 
 # Above this many fp32 logit elements (B*H*Lq*Lk), the unfused softmax path
@@ -107,7 +142,8 @@ def sdpa(q, k, v, *, heads: int):
     exceed ~1 GiB (e.g. the VAE's 65k-token single-head mid attention at
     2048x2048, where materializing L^2 logits cannot fit).
     """
-    if _flash_eligible(q, k, heads):
+    route = _resolve_route(q, k, heads)
+    if route.impl != "xla":
         from .flash_attention import (
             DEFAULT_BLOCK_K,
             DEFAULT_BLOCK_Q,
@@ -115,22 +151,15 @@ def sdpa(q, k, v, *, heads: int):
             upstream_flash_sdpa,
         )
 
-        # Forcing via env on a non-TPU backend means interpret mode (tests):
+        # On a non-TPU backend flash only runs in interpret mode (tests):
         # Mosaic kernels only compile for TPU.
         interpret = jax.devices()[0].platform == "cpu"
-        # DISTRIFUSER_TPU_FLASH_IMPL: "upstream" (default on TPU —
-        # jax.experimental's tuned kernel) or "inrepo" (the kernel above;
-        # also the interpret-mode test path, upstream has no interpret knob).
-        # Explicit BQ/BK tile tuning (scripts/tune_flash.py) targets the
-        # in-repo kernel, so setting either knob selects it.
-        tuned = ("DISTRIFUSER_TPU_FLASH_BQ" in os.environ
-                 or "DISTRIFUSER_TPU_FLASH_BK" in os.environ)
+        # the probe gates only the DEFAULT/table route: an explicit
+        # IMPL=upstream is honored past it (the trace-time except below
+        # still guards), so a probe misjudgment can never override an
+        # operator's choice
         explicit = os.environ.get("DISTRIFUSER_TPU_FLASH_IMPL")
-        impl = explicit or ("inrepo" if (interpret or tuned) else "upstream")
-        # the probe gates only the DEFAULT route: an explicit IMPL=upstream
-        # is honored past it (the trace-time except below still guards), so
-        # a probe misjudgment can never override an operator's choice
-        if impl == "upstream" and not interpret and (
+        if route.impl == "upstream" and not interpret and (
             explicit == "upstream" or _upstream_flash_available()
         ):
             try:
@@ -142,9 +171,8 @@ def sdpa(q, k, v, *, heads: int):
                     f"({type(e).__name__}: {e}); using in-repo Pallas kernel",
                     file=sys.stderr,
                 )
-        # block sizes tunable per chip without code changes
-        bq = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BQ", DEFAULT_BLOCK_Q))
-        bk = int(os.environ.get("DISTRIFUSER_TPU_FLASH_BK", DEFAULT_BLOCK_K))
+        bq = route.block_q or DEFAULT_BLOCK_Q
+        bk = route.block_k or DEFAULT_BLOCK_K
         lq, lk = q.shape[1], k.shape[1]
         bq = bq if lq % bq == 0 else DEFAULT_BLOCK_Q
         bk = bk if lk % bk == 0 else DEFAULT_BLOCK_K

@@ -41,7 +41,8 @@ def emit(phase: str, **kv):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phases", type=str,
-                    default="attn,tune,b1024_step,b1024,b1024_xla,b2048,trace")
+                    default="attn,tune,b1024_step,b1024,b1024_xla,b2048,"
+                            "b2048_ring,trace")
     ap.add_argument("--deadline_s", type=float, default=9000.0,
                     help="total wall-clock budget; later phases skip")
     ap.add_argument("--steps", type=int, default=50)
@@ -123,7 +124,7 @@ def main():
                     res[name] = round(timed(fn, 20) * 1e3, 3)
                 except Exception as e:
                     res[name] = f"failed:{type(e).__name__}"
-            emit("attn", L=L, heads=H, ms=res)
+            emit("attn", L=L, heads=H, head_dim=d, ms=res)
 
     # ---------------- tune: in-repo kernel tile sweep ----------------------
     if "tune" in phases and left() > 600:
@@ -150,10 +151,10 @@ def main():
                         ) * 1e3, 3)
                     except Exception as e:
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
-            emit("tune", L=L, heads=H, ms=res)
+            emit("tune", L=L, heads=H, head_dim=C // H, ms=res)
 
     # ---------------- full-model latencies --------------------------------
-    def bench_unet(size, stepwise, label, flash_env=None):
+    def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather"):
         if flash_env is not None:
             os.environ["DISTRIFUSER_TPU_FLASH"] = flash_env
         elif "DISTRIFUSER_TPU_FLASH" in os.environ:
@@ -166,6 +167,7 @@ def main():
         ucfg = unet_mod.sdxl_config()
         cfg = DistriConfig(devices=jax.devices()[:1], height=size, width=size,
                            warmup_steps=4, parallelism="patch",
+                           attn_impl=attn_impl,
                            use_cuda_graph=not stepwise)
         params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, cfg.dtype)
         runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
@@ -202,11 +204,15 @@ def main():
              vs_a100=round(6.6 * args.steps / 50 / med, 3) if size == 1024 else None)
         return med
 
-    for label, size, stepwise, flash in [
-        ("b1024_step", 1024, True, None),
-        ("b1024", 1024, False, None),
-        ("b1024_xla", 1024, False, "0"),
-        ("b2048", 2048, False, None),
+    # b2048 vs b2048_ring: the gather-vs-ring layout A/B at the north-star
+    # resolution (VERDICT r2 task 3) — the analytic HBM table (BENCH_NOTES)
+    # says ring is what fits 3840²; this measures its latency cost at 2048².
+    for label, size, stepwise, flash, impl in [
+        ("b1024_step", 1024, True, None, "gather"),
+        ("b1024", 1024, False, None, "gather"),
+        ("b1024_xla", 1024, False, "0", "gather"),
+        ("b2048", 2048, False, None, "gather"),
+        ("b2048_ring", 2048, False, None, "ring"),
     ]:
         if label not in phases:
             continue
@@ -214,7 +220,7 @@ def main():
             emit(label, skipped="deadline")
             continue
         try:
-            bench_unet(size, stepwise, label, flash)
+            bench_unet(size, stepwise, label, flash, impl)
         except Exception as e:
             emit(label, ok=False, error=f"{type(e).__name__}: {str(e)[:200]}")
 

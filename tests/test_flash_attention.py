@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distrifuser_tpu.ops.attention import _flash_eligible, sdpa
+from distrifuser_tpu.ops.attention import _resolve_route, sdpa
 from distrifuser_tpu.ops.flash_attention import flash_sdpa
 
 
@@ -54,12 +54,12 @@ def test_routing_gates():
     q = jnp.zeros((1, 256, 32))
     k = jnp.zeros((1, 256, 32))
     # CPU default: no flash
-    assert not _flash_eligible(q, k, heads=2)
+    assert _resolve_route(q, k, heads=2).impl == "xla"
     os.environ["DISTRIFUSER_TPU_FLASH"] = "1"
     try:
-        assert _flash_eligible(q, k, heads=2)
+        assert _resolve_route(q, k, heads=2).impl != "xla"
         # unaligned length -> never
-        assert not _flash_eligible(jnp.zeros((1, 200, 32)), k, heads=2)
+        assert _resolve_route(jnp.zeros((1, 200, 32)), k, heads=2).impl == "xla"
     finally:
         del os.environ["DISTRIFUSER_TPU_FLASH"]
 

@@ -1,0 +1,142 @@
+"""SDPA routing: env overrides > measured table > analytic default.
+
+The reference always runs fused SDPA (modules/pp/attn.py:153); our backend
+choice is a checked-in measured table (ops/sdpa_routing.py) with env vars
+demoted to operator overrides. These tests pin the resolution order and the
+log -> table updater round trip."""
+
+import json
+import os
+import sys
+
+import jax
+import pytest
+
+import importlib
+
+attention = importlib.import_module("distrifuser_tpu.ops.attention")
+from distrifuser_tpu.ops import sdpa_routing
+from distrifuser_tpu.ops.sdpa_routing import Route
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+
+class _Dev:
+    def __init__(self, platform):
+        self.platform = platform
+
+
+def _route(monkeypatch, platform="tpu", lq=4096, lk=4096, c=640, heads=10):
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev(platform)])
+    q = jax.ShapeDtypeStruct((2, lq, c), jnp.bfloat16)
+    k = jax.ShapeDtypeStruct((2, lk, c), jnp.bfloat16)
+    return attention._resolve_route(q, k, heads)
+
+
+def test_env_off_wins_over_everything(monkeypatch):
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "0")
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES",
+                        {(64, 12): Route("inrepo", 256, 512)})
+    assert _route(monkeypatch) == Route("xla")
+
+
+def test_unaligned_always_xla(monkeypatch):
+    assert _route(monkeypatch, lq=4095, lk=4095) == Route("xla")
+
+
+def test_cpu_defaults_to_xla(monkeypatch):
+    assert _route(monkeypatch, platform="cpu") == Route("xla")
+
+
+def test_force_on_cpu_is_inrepo_interpret_path(monkeypatch):
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "1")
+    assert _route(monkeypatch, platform="cpu").impl == "inrepo"
+
+
+def test_measured_table_drives_default_route(monkeypatch):
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES",
+                        {(64, 12): Route("inrepo", 256, 512),
+                         (64, 16): Route("xla")})
+    # L=4096 -> bucket 12 -> measured inrepo with tuned tiles
+    assert _route(monkeypatch) == Route("inrepo", 256, 512)
+    # L=57600 -> bucket ~15.8 -> nearest measured is 16 -> xla beats flash
+    assert _route(monkeypatch, lq=57600 // 8 * 8, lk=57344) == Route("xla")
+
+
+def test_env_tiles_override_measured_tiles(monkeypatch):
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES",
+                        {(64, 12): Route("inrepo", 256, 512)})
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH_BQ", "128")
+    assert _route(monkeypatch) == Route("inrepo", 128, 512)
+
+
+def test_explicit_impl_wins_over_table(monkeypatch):
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES",
+                        {(64, 12): Route("xla")})
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH_IMPL", "upstream")
+    assert _route(monkeypatch).impl == "upstream"
+
+
+def test_unmeasured_falls_to_analytic_default(monkeypatch):
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES", {})
+    assert _route(monkeypatch).impl == "upstream"  # long seq on TPU
+    assert _route(monkeypatch, lq=512, lk=512).impl == "xla"  # short
+
+
+def test_lookup_requires_matching_head_dim():
+    assert sdpa_routing.lookup(4096, 64) is None  # shipped table is empty
+    table = {(64, 12): Route("upstream")}
+    old = sdpa_routing.MEASURED_ROUTES
+    sdpa_routing.MEASURED_ROUTES = table
+    try:
+        assert sdpa_routing.lookup(5000, 64) == Route("upstream")
+        assert sdpa_routing.lookup(5000, 160) is None
+    finally:
+        sdpa_routing.MEASURED_ROUTES = old
+
+
+def test_updater_round_trip(tmp_path):
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 2.0, "inrepo": 1.5, "upstream": 1.0}},
+        {"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 9.0, "inrepo": 8.0, "upstream": "failed:XlaError"}},
+        {"phase": "tune", "L": 16384, "heads": 10, "head_dim": 64,
+         "ms": {"128x128": 8.0, "256x512": 6.5}},
+        {"phase": "b1024", "size": 1024, "s": 7.0},  # ignored: no ms dict
+    ]
+    log.write_text("non-json noise\n"
+                   + "\n".join(json.dumps(rec) for rec in lines) + "\n")
+
+    attn, tune = upd.parse_log(str(log))
+    assert len(attn) == 2 and len(tune) == 1
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][0] == "upstream"
+    impl, bq, bk, _comment = routes[(64, 14)]
+    assert (impl, bq, bk) == ("inrepo", 256, 512)  # tuned tiles attached
+
+    block = upd.render_block(routes, "unit-test")
+    ns = {"Route": Route}
+    exec(block.replace(upd.BEGIN, "").replace(upd.END, ""), ns)
+    assert ns["MEASURED_ROUTES"][(64, 14)] == Route("inrepo", 256, 512)
+    assert ns["MEASURED_PROVENANCE"] == "unit-test"
+
+
+def test_sdpa_still_computes_on_cpu(monkeypatch):
+    """End to end: routing lands on a working path whatever the table says."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    monkeypatch.setattr(sdpa_routing, "MEASURED_ROUTES",
+                        {(64, 7): Route("inrepo", 64, 64)})
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, 128, 128), jnp.float32)
+    out = attention.sdpa(q, q, q, heads=2)
+    assert out.shape == (1, 128, 128)
+    assert np.isfinite(np.asarray(out)).all()
