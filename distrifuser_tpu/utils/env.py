@@ -36,11 +36,21 @@ def check_env() -> None:
 
 
 def default_backend() -> str:
-    """Best available platform name ('tpu' when chips are attached, else 'cpu')."""
+    """Best available platform *class*: 'tpu' when TPU chips are attached
+    (including through the axon PJRT plugin, whose backend registers under
+    the name "axon" while lowering canonicalizes axon->tpu), else whatever
+    JAX reports ('cpu', 'gpu').
+
+    Callers key behavior (bf16 default dtype, kernel routing) on the class,
+    so tunnelled TPU backends MUST normalize to 'tpu' here: before this,
+    DistriConfig defaulted to float32 on the real chip — 2x the HBM bytes
+    of bf16 on every activation and weight.
+    """
     try:
-        return jax.default_backend()
+        backend = jax.default_backend()
     except RuntimeError:
         return "cpu"
+    return "tpu" if backend in ("axon", "tpu") else backend
 
 
 def is_power_of_2(n: int) -> bool:

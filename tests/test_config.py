@@ -68,3 +68,21 @@ def test_latent_geometry(devices8):
     assert cfg.latent_height == 128 and cfg.latent_width == 128
     assert cfg.patch_height() == 32  # 128 rows / 4 sp devices
     assert cfg.patch_height(scale=4) == 8
+
+
+def test_axon_backend_classifies_as_tpu(monkeypatch):
+    """The axon PJRT plugin registers its backend under the name "axon"
+    (jax_platforms="axon,cpu"); dtype selection keys on the platform CLASS,
+    so axon must normalize to tpu — before this, DistriConfig silently
+    defaulted to float32 on the real chip (2x bf16's HBM bytes)."""
+    import jax.numpy as jnp
+
+    from distrifuser_tpu.utils import env
+
+    for plugin_name, want in [("axon", "tpu"), ("tpu", "tpu"), ("cpu", "cpu")]:
+        monkeypatch.setattr(jax, "default_backend", lambda p=plugin_name: p)
+        assert env.default_backend() == want
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    cfg = DistriConfig(devices=jax.devices()[:1], use_cuda_graph=False)
+    assert cfg.dtype == jnp.bfloat16
