@@ -193,6 +193,13 @@ def main():
         sys.exit(3)
     on_tpu = devices[0].platform != "cpu"
     preset = args.preset or ("sdxl" if on_tpu else "tiny")
+    # provenance on stderr: the round-3 dtype audit found every prior chip
+    # number had silently run fp32 (BENCH_NOTES.md) — make the effective
+    # platform/dtype visible in every bench log so that cannot recur
+    from distrifuser_tpu.utils.env import default_backend
+    print(f"bench provenance: platform={devices[0].platform} "
+          f"backend_class={default_backend()} jax={jax.__version__}",
+          file=sys.stderr, flush=True)
     if preset == "sdxl":
         ucfg = unet_mod.sdxl_config()
         size = args.image_size
@@ -207,6 +214,8 @@ def main():
         parallelism="patch",
     )
     dtype = dtype_cfg.dtype
+    print(f"bench provenance: model dtype={jnp.dtype(dtype).name}",
+          file=sys.stderr, flush=True)
     params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dtype)
     scheduler = get_scheduler("ddim")
 
