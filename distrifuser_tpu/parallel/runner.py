@@ -238,35 +238,47 @@ class DenoiseRunner:
         x = latents.astype(jnp.float32)
         sstate = sched.init_state(x.shape)
 
-        if cfg.parallelism != "patch" or cfg.mode == "full_sync":
-            # one phase for everything: naive_patch / tensor / full_sync
-            pstate0: Any = {"step": jnp.asarray(0)} if (
-                cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate"
-            ) else {}
-            x, pstate, sstate = step_sync(
-                params, 0, x, pstate0, sstate, my_enc, my_added, text_kv, gs
+        def state_zeros(pstate_seed):
+            """The patch-state carry structure, discovered WITHOUT inlining an
+            extra UNet copy: sync steps never read their input state (each
+            re-emits fresh gathered activations — _unet_local returns
+            ctx.state_out), so the fori carry can start as zeros of the right
+            shape instead of unrolling step 0.  The unroll was a third full
+            UNet body in the 50-step program — a third of the multi-ten-minute
+            remote compile that cost round 2 its benchmark number."""
+            _, pshape, _ = jax.eval_shape(
+                step_sync, params, jnp.asarray(0), x, pstate_seed, sstate,
+                my_enc, my_added, text_kv, gs,
             )
+            return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), pshape)
+
+        if cfg.parallelism != "patch" or cfg.mode == "full_sync":
+            # one phase for everything: naive_patch / tensor / full_sync.
+            # The {} seed also covers naive_patch/alternate: step()
+            # unconditionally overwrites pstate with {"step": i} there, so
+            # eval_shape returns the right carry structure from any seed.
 
             def body(i, carry):
                 x, ps, ss = carry
                 return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
 
-            x, _, _ = lax.fori_loop(1, num_steps, body, (x, pstate, sstate))
+            x, _, _ = lax.fori_loop(
+                0, num_steps, body, (x, state_zeros({}), sstate)
+            )
             return x
 
         # displaced patch parallelism: sync warmup then stale steady state.
         # counter <= warmup_steps selects sync (reference §2.3), so steps
         # 0..warmup inclusive are synchronous.
         n_sync = min(cfg.warmup_steps + 1, num_steps)
-        x, pstate, sstate = step_sync(
-            params, 0, x, None, sstate, my_enc, my_added, text_kv, gs
-        )
 
         def sync_body(i, carry):
             x, ps, ss = carry
             return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
 
-        x, pstate, sstate = lax.fori_loop(1, n_sync, sync_body, (x, pstate, sstate))
+        x, pstate, sstate = lax.fori_loop(
+            0, n_sync, sync_body, (x, state_zeros(None), sstate)
+        )
 
         def stale_body(carry, i):
             x, ps, ss = carry
