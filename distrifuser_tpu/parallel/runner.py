@@ -280,6 +280,11 @@ class DenoiseRunner:
             0, n_sync, sync_body, (x, state_zeros(None), sstate)
         )
 
+        if n_sync >= num_steps:
+            # all steps synchronous (e.g. short A/B runs): a zero-length scan
+            # would still compile its dead stale UNet body
+            return x
+
         def stale_body(carry, i):
             x, ps, ss = carry
             x, ps, ss = step_stale(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
