@@ -369,7 +369,13 @@ def _ln(x):
 def precompute_caption_kv(params, cfg: DiTConfig, enc: jnp.ndarray) -> jnp.ndarray:
     """Per-block cross-attention K/V, computed once per generation:
     [depth, B, Lt, 2*hidden].  The text tokens are constant across the
-    denoise loop (same reasoning as the UNet's precompute_text_kv)."""
+    denoise loop (same reasoning as the UNet's precompute_text_kv).
+
+    Computed outside dit_forward, so it applies the model-dtype entry cast
+    itself: fp32 caption embeds would otherwise yield fp32 KV whose
+    cross-attention output upcasts the residual stream for every remaining
+    block (the same silent 2x-HBM leak fixed in the UNet's cache)."""
+    enc = enc.astype(params["cap_fc1"]["kernel"].dtype)
     y = caption_project(params, enc)
     return jax.vmap(lambda kvp: linear(kvp, y))(params["blocks"]["cross_kv"])
 

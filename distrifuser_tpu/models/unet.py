@@ -538,6 +538,12 @@ def precompute_text_kv(params, encoder_hidden_states):
 
     Returns {layer_name: [B, L_text, 2C]} keyed identically to the forward's
     cross-attn names.
+
+    The cache is computed OUTSIDE unet_forward, so it must apply the same
+    model-dtype entry cast the forward applies to its own inputs
+    (unet_forward casts enc at its top): fp32 prompt embeds would otherwise
+    produce fp32 KV whose cross-attention output silently upcasts the whole
+    residual stream — at 2x the HBM bytes — for the rest of the UNet.
     """
     out = {}
 
@@ -545,7 +551,8 @@ def precompute_text_kv(params, encoder_hidden_states):
         if isinstance(tree, dict):
             for k, v in tree.items():
                 if k == "attn2" and isinstance(v, dict):
-                    out[f"{path}.{k}" if path else k] = linear(v["to_kv"], encoder_hidden_states)
+                    enc = encoder_hidden_states.astype(v["to_kv"]["kernel"].dtype)
+                    out[f"{path}.{k}" if path else k] = linear(v["to_kv"], enc)
                 elif isinstance(v, (dict, list)):
                     walk(v, f"{path}.{k}" if path else k)
         elif isinstance(tree, list):

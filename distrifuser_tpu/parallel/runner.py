@@ -418,11 +418,6 @@ class DenoiseRunner:
         cfg = self.cfg
         if cfg.parallelism != "patch" or not cfg.is_sp:
             return {}
-        batch_size = cfg.batch_size if batch_size is None else batch_size
-        if batch_size % cfg.dp_degree != 0:
-            raise ValueError(
-                f"batch_size {batch_size} not divisible by dp_degree {cfg.dp_degree}"
-            )
         self.scheduler.set_timesteps(2)
         step = self._make_step(PHASE_SYNC)
 
@@ -438,26 +433,9 @@ class DenoiseRunner:
             )
             return pstate
 
-        b = batch_size // cfg.dp_degree  # per-image-group batch
-        n_br = 2 if cfg.do_classifier_free_guidance else 1
-        lat = jax.ShapeDtypeStruct(
-            (b, cfg.latent_height, cfg.latent_width, self.ucfg.in_channels),
-            jnp.float32,
+        lat, enc, added, gs = self._abstract_inputs(
+            batch_size, text_len, per_group=True
         )
-        enc = jax.ShapeDtypeStruct(
-            (n_br, b, text_len, self.ucfg.cross_attention_dim), jnp.float32
-        )
-        added = None
-        if self.ucfg.addition_embed_type == "text_time":
-            emb = (
-                self.ucfg.projection_class_embeddings_input_dim
-                - 6 * self.ucfg.addition_time_embed_dim
-            )
-            added = {
-                "text_embeds": jax.ShapeDtypeStruct((n_br, b, emb), jnp.float32),
-                "time_ids": jax.ShapeDtypeStruct((n_br, b, 6), jnp.float32),
-            }
-        gs = jax.ShapeDtypeStruct((), jnp.float32)
 
         shapes = jax.eval_shape(
             lambda p, l, e, a, g: shard_map(
@@ -489,17 +467,27 @@ class DenoiseRunner:
     # public API
     # ------------------------------------------------------------------
 
-    def compiled_hlo(self, num_inference_steps: int = 4, batch_size: int = None,
-                     text_len: int = 77) -> str:
-        """Optimized-HLO text of the fused loop (abstract inputs, no device
-        execution beyond compilation).  Feed to utils/overlap.py to verify
-        the refresh collectives stay carry-only on this backend."""
+    def _abstract_inputs(self, batch_size: int = None, text_len: int = 77,
+                         *, per_group: bool = False):
+        """ShapeDtypeStructs for (lat, enc, added, gs) — the single source of
+        truth for the abstract program signature, shared by
+        comm_volume_report and compiled_hlo so the two observability paths
+        can never trace different programs (they once drifted on the enc
+        dtype).  generate() casts its real inputs to the same dtypes, so a
+        program lowered from these specs is the program that runs.
+
+        ``per_group=False`` gives the global-batch signature of the fused
+        loop (batch splits over the dp axis inside shard_map);
+        ``per_group=True`` gives the per-image-group shapes
+        comm_volume_report feeds its replicated-spec trace."""
         cfg = self.cfg
         b = cfg.batch_size if batch_size is None else batch_size
         if b % cfg.dp_degree != 0:
             raise ValueError(
                 f"batch_size {b} not divisible by dp_degree {cfg.dp_degree}"
             )
+        if per_group:
+            b = b // cfg.dp_degree
         n_br = 2 if cfg.do_classifier_free_guidance else 1
         lat = jax.ShapeDtypeStruct(
             (b, cfg.latent_height, cfg.latent_width, self.ucfg.in_channels),
@@ -519,6 +507,14 @@ class DenoiseRunner:
                 "time_ids": jax.ShapeDtypeStruct((n_br, b, 6), jnp.float32),
             }
         gs = jax.ShapeDtypeStruct((), jnp.float32)
+        return lat, enc, added, gs
+
+    def compiled_hlo(self, num_inference_steps: int = 4, batch_size: int = None,
+                     text_len: int = 77) -> str:
+        """Optimized-HLO text of the fused loop (abstract inputs, no device
+        execution beyond compilation).  Feed to utils/overlap.py to verify
+        the refresh collectives stay carry-only on this backend."""
+        lat, enc, added, gs = self._abstract_inputs(batch_size, text_len)
         # seed the jit cache: a following generate() with the same step count
         # reuses this program instead of re-compiling (jit caches by shape)
         fn = self._compiled.setdefault(
@@ -557,6 +553,14 @@ class DenoiseRunner:
             prompt_embeds = mk(prompt_embeds)
             if added is not None:
                 added = jax.tree.map(mk, added)
+        # Pin inputs to the abstract signature (_abstract_inputs): embeds in
+        # the model dtype, latents/time_ids fp32.  Without this, fp32-embeds
+        # callers silently retrace a second program that a compiled_hlo-seeded
+        # jit cache (and its overlap analysis) never describes.
+        prompt_embeds = jnp.asarray(prompt_embeds, self.cfg.dtype)
+        if added is not None and "text_embeds" in added:
+            added = dict(added)
+            added["text_embeds"] = jnp.asarray(added["text_embeds"], self.cfg.dtype)
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),

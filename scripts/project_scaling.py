@@ -1,0 +1,139 @@
+"""Analytic multi-chip scaling projection for displaced patch parallelism.
+
+Multi-chip TPU hardware is not reachable from this container, so this tool
+projects the n-way speedup the reference reports on GPUs
+(/root/reference/README.md:30: 1.8x/3.4x/6.1x at 2/4/8 A100s, 3840px) from
+first-party measurables:
+
+* per-device compute: XLA ``cost_analysis`` FLOPs of the single-device step,
+  divided across the patch axis (compute partitions exactly: each device
+  runs the same program on 1/n of the rows);
+* per-device comm: ``DenoiseRunner.comm_volume_report`` stale-state element
+  counts (the per-step refresh all-gather/ppermute traffic), at the model
+  dtype's width;
+* overlap: the HLO classifier (utils/overlap.py) shows 63/65 refresh
+  collectives defer to the carry — they ride ICI *while* the step computes —
+  so the projected step time is max(compute/n, comm/BW) + the two inline
+  collectives (output gather + CFG combine), not a sum.
+
+Constants default to public v5e figures (bf16 peak 197 TFLOP/s/chip, ICI
+~45 GB/s per direction per link) and are CLI-overridable; the projection is
+a roofline, not a measurement, and says so in its output.
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/project_scaling.py --image_size 2048 --mxu_frac 0.45
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image_size", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--peak_tflops", type=float, default=197.0,
+                    help="bf16 peak per chip (v5e: 197)")
+    ap.add_argument("--mxu_frac", type=float, default=0.45,
+                    help="sustained fraction of peak (round-1 measured ~0.47 "
+                    "at 1024px single-chip)")
+    ap.add_argument("--ici_gbps", type=float, default=45.0,
+                    help="ICI GB/s per direction per link (v5e ring)")
+    ap.add_argument("--ns", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.parallel.runner import make_runner
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    size = args.image_size
+    ucfg = unet_mod.sdxl_config()
+
+    # single-device per-step FLOPs from the compiled cost analysis
+    cfg1 = DistriConfig(devices=jax.devices()[:1], height=size, width=size,
+                        warmup_steps=4, parallelism="patch",
+                        dtype=jnp.bfloat16)
+    shape_params = jax.eval_shape(
+        lambda k: unet_mod.init_unet_params(k, ucfg, cfg1.dtype),
+        jax.random.PRNGKey(0),
+    )
+    shape_params = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), shape_params
+    )
+    runner1 = make_runner(cfg1, ucfg, shape_params, get_scheduler("ddim"))
+    fn = runner1._build(2)
+    n_br = 2 if cfg1.do_classifier_free_guidance else 1
+    lat = jax.ShapeDtypeStruct((1, size // 8, size // 8, ucfg.in_channels),
+                               jnp.float32)
+    enc = jax.ShapeDtypeStruct((n_br, 1, 77, ucfg.cross_attention_dim),
+                               cfg1.dtype)
+    emb = (ucfg.projection_class_embeddings_input_dim
+           - 6 * ucfg.addition_time_embed_dim)
+    added = {"text_embeds": jax.ShapeDtypeStruct((n_br, 1, emb), cfg1.dtype),
+             "time_ids": jax.ShapeDtypeStruct((n_br, 1, 6), jnp.float32)}
+    gs = jax.ShapeDtypeStruct((), jnp.float32)
+    compiled = fn.lower(shape_params, lat, enc, added, gs).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops_total = float(cost.get("flops", 0.0))
+    flops_step = flops_total / 2  # the program ran 2 steps
+    sustained = args.peak_tflops * 1e12 * args.mxu_frac
+
+    bytes_per_elem = jnp.dtype(cfg1.dtype).itemsize
+    print(f"# projection (roofline, not a measurement): SDXL {size}px, "
+          f"{args.steps}-step, CFG batch 2", flush=True)
+    print(f"# per-step FLOPs {flops_step/1e12:.2f} T; sustained "
+          f"{sustained/1e12:.1f} TFLOP/s/chip "
+          f"({args.mxu_frac:.0%} of {args.peak_tflops:.0f}T peak)")
+
+    devs = jax.devices()
+    for n in args.ns:
+        if n == 1:
+            t_step = flops_step / sustained
+            print(json.dumps({
+                "n": 1, "step_s": round(t_step, 4),
+                "total_s": round(t_step * args.steps, 2), "speedup": 1.0,
+            }))
+            t1 = t_step
+            continue
+        if len(devs) < 2 * n:
+            print(json.dumps({"n": n, "skipped":
+                              f"need {2*n} virtual devices"}))
+            continue
+        cfgn = DistriConfig(devices=devs[:2 * n], height=size, width=size,
+                            warmup_steps=4, parallelism="patch",
+                            dtype=jnp.bfloat16)
+        runnern = make_runner(cfgn, ucfg, shape_params, get_scheduler("ddim"))
+        rep = runnern.comm_volume_report()
+        deferred_elems = sum(rep.values())  # refresh traffic, overlappable
+        # inline per step: the full-output row gather (each device sends its
+        # patch to n-1 peers) + the CFG combine (one latent over 2 ranks)
+        lat_elems = size // 8 * (size // 8) * ucfg.in_channels
+        inline_elems = lat_elems * (n - 1) / n + lat_elems
+        t_comp = flops_step / (n * sustained)  # CFG axis holds batch fixed
+        bw = args.ici_gbps * 1e9
+        t_comm_deferred = deferred_elems * bytes_per_elem / bw
+        t_inline = inline_elems * 4 / bw  # latents are fp32
+        t_step = max(t_comp, t_comm_deferred) + t_inline
+        print(json.dumps({
+            "n": n, "step_s": round(t_step, 4),
+            "compute_s": round(t_comp, 4),
+            "deferred_comm_s": round(t_comm_deferred, 4),
+            "inline_comm_s": round(t_inline, 4),
+            "bound": "comm" if t_comm_deferred > t_comp else "compute",
+            "total_s": round(t_step * args.steps, 2),
+            "speedup": round(t1 / t_step, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main()

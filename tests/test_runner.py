@@ -125,3 +125,37 @@ def test_comm_volume_report(devices8):
     # single device: no comm, empty report
     runner1, _, _ = make_runner(devices8, 1)
     assert runner1.comm_volume_report() == {}
+
+
+def test_patch_mode_bf16_end_to_end(devices8):
+    """bf16 model dtype through the patch-parallel path (the real-chip
+    configuration since the axon dtype fix): the text-KV cache is computed
+    outside unet_forward and must apply the same model-dtype entry cast —
+    fp32 prompt embeds once upcast the whole residual stream after the
+    first cross-attention (caught via comm_volume_report tracing)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    cfg = DistriConfig(devices=devices8, height=256, width=256,
+                       warmup_steps=1, parallelism="patch",
+                       dtype=jnp.bfloat16, use_cuda_graph=False)
+    ucfg = unet_mod.tiny_config(sdxl=True)
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, cfg.dtype)
+    runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    report = runner.comm_volume_report()
+    assert set(report) == {"conv2d", "attn", "gn"}
+    lat = jax.random.normal(jax.random.PRNGKey(1),
+                            (1, 32, 32, ucfg.in_channels), jnp.float32)
+    # fp32 prompt embeds on purpose: the KV cache must cast, not upcast
+    enc = jax.random.normal(jax.random.PRNGKey(2),
+                            (2, 1, 77, ucfg.cross_attention_dim), jnp.float32)
+    emb = (ucfg.projection_class_embeddings_input_dim
+           - 6 * ucfg.addition_time_embed_dim)
+    added = {"text_embeds": jnp.zeros((2, 1, emb), jnp.float32),
+             "time_ids": jnp.zeros((2, 1, 6), jnp.float32)}
+    out = runner.generate(lat, enc, guidance_scale=5.0,
+                          num_inference_steps=3, added_cond=added)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
