@@ -42,7 +42,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phases", type=str,
                     default="attn,tune,b1024_step,b1024,b1024_xla,b2048,"
-                            "b2048_ring,trace")
+                            "b2048_ring,b1024_fp32,trace")
     ap.add_argument("--deadline_s", type=float, default=9000.0,
                     help="total wall-clock budget; later phases skip")
     ap.add_argument("--steps", type=int, default=50)
@@ -154,7 +154,8 @@ def main():
             emit("tune", L=L, heads=H, head_dim=C // H, ms=res)
 
     # ---------------- full-model latencies --------------------------------
-    def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather"):
+    def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather",
+                   dtype=None):
         if flash_env is not None:
             os.environ["DISTRIFUSER_TPU_FLASH"] = flash_env
         elif "DISTRIFUSER_TPU_FLASH" in os.environ:
@@ -167,8 +168,9 @@ def main():
         ucfg = unet_mod.sdxl_config()
         cfg = DistriConfig(devices=jax.devices()[:1], height=size, width=size,
                            warmup_steps=4, parallelism="patch",
-                           attn_impl=attn_impl,
+                           attn_impl=attn_impl, dtype=dtype,
                            use_cuda_graph=not stepwise)
+        emit(label + "_cfg", dtype=str(jnp.dtype(cfg.dtype).name))
         params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, cfg.dtype)
         runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
         lat = jax.random.normal(jax.random.PRNGKey(1),
@@ -199,20 +201,27 @@ def main():
             run()
             times[i] = time.perf_counter() - t
         med = statistics.median(times)
+        # vs_a100 only where the workload matches the baseline config: 1024px
+        # in the default (bf16) dtype — the fp32 ablation exists to quantify
+        # the dtype delta, not to compare against the A100 number
+        comparable = size == 1024 and dtype is None
         emit(label, size=size, steps=args.steps, s=round(med, 4),
              compile_s=compile_s,
-             vs_a100=round(6.6 * args.steps / 50 / med, 3) if size == 1024 else None)
+             vs_a100=round(6.6 * args.steps / 50 / med, 3) if comparable else None)
         return med
 
     # b2048 vs b2048_ring: the gather-vs-ring layout A/B at the north-star
     # resolution (VERDICT r2 task 3) — the analytic HBM table (BENCH_NOTES)
     # says ring is what fits 3840²; this measures its latency cost at 2048².
-    for label, size, stepwise, flash, impl in [
-        ("b1024_step", 1024, True, None, "gather"),
-        ("b1024", 1024, False, None, "gather"),
-        ("b1024_xla", 1024, False, "0", "gather"),
-        ("b2048", 2048, False, None, "gather"),
-        ("b2048_ring", 2048, False, None, "ring"),
+    # b1024_fp32 quantifies the round-3 dtype fix (prior rounds silently
+    # benched fp32 — BENCH_NOTES) on otherwise identical programs.
+    for label, size, stepwise, flash, impl, dt in [
+        ("b1024_step", 1024, True, None, "gather", None),
+        ("b1024", 1024, False, None, "gather", None),
+        ("b1024_xla", 1024, False, "0", "gather", None),
+        ("b2048", 2048, False, None, "gather", None),
+        ("b2048_ring", 2048, False, None, "ring", None),
+        ("b1024_fp32", 1024, False, None, "gather", jnp.float32),
     ]:
         if label not in phases:
             continue
@@ -220,7 +229,7 @@ def main():
             emit(label, skipped="deadline")
             continue
         try:
-            bench_unet(size, stepwise, label, flash, impl)
+            bench_unet(size, stepwise, label, flash, impl, dt)
         except Exception as e:
             emit(label, ok=False, error=f"{type(e).__name__}: {str(e)[:200]}")
 

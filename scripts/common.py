@@ -16,6 +16,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+import jax.numpy as jnp
 
 from distrifuser_tpu import DistriConfig
 from distrifuser_tpu.models import clip as clip_mod
@@ -73,6 +74,10 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--no_vae_sp", action="store_true",
                         help="disable the sequence-parallel VAE decode "
                         "(replicate the dense decode on every device instead)")
+    parser.add_argument("--dtype", type=str, default=None,
+                        choices=["bfloat16", "float32"],
+                        help="model/computation dtype (default: bf16 on TPU, "
+                        "fp32 on CPU)")
 
 
 def config_from_args(args) -> DistriConfig:
@@ -100,6 +105,7 @@ def config_from_args(args) -> DistriConfig:
         attn_impl=args.attn_impl,
         comm_batch=args.comm_batch,
         vae_sp=not args.no_vae_sp,
+        dtype=None if args.dtype is None else getattr(jnp, args.dtype),
     )
 
 
