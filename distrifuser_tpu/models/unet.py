@@ -373,9 +373,12 @@ def timestep_embedding(
 
 
 def layer_norm(p, x, eps: float = 1e-5):
-    mean = x.mean(axis=-1, keepdims=True)
-    var = jnp.square(x - mean).mean(axis=-1, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    """Moments in fp32 (torch upcasts low-precision LN internally; bf16's
+    8-bit mantissa cannot accumulate a 1280-wide mean), output in x.dtype."""
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = jnp.square(x32 - mean).mean(axis=-1, keepdims=True)
+    y = ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
     return y * p["scale"] + p["bias"]
 
 
