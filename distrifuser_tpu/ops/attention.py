@@ -127,9 +127,16 @@ _CHUNK_LOGITS_ELEMS = 1 << 28
 
 
 def _sdpa_xla(q, k, v, scale):
-    """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D], fp32 softmax."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D], fp32 softmax.
+
+    The QK product accumulates straight into fp32 (preferred_element_type)
+    rather than rounding logits to bf16 first — the softmax upcast needed
+    fp32 anyway, so this costs nothing and matches the flash kernels'
+    in-kernel fp32 logits."""
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    w = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
 
 
