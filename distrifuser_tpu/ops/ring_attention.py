@@ -42,7 +42,9 @@ def _chunk_scores(q, kv_chunk, heads):
     qh = q.reshape(b, lq, heads, d)
     kh = k.reshape(b, lk, heads, d)
     vh = v.reshape(b, lk, heads, d)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh).astype(jnp.float32) * (1.0 / d**0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", qh, kh, preferred_element_type=jnp.float32
+    ) * (1.0 / d**0.5)
     return s, vh
 
 
