@@ -122,7 +122,9 @@ def _self_attn(p, x, heads: int, mask):
     q = q.reshape(b, l, heads, d)
     k = k.reshape(b, l, heads, d)
     v = v.reshape(b, l, heads, d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) + mask
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) + mask
     w = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, l, c)
     return linear(p["out_proj"], out)

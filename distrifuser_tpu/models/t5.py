@@ -140,7 +140,9 @@ def _attention(lp, cfg: T5Config, x, pos_bias, mask_bias):
     q = linear(lp["q"], x).reshape(b, l, h, dk)
     k = linear(lp["k"], x).reshape(b, l, h, dk)
     v = linear(lp["v"], x).reshape(b, l, h, dk)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
     logits = logits + pos_bias[None] + mask_bias
     w = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     att = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, l, cfg.inner_dim)
