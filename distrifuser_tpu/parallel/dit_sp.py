@@ -21,7 +21,11 @@ Per-block stale state depends on ``attn_impl``: "gather" carries the full
 gathered [depth, 2, B, N, hidden] K/V (O(L), the reference's buffer
 layout); "ring" carries only the own [depth, B, N/n, 2*hidden] chunk and
 streams peers through the shared ``ring_pass`` online softmax — O(L/n)
-state and no refresh collective at all.  The pipeline runner
+state and no refresh collective at all.  Two exact (stateless) layouts
+complete the menu: "ulysses" (head-sharding all_to_all over the whole sp
+axis) and "usp" (the xDiT-style 2-level composition — sp factored into
+``ulysses_degree`` x ring sub-axes, one all_to_all per block over the
+inner axis and a fresh-KV ring over the outer one).  The pipeline runner
 (pipefusion.py) and this runner are complementary points on the
 memory/traffic trade (weights/depth-sharded + O(N/M) ring hops vs
 weights-replicated + KV exchange).
@@ -45,7 +49,13 @@ from ..models import dit as dit_mod
 from ..models.dit import DiTConfig
 from ..ops.attention import sdpa
 from ..schedulers import BaseScheduler
-from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
+from ..utils.config import (
+    DP_AXIS,
+    SP_AXIS,
+    SP_R_AXIS,
+    SP_U_AXIS,
+    DistriConfig,
+)
 from .collectives import all_gather_seq
 from .guidance import branch_select, combine_guidance
 
@@ -85,6 +95,19 @@ class DiTDenoiseRunner:
                 f"ulysses needs num_heads ({dit_config.num_heads}) divisible "
                 f"by the sp degree ({n})"
             )
+        if (
+            distri_config.attn_impl == "usp"
+            and dit_config.num_heads % distri_config.ulysses_degree != 0
+        ):
+            raise ValueError(
+                f"usp needs num_heads ({dit_config.num_heads}) divisible by "
+                f"ulysses_degree ({distri_config.ulysses_degree})"
+            )
+        # USP runs on the 4-axis factored view of the same device grid;
+        # sequence-sharding ops address the composite (sp_u, sp_r) axis pair.
+        self._usp = distri_config.attn_impl == "usp"
+        self.mesh = distri_config.usp_mesh() if self._usp else distri_config.mesh
+        self.seq_axes = (SP_U_AXIS, SP_R_AXIS) if self._usp else SP_AXIS
         if dit_config.num_tokens % n != 0:
             raise ValueError(
                 f"token count {dit_config.num_tokens} must be divisible by "
@@ -116,7 +139,7 @@ class DiTDenoiseRunner:
         n = cfg.n_device_per_batch
         n_tok = dcfg.num_tokens
         chunk = n_tok // n
-        sp_idx = lax.axis_index(SP_AXIS)
+        sp_idx = lax.axis_index(self.seq_axes)
         offset = sp_idx * chunk
         compute_dtype = params["proj_in"]["kernel"].dtype
 
@@ -133,6 +156,7 @@ class DiTDenoiseRunner:
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
         ring = cfg.attn_impl == "ring"
         ulysses = cfg.attn_impl == "ulysses"
+        usp = self._usp
 
         def block_body_ulysses(carry, xs):
             """Ulysses SP (exact, stateless): all_to_all re-shards the
@@ -170,6 +194,60 @@ class DiTDenoiseRunner:
                     att, SP_AXIS, split_axis=1, concat_axis=2, tiled=True
                 )  # [B, chunk, H, D]
                 return back.reshape(b_, lq_, dcfg.hidden_size)
+
+            h_out, _ = dit_mod.dit_block(
+                bp, dcfg, hcur, c6, ckv, attn_core=core, cap_bias=cap_bias
+            )
+            return h_out, kv_blk
+
+        def block_body_usp(carry, xs):
+            """USP (exact, stateless): the xDiT-style 2-level composition.
+            The sp axis is factored (sp_u x sp_r); one all_to_all over sp_u
+            turns [B, N/n, H, D] token shards into [B, N/r, H/u, D]
+            head-sharded assemblies, the exact KV ring over sp_r streams the
+            other r-1 assemblies through the online softmax (every chunk
+            fresh — unlike the displaced "ring" layout there is no
+            staleness), and the inverse all_to_all restores the token shard.
+            Per block this moves 1/u of pure-ring bytes over the ring and
+            1/r of pure-ulysses bytes through the all_to_alls — the knob
+            (ulysses_degree) picks the point between them that fits the
+            mesh."""
+            from ..ops.ring_attention import ring_pass
+
+            hcur = carry
+            bp, ckv, kv_blk = xs
+            heads = dcfg.num_heads
+            d = dcfg.hidden_size // heads
+            u = cfg.ulysses_degree
+            r = n // u
+
+            def core(q, k, v):
+                b_, lq_ = q.shape[0], q.shape[1]
+
+                def to_headshard(t):
+                    th = t.reshape(b_, lq_, heads, d)
+                    if u == 1:
+                        return th
+                    # split heads over sp_u, concat this u-group's tokens
+                    return lax.all_to_all(
+                        th, SP_U_AXIS, split_axis=2, concat_axis=1, tiled=True
+                    )  # [B, N/r, H/u, D]
+
+                qg, kg, vg = to_headshard(q), to_headshard(k), to_headshard(v)
+                l_loc, h_loc = qg.shape[1], heads // u
+                q2 = qg.reshape(b_, l_loc, h_loc * d)
+                kv_local = jnp.concatenate(
+                    [kg.reshape(b_, l_loc, h_loc * d),
+                     vg.reshape(b_, l_loc, h_loc * d)], axis=-1
+                )
+                out = ring_pass(q2, kv_local, kv_local, r, SP_R_AXIS,
+                                heads=h_loc)  # [B, H/u, N/r, D] fp32
+                out = out.astype(q.dtype).transpose(0, 2, 1, 3)
+                if u > 1:
+                    out = lax.all_to_all(
+                        out, SP_U_AXIS, split_axis=1, concat_axis=2, tiled=True
+                    )  # [B, N/n, H, D]
+                return out.reshape(b_, lq_, dcfg.hidden_size)
 
             h_out, _ = dit_mod.dit_block(
                 bp, dcfg, hcur, c6, ckv, attn_core=core, cap_bias=cap_bias
@@ -238,7 +316,9 @@ class DiTDenoiseRunner:
                 fresh = kv_blk
             return h_out, fresh
 
-        if ulysses:
+        if usp:
+            block_body = block_body_usp
+        elif ulysses:
             block_body = block_body_ulysses
         else:
             block_body = block_body_ring if ring else block_body_gather
@@ -247,7 +327,7 @@ class DiTDenoiseRunner:
             block_body, h, (params["blocks"], cap_kv, kv_state)
         )
         eps_rows = dit_mod.final_layer(params, dcfg, h, temb_all[s])
-        eps_full = all_gather_seq(eps_rows)
+        eps_full = all_gather_seq(eps_rows, self.seq_axes)
         return eps_full, kv_new
 
     def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
@@ -268,7 +348,7 @@ class DiTDenoiseRunner:
 
         bloc = my_enc.shape[0]
         sstate = sched.init_state(x.shape)
-        if cfg.attn_impl == "ulysses":
+        if cfg.attn_impl in ("ulysses", "usp"):
             # exact and stateless: a minimal placeholder keeps the block
             # scan's xs structure uniform
             kv0 = jnp.zeros((dcfg.depth, 1), compute_dtype)
@@ -323,7 +403,7 @@ class DiTDenoiseRunner:
         def loop(params, latents, enc, cap_mask, gs):
             return shard_map(
                 device_loop,
-                mesh=cfg.mesh,
+                mesh=self.mesh,
                 in_specs=(P(), lat_spec, enc_spec, enc_spec, P()),
                 out_specs=lat_spec,
                 check_vma=False,

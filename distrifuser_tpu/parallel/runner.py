@@ -108,11 +108,12 @@ class DenoiseRunner:
                 "UNet's heterogeneous stages cannot pipeline — use "
                 "parallelism='patch' here"
             )
-        if distri_config.attn_impl == "ulysses":
+        if distri_config.attn_impl in ("ulysses", "usp"):
             raise ValueError(
-                "attn_impl='ulysses' is a DiT strategy (parallel/dit_sp.py): "
-                "head counts vary per UNet level, so the all-to-all head "
-                "shard does not apply — use 'gather' or 'ring' here"
+                f"attn_impl={distri_config.attn_impl!r} is a DiT strategy "
+                "(parallel/dit_sp.py): head counts vary per UNet level, so "
+                "the all-to-all head shard does not apply — use 'gather' or "
+                "'ring' here"
             )
         _check_geometry(distri_config, unet_config)
         self._compiled: Dict[int, Any] = {}

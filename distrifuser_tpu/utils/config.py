@@ -42,6 +42,10 @@ from .env import check_env, default_backend, is_power_of_2
 DP_AXIS = "dp"
 CFG_AXIS = "cfg"
 SP_AXIS = "sp"
+# USP (attn_impl="usp") factors the sp axis into two named sub-axes:
+# all_to_all head-sharding rides SP_U, the exact KV ring rides SP_R.
+SP_U_AXIS = "sp_u"
+SP_R_AXIS = "sp_r"
 
 SYNC_MODES = (
     "separate_gn",
@@ -103,6 +107,10 @@ class DistriConfig:
     # around the sp axis with ppermute + online softmax, shrinking per-layer
     # state from O(L) to O(L/n) — the idiomatic TPU long-context path.
     attn_impl: str = "gather"
+    # attn_impl="usp" only: factor the sp axis into ulysses_degree (head-
+    # sharding all_to_all sub-axis) x ring sub-axis — the xDiT-style USP
+    # composition.  Must divide n_device_per_batch.
+    ulysses_degree: int = 1
     # Batch the stale-phase refresh collectives into one flat exchange per
     # step (per collective kind) — the TPU-native analog of the reference's
     # `comm_checkpoint` buffer batching (utils.py:181-190).  Off by default:
@@ -144,10 +152,19 @@ class DistriConfig:
             raise ValueError(
                 f"split_scheme must be one of {SPLIT_SCHEMES}, got {self.split_scheme!r}"
             )
-        if self.attn_impl not in ("gather", "ring", "ulysses"):
+        if self.attn_impl not in ("gather", "ring", "ulysses", "usp"):
             raise ValueError(
-                "attn_impl must be 'gather', 'ring', or 'ulysses' (ulysses: "
-                f"DiT only), got {self.attn_impl!r}"
+                "attn_impl must be 'gather', 'ring', 'ulysses', or 'usp' "
+                f"(ulysses/usp: DiT only), got {self.attn_impl!r}"
+            )
+        if self.ulysses_degree < 1:
+            raise ValueError(
+                f"ulysses_degree must be >= 1, got {self.ulysses_degree}"
+            )
+        if self.ulysses_degree > 1 and self.attn_impl != "usp":
+            raise ValueError(
+                "ulysses_degree applies to attn_impl='usp' only (pure "
+                "head-sharding is attn_impl='ulysses')"
             )
         if self.height % 8 != 0 or self.width % 8 != 0:
             # Same constraint as the reference pipelines (pipelines.py:71).
@@ -194,6 +211,13 @@ class DistriConfig:
             self.dp_degree, cfg_dim, self.n_device_per_batch
         )
         self.mesh = Mesh(dev_array, axis_names=(DP_AXIS, CFG_AXIS, SP_AXIS))
+        if self.attn_impl == "usp" and (
+            self.n_device_per_batch % self.ulysses_degree != 0
+        ):
+            raise ValueError(
+                f"ulysses_degree {self.ulysses_degree} must divide the sp "
+                f"degree {self.n_device_per_batch}"
+            )
 
         if self.dtype is None:
             import jax.numpy as jnp
@@ -205,6 +229,21 @@ class DistriConfig:
     # In single-controller SPMD there is no per-process "rank"; these map a
     # linear device index to its mesh coordinates.
     # ------------------------------------------------------------------
+    def usp_mesh(self) -> Mesh:
+        """The 4-axis view of the same device grid for attn_impl='usp':
+        sp factored into (SP_U_AXIS, SP_R_AXIS) with |sp_u| = ulysses_degree.
+        Linearized (sp_u, sp_r) coordinates equal the 3-axis mesh's sp index,
+        so rank bookkeeping (batch_idx/split_idx) is unchanged."""
+        u = self.ulysses_degree
+        n = self.n_device_per_batch
+        cfg_dim = self.group_size // n
+        dev_array = np.array(self.devices, dtype=object).reshape(
+            self.dp_degree, cfg_dim, u, n // u
+        )
+        return Mesh(
+            dev_array, axis_names=(DP_AXIS, CFG_AXIS, SP_U_AXIS, SP_R_AXIS)
+        )
+
     @property
     def use_compiled_step(self) -> bool:
         """TPU-native alias for ``use_cuda_graph``: run the denoise loop as a

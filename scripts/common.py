@@ -66,8 +66,12 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--dp_degree", type=int, default=1,
                         help="data-parallel image groups (extra mesh axis)")
     parser.add_argument("--attn_impl", type=str, default="gather",
-                        choices=["gather", "ring", "ulysses"],
-                        help="patch attention layout (ring: O(L/n) state)")
+                        choices=["gather", "ring", "ulysses", "usp"],
+                        help="patch attention layout (ring: O(L/n) state; "
+                        "ulysses/usp: DiT only, exact)")
+    parser.add_argument("--ulysses_degree", type=int, default=1,
+                        help="with --attn_impl usp: factor the sp axis into "
+                        "ulysses_degree (head-sharding all_to_all) x ring")
     parser.add_argument("--comm_batch", action="store_true",
                         help="batch stale-refresh collectives into one flat "
                         "exchange per step (analog of comm_checkpoint batching)")
@@ -103,6 +107,7 @@ def config_from_args(args) -> DistriConfig:
         batch_size=args.batch_size,
         dp_degree=args.dp_degree,
         attn_impl=args.attn_impl,
+        ulysses_degree=args.ulysses_degree,
         comm_batch=args.comm_batch,
         vae_sp=not args.no_vae_sp,
         dtype=None if args.dtype is None else getattr(jnp, args.dtype),

@@ -31,8 +31,8 @@ def main():
     parser.add_argument("--model", type=str, default=None,
                         help="local PixArt snapshot dir (transformer/, vae/, "
                         "text_encoder/, tokenizer/); omit for random weights")
-    parser.add_argument("--prompt", type=str,
-                        default="an astronaut riding a horse on the moon")
+    # add_distri_args already defines --prompt; only the default differs here
+    parser.set_defaults(prompt="an astronaut riding a horse on the moon")
     args = parser.parse_args()
     args.image_size = args.image_size or [1024, 1024]
     if args.parallelism not in ("patch", "pipefusion"):

@@ -271,3 +271,56 @@ def test_geometry_validation():
                          do_classifier_free_guidance=False, split_batch=False),
             dcfg, params, get_scheduler("ddim"),
         )
+
+
+@pytest.mark.parametrize("u", [1, 2, 4])
+def test_usp_exact(u):
+    """attn_impl='usp' (Ulysses x ring 2-level SP) is exact for every
+    factorization of the sp axis: u=4/r=1 degenerates to pure head-sharding,
+    u=1/r=4 to the exact KV ring, u=2/r=2 is the genuine composition.  All
+    must equal the dense loop (no staleness exists in this layout)."""
+    dcfg, params = make_model()  # 4 heads
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=0, attn_impl="usp",
+                    ulysses_degree=u)
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=1.0, num_inference_steps=4)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 1.0, 4,
+                     do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_usp_cfg_split():
+    """USP under the CFG mesh axis: 8 devices = cfg 2 x (sp_u 2 x sp_r 2)."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    cfg = sp_config(8, do_cfg=True, warmup_steps=0, attn_impl="usp",
+                    ulysses_degree=2)
+    runner = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+    out = runner.generate(lat, enc, guidance_scale=3.5, num_inference_steps=4)
+    ref = dense_loop(params, dcfg, get_scheduler("ddim"), lat, enc, 3.5, 4,
+                     do_cfg=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_usp_validation():
+    dcfg, _ = make_model()  # 4 heads
+    with pytest.raises(ValueError, match="ulysses_degree"):
+        sp_config(8, do_cfg=False, attn_impl="usp", ulysses_degree=3)
+    with pytest.raises(ValueError, match="ulysses_degree applies"):
+        sp_config(8, do_cfg=False, attn_impl="ring", ulysses_degree=2)
+
+
+def test_usp_rejected_by_unet_runner():
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.parallel.runner import DenoiseRunner
+
+    ucfg = tiny_config()
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    cfg = DistriConfig(devices=jax.devices()[:4], height=128, width=128,
+                       do_classifier_free_guidance=False, split_batch=False,
+                       attn_impl="usp", ulysses_degree=2)
+    with pytest.raises(ValueError, match="DiT strategy"):
+        DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
