@@ -12,6 +12,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def test_entry_tiny_compiles(monkeypatch):
     monkeypatch.setenv("DISTRIFUSER_TPU_GRAFT_PRESET", "tiny")
+    # entry()/dryrun setdefault DISTRIFUSER_TPU_FLASH=0 process-wide (the
+    # driver gate wants the XLA path on CPU); pre-setting it via monkeypatch
+    # makes that mutation test-scoped instead of leaking into later files
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "0")
     import __graft_entry__ as g
 
     fn, args = g.entry()
@@ -22,6 +26,7 @@ def test_entry_tiny_compiles(monkeypatch):
 
 def test_dryrun_multichip_8(monkeypatch):
     monkeypatch.setenv("DISTRIFUSER_TPU_GRAFT_PRESET", "tiny")
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "0")  # see above
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)  # patch + tensor + dp over the 3-axis mesh

@@ -27,6 +27,17 @@ class _Dev:
         self.platform = platform
 
 
+@pytest.fixture(autouse=True)
+def _clean_flash_env(monkeypatch):
+    """Isolate routing tests from env leaked by other test files —
+    __graft_entry__ setdefaults DISTRIFUSER_TPU_FLASH=0 process-wide when
+    test_graft_entry runs earlier in the session.  Runs before each test
+    body, so tests that set these vars intentionally still win."""
+    for var in ("DISTRIFUSER_TPU_FLASH", "DISTRIFUSER_TPU_FLASH_IMPL",
+                "DISTRIFUSER_TPU_FLASH_BQ", "DISTRIFUSER_TPU_FLASH_BK"):
+        monkeypatch.delenv(var, raising=False)
+
+
 def _route(monkeypatch, platform="tpu", lq=4096, lk=4096, c=640, heads=10):
     import jax.numpy as jnp
 
