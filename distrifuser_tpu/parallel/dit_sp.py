@@ -411,6 +411,43 @@ class DiTDenoiseRunner:
 
         return jax.jit(loop)
 
+    def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
+        """Per-device stale-state and per-step collective volumes (elements)
+        for the configured attention layout — the DiT analog of
+        DenoiseRunner.comm_volume_report / PipeFusionRunner.comm_report
+        (reference verbose buffer stats, utils.py:152-158).  Closed-form from
+        the architecture; no tracing."""
+        cfg, dcfg = self.cfg, self.dcfg
+        n = cfg.n_device_per_batch
+        if not cfg.is_sp:
+            return {"layout": cfg.attn_impl, "kv_state_elems": 0,
+                    "per_step_collective_elems": 0}
+        b = batch_size
+        n_tok, hid, depth = dcfg.num_tokens, dcfg.hidden_size, dcfg.depth
+        chunk = n_tok // n
+        # the final-layer epsilon gather runs in every layout
+        eps_gather = b * n_tok * dcfg.patch_size**2 * 2 * dcfg.in_channels
+        if cfg.attn_impl == "gather":
+            state = depth * 2 * b * n_tok * hid
+            per_step = depth * 2 * b * n_tok * hid + eps_gather
+        elif cfg.attn_impl == "ring":
+            state = depth * b * chunk * 2 * hid
+            # (n-1) ppermute hops of the local 2C chunk per block, in-step
+            per_step = depth * (n - 1) * b * chunk * 2 * hid + eps_gather
+        elif cfg.attn_impl == "ulysses":
+            state = 0
+            # 2 all_to_alls (qkv out + attn back) moving ~the local tokens
+            per_step = depth * b * chunk * hid * 4 + eps_gather
+        else:  # usp
+            u = cfg.ulysses_degree
+            r = n // u
+            state = 0
+            a2a = depth * b * chunk * hid * 4 if u > 1 else 0
+            ring_hops = depth * (r - 1) * b * (chunk * u) * 2 * hid // u
+            per_step = a2a + ring_hops + eps_gather
+        return {"layout": cfg.attn_impl, "kv_state_elems": int(state),
+                "per_step_collective_elems": int(per_step)}
+
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
                  cap_mask=None):
         """Same contract as PipeFusionRunner.generate.  ``cap_mask``

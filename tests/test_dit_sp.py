@@ -324,3 +324,35 @@ def test_usp_rejected_by_unet_runner():
                        attn_impl="usp", ulysses_degree=2)
     with pytest.raises(ValueError, match="DiT strategy"):
         DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+
+
+def test_comm_report_layouts():
+    """The layout trade the report must show: ring state is n-x smaller than
+    gather; ulysses/usp are stateless; usp's ring traffic shrinks with
+    ulysses_degree."""
+    dcfg, params = make_model()
+    reports = {}
+    for impl, kw in [("gather", {}), ("ring", {}), ("ulysses", {}),
+                     ("usp", {"ulysses_degree": 2})]:
+        cfg = sp_config(4, do_cfg=False, attn_impl=impl, **kw)
+        r = DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+        reports[impl] = r.comm_report()
+    assert reports["gather"]["kv_state_elems"] == \
+        4 * reports["ring"]["kv_state_elems"]
+    assert reports["ulysses"]["kv_state_elems"] == 0
+    assert reports["usp"]["kv_state_elems"] == 0
+    # at n=4/u=2 the two layouts move identical bytes (1.5*N*hid per
+    # block); usp's advantage is strict from n=8 up
+    assert (reports["usp"]["per_step_collective_elems"]
+            == reports["ring"]["per_step_collective_elems"])
+    r8 = {}
+    for impl, kw in [("ring", {}), ("usp", {"ulysses_degree": 2})]:
+        cfg = sp_config(8, do_cfg=False, attn_impl=impl, **kw)
+        r8[impl] = DiTDenoiseRunner(
+            cfg, dcfg, params, get_scheduler("ddim")).comm_report()
+    assert (r8["usp"]["per_step_collective_elems"]
+            < r8["ring"]["per_step_collective_elems"])
+    # single device: no collectives at all
+    cfg1 = sp_config(1, do_cfg=False)
+    r1 = DiTDenoiseRunner(cfg1, dcfg, params, get_scheduler("ddim"))
+    assert r1.comm_report()["per_step_collective_elems"] == 0
