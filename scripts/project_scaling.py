@@ -35,6 +35,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", type=str, default="sdxl",
+                    choices=["sdxl", "pixart"],
+                    help="pixart projects the DiT attention layouts "
+                    "(gather/ring/ulysses/usp) from comm_report volumes")
+    ap.add_argument("--ulysses_degree", type=int, default=2)
     ap.add_argument("--image_size", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--peak_tflops", type=float, default=197.0,
@@ -54,6 +59,9 @@ def main():
     from distrifuser_tpu.models import unet as unet_mod
     from distrifuser_tpu.parallel.runner import make_runner
     from distrifuser_tpu.schedulers import get_scheduler
+
+    if args.model == "pixart":
+        return project_dit(args)
 
     size = args.image_size
     ucfg = unet_mod.sdxl_config()
@@ -96,14 +104,13 @@ def main():
           f"({args.mxu_frac:.0%} of {args.peak_tflops:.0f}T peak)")
 
     devs = jax.devices()
+    t1 = flops_step / sustained  # single-chip roofline, the speedup base
     for n in args.ns:
         if n == 1:
-            t_step = flops_step / sustained
             print(json.dumps({
-                "n": 1, "step_s": round(t_step, 4),
-                "total_s": round(t_step * args.steps, 2), "speedup": 1.0,
+                "n": 1, "step_s": round(t1, 4),
+                "total_s": round(t1 * args.steps, 2), "speedup": 1.0,
             }))
-            t1 = t_step
             continue
         if len(devs) < 2 * n:
             print(json.dumps({"n": n, "skipped":
@@ -133,6 +140,83 @@ def main():
             "total_s": round(t_step * args.steps, 2),
             "speedup": round(t1 / t_step, 2),
         }))
+
+
+def project_dit(args):
+    """Same roofline for the PixArt DiT, per attention layout: compute from
+    analytic FLOPs (attention + MLP dominate a DiT), comm from
+    DiTDenoiseRunner.comm_report.  Exact layouts (ulysses/usp) pay their
+    collectives inline; displaced layouts (gather/ring) overlap the refresh
+    (the DiT scan defers it to the carry, parallel/dit_sp.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models import dit as dit_mod
+    from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    dcfg = dit_mod.pixart_config(sample_size=args.image_size // 8)
+    n_tok, hid, depth = dcfg.num_tokens, dcfg.hidden_size, dcfg.depth
+    # per-branch-batch=2 (CFG); attention 4*N^2*hid + qkvo 8*N*hid^2 + MLP
+    # 16*N*hid^2 (mlp_ratio 4), x2 for the CFG batch
+    flops_step = 2 * depth * (4 * n_tok**2 * hid + 24 * n_tok * hid**2)
+    sustained = args.peak_tflops * 1e12 * args.mxu_frac
+    bw = args.ici_gbps * 1e9
+    print(f"# projection (roofline): PixArt {dcfg.sample_size * 8}px "
+          f"({n_tok} tokens, depth {depth}), {args.steps}-step, CFG batch 2")
+    print(f"# per-step FLOPs {flops_step / 1e12:.2f} T; sustained "
+          f"{sustained / 1e12:.1f} TFLOP/s/chip")
+    t1 = flops_step / sustained
+    print(json.dumps({"n": 1, "layout": "dense", "step_s": round(t1, 4),
+                      "total_s": round(t1 * args.steps, 2), "speedup": 1.0}))
+    devs = jax.devices()
+    for n in args.ns:
+        if n == 1:
+            continue
+        if len(devs) < 2 * n:
+            print(json.dumps({"n": n, "skipped": f"need {2*n} devices"}))
+            continue
+        for impl in ("gather", "ring", "ulysses", "usp"):
+            kw = {}
+            if impl == "usp":
+                if n % args.ulysses_degree:
+                    print(json.dumps({
+                        "n": n, "layout": impl,
+                        "skipped": f"ulysses_degree {args.ulysses_degree} "
+                                   f"does not divide n",
+                    }))
+                    continue
+                kw["ulysses_degree"] = args.ulysses_degree
+            if impl in ("ulysses", "usp") and dcfg.num_heads % (
+                kw.get("ulysses_degree", n)
+            ):
+                print(json.dumps({
+                    "n": n, "layout": impl,
+                    "skipped": f"num_heads {dcfg.num_heads} not divisible "
+                               f"by degree {kw.get('ulysses_degree', n)}",
+                }))
+                continue
+            cfg = DistriConfig(
+                devices=devs[:2 * n], height=dcfg.sample_size * 8,
+                width=dcfg.sample_size * 8, attn_impl=impl,
+                dtype=jnp.bfloat16, **kw,
+            )
+            rep = DiTDenoiseRunner(
+                cfg, dcfg, None, get_scheduler("ddim")
+            ).comm_report()
+            t_comp = flops_step / (n * sustained)
+            t_comm = rep["per_step_collective_elems"] * 2 / bw
+            exact = impl in ("ulysses", "usp")
+            t_step = (t_comp + t_comm) if exact else max(t_comp, t_comm)
+            print(json.dumps({
+                "n": n, "layout": impl, "step_s": round(t_step, 4),
+                "compute_s": round(t_comp, 4), "comm_s": round(t_comm, 5),
+                "comm_inline": exact,
+                "state_MiB": round(rep["kv_state_elems"] * 2 / 2**20, 1),
+                "total_s": round(t_step * args.steps, 2),
+                "speedup": round(t1 / t_step, 2),
+            }))
 
 
 if __name__ == "__main__":
