@@ -1,0 +1,53 @@
+"""bench.py's one-parseable-line contract.
+
+Rounds 1-2 lost their benchmark gate to rc=124 with nothing parseable on
+stdout; bench.py now guarantees exactly one JSON result line within its
+total wall-clock budget (a real latency, or an explicit failure metric)
+and a meaningful exit code.  These run the real script as the driver does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(args, timeout):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)  # single CPU device is fine and faster
+    return subprocess.run(
+        [sys.executable, BENCH, *args], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def _parse_result(stdout):
+    lines = [l for l in stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"expected exactly one JSON line, got: {stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    return rec
+
+
+def test_normal_run_emits_real_latency():
+    r = _run(["--steps", "2", "--test_times", "1"], timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_result(r.stdout)
+    assert rec["value"] > 0 and rec["unit"] == "s"
+    assert "provenance" in r.stderr  # platform/dtype always logged
+
+
+def test_expired_budget_still_emits_parseable_line():
+    """Budget already spent at start: the watchdog must print the explicit
+    timeout metric (never silence) and exit 2."""
+    r = _run(["--steps", "2", "--test_times", "1", "--total_budget_s", "91"],
+             timeout=300)
+    assert r.returncode == 2, (r.returncode, r.stderr[-500:])
+    rec = _parse_result(r.stdout)
+    assert rec["metric"] == "bench_watchdog_timeout"
+    assert rec["value"] == -1.0
