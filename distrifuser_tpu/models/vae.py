@@ -20,7 +20,7 @@ analog) so 3840x3840 outputs fit on one chip.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

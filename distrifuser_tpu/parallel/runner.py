@@ -27,7 +27,6 @@ visible per-step latency.
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Any, Dict, Optional
 

@@ -25,7 +25,7 @@ comparability to published tables depends on the pretrained files.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
 import numpy as np
 
