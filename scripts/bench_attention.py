@@ -9,6 +9,11 @@ kernel, at the (B*2 CFG, L, C, heads) shapes the SDXL UNet actually runs at
 the XLA path, so it is not a routing decision).
 
 Prints one JSON line per (shape, impl): {"impl", "L", "heads", "ms"}.
+
+On-chip runs should go through scripts/chip_campaign.py (one claimant, all
+phases serialized); its attn/tune lines feed scripts/update_sdpa_table.py,
+which bakes the winners into the checked-in routing table
+(ops/sdpa_routing.py).
 """
 
 import argparse

@@ -3,9 +3,12 @@
 Times `flash_sdpa` over the SDXL self-attention shapes (the two transformer
 resolutions at a given image size, CFG batch 2) for a grid of (block_q,
 block_k) tile sizes, against the XLA softmax path as baseline.  Prints the
-best tiles per shape — export DISTRIFUSER_TPU_FLASH_BQ/BK to apply them
+best tiles per shape.  To apply them: prefer checking the winners into the
+measured routing table — run the sweep through scripts/chip_campaign.py and
+feed the log to scripts/update_sdpa_table.py (ops/sdpa_routing.py).  The
+DISTRIFUSER_TPU_FLASH_BQ/BK env vars remain as a session-local override
 (ops/attention.py reads both; setting either also selects the in-repo
-kernel over the upstream default, since the tiles target it).
+kernel, since the tiles target it).
 
 The reference gets its fused attention pre-tuned inside cuDNN/Flash
 (modules/pp/attn.py:87,153); on TPU tile choice is ours to make, and the MXU
