@@ -1,0 +1,33 @@
+#!/bin/bash
+# Spaced retry loop for the real-chip measurement campaign.
+#
+# Lease rules (BENCH_NOTES.md "Chip availability"): one claimant at a time;
+# never kill an active claim (wedges the lease); a wedged lease needs 30+
+# minutes of COMPLETE idleness, so failed claims are spaced ~35 min apart —
+# a short-sleep loop keeps the lease wedged forever.  Each attempt exits
+# cleanly on init failure (rc 3), so a wedged lease costs one ~25-min hang
+# per attempt, nothing worse.
+#
+# Usage (detached, so no shell timeout can kill an active claim):
+#   setsid nohup scripts/chip_retry_loop.sh [hours=5] > /dev/null 2>&1 &
+# Results append to chip_logs/campaign_r3.log as JSON lines; on success feed
+# them to scripts/update_sdpa_table.py and BENCH_NOTES.md.
+
+HOURS="${1:-5}"
+DEADLINE=$(( $(date +%s) + HOURS * 3600 ))
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p chip_logs
+LOG=chip_logs/campaign_r3.log
+# wait for any existing claimant before the first attempt
+while pgrep -f "python scripts/chip_campaign.py" > /dev/null; do sleep 60; done
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n+1))
+  echo "=== retry_loop attempt $n $(date -u +%H:%M:%S) ===" >> "$LOG"
+  PYTHONPATH=/root/.axon_site:"$PWD" python scripts/chip_campaign.py \
+    --deadline_s 7200 >> "$LOG" 2>&1
+  rc=$?
+  echo "=== retry_loop attempt $n exited rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
+  [ "$rc" -eq 0 ] && break
+  sleep 2100
+done
