@@ -151,3 +151,20 @@ def test_sdpa_still_computes_on_cpu(monkeypatch):
     out = attention.sdpa(q, q, q, heads=2)
     assert out.shape == (1, 128, 128)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_updater_accepts_bench_attention_lines(tmp_path):
+    import update_sdpa_table as upd
+
+    log = tmp_path / "bench_attention.log"
+    lines = [
+        {"impl": "xla", "L": 4096, "heads": 10, "ms": 2.0},
+        {"impl": "pallas_inrepo", "L": 4096, "heads": 10, "ms": 1.4},
+        {"impl": "pallas_upstream", "L": 4096, "heads": 10,
+         "ms": "failed: XlaRuntimeError"},
+    ]
+    log.write_text("\n".join(json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    assert len(attn) == 1 and not tune
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][0] == "inrepo"  # failed upstream excluded
