@@ -259,8 +259,9 @@ def unpatchify(cfg: DiTConfig, tokens: jnp.ndarray, channels: int) -> jnp.ndarra
 
 
 def pos_embed_table(cfg: DiTConfig, dtype=jnp.float32) -> jnp.ndarray:
-    """2D sin-cos position table [N, hidden] (DiT convention: half the
-    channels encode the row coordinate, half the column).
+    """2D sin-cos position table [N, hidden] (diffusers convention: the
+    FIRST half of the channels encodes the column/width coordinate, the
+    second half the row — see the ordering note at the return).
 
     Coordinates follow diffusers' PatchEmbed scaling so converted PixArt
     weights see the frequencies they trained with:
@@ -287,7 +288,12 @@ def pos_embed_table(cfg: DiTConfig, dtype=jnp.float32) -> jnp.ndarray:
     col = axis_embed(coords, dim)
     grid_row = jnp.repeat(row, side, axis=0)            # [N, dim]
     grid_col = jnp.tile(col, (side, 1))                 # [N, dim]
-    return jnp.concatenate([grid_row, grid_col], axis=-1).astype(dtype)
+    # Channel order matches diffusers get_2d_sincos_pos_embed: its
+    # np.meshgrid(grid_w, grid_h)[0] is the WIDTH/column coordinate, and the
+    # first half of the table is built from grid[0] — so column first.
+    # Converted PixArt checkpoints trained against that layout; row-first
+    # would transpose the positional table diagonally.
+    return jnp.concatenate([grid_col, grid_row], axis=-1).astype(dtype)
 
 
 def timestep_embedding(cfg: DiTConfig, t: jnp.ndarray) -> jnp.ndarray:
@@ -401,8 +407,9 @@ def _masked_cross_sdpa(q, k, v, bias, heads: int):
     qh = q.reshape(b, lq, heads, d)
     kh = k.reshape(b, lk, heads, d)
     vh = v.reshape(b, lk, heads, d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / math.sqrt(d)
-    w = jax.nn.softmax(logits.astype(jnp.float32) + bias, axis=-1)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    w = jax.nn.softmax(logits + bias, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), vh)
     return out.reshape(b, lq, c)
 

@@ -422,11 +422,17 @@ class DiTDenoiseRunner:
         if not cfg.is_sp:
             return {"layout": cfg.attn_impl, "kv_state_elems": 0,
                     "per_step_collective_elems": 0}
-        b = batch_size
+        # Per-device folded batch (guidance.branch_select): cfg_split keeps
+        # one branch locally; otherwise CFG rides the batch dim as 2B.
+        n_br_local = (
+            1 if cfg.cfg_split or not cfg.do_classifier_free_guidance else 2
+        )
+        b = batch_size * n_br_local
         n_tok, hid, depth = dcfg.num_tokens, dcfg.hidden_size, dcfg.depth
         chunk = n_tok // n
-        # the final-layer epsilon gather runs in every layout
-        eps_gather = b * n_tok * dcfg.patch_size**2 * 2 * dcfg.in_channels
+        # the final-layer epsilon gather runs in every layout; eps-only head
+        # (out_channels), not diffusers' 2x (eps, sigma) head — ADVICE r3
+        eps_gather = b * n_tok * dcfg.patch_size**2 * dcfg.out_channels
         if cfg.attn_impl == "gather":
             state = depth * 2 * b * n_tok * hid
             per_step = depth * 2 * b * n_tok * hid + eps_gather

@@ -92,10 +92,11 @@ def main():
             flash_sdpa, upstream_flash_sdpa,
         )
 
-        shapes = [  # (L, C, heads) — SDXL levels at 1024/2048/3840 px
+        shapes = [  # (L, C, heads) — SDXL levels at 1024/2048/3840 px,
             (4096, 640, 10), (1024, 1280, 20),
             (16384, 640, 10), (4096, 1280, 20),
             (57600, 640, 10),
+            (4096, 1152, 16),  # PixArt-XL 1024px self-attn (head_dim 72)
         ]
         for (L, C, H) in shapes:
             if left() < 300:
@@ -238,7 +239,7 @@ def main():
         try:
             trace_dir = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                "chip_logs", "trace_r3",
+                "chip_logs", "trace_r4",
             )
             os.makedirs(trace_dir, exist_ok=True)
             from distrifuser_tpu import DistriConfig

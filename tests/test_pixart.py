@@ -312,7 +312,7 @@ def test_pos_embed_interpolation_scale():
                          np.cos(coords[:, None] * om)], axis=-1)
     row = np.repeat(ax, 8, axis=0)
     col = np.tile(ax, (8, 1))
-    np.testing.assert_allclose(t_scaled, np.concatenate([row, col], axis=-1),
+    np.testing.assert_allclose(t_scaled, np.concatenate([col, row], axis=-1),
                                rtol=1e-6, atol=1e-6)
     # default config unchanged (identity scaling)
     t_base = np.asarray(dit_mod.pos_embed_table(base))
@@ -323,3 +323,59 @@ def test_pos_embed_interpolation_scale():
     assert cfg.interpolation_scale == 2.0 and cfg.pos_embed_base_size == 64
     cfg512 = dit_mod.dit_config_from_json({"sample_size": 64})
     assert cfg512.interpolation_scale == 1.0
+
+
+def _diffusers_2d_sincos(embed_dim, grid_size, interpolation_scale=1.0,
+                         base_size=None):
+    """Oracle transcribing diffusers get_2d_sincos_pos_embed structurally:
+    np.meshgrid(grid_w, grid_h) puts the WIDTH coordinate in grid[0], and the
+    first half of the channel dim is built from grid[0]."""
+    base_size = base_size or grid_size
+    coords = (np.arange(grid_size, dtype=np.float32)
+              / (grid_size / base_size) / interpolation_scale)
+    grid = np.stack(np.meshgrid(coords, coords), axis=0)  # [2(w,h), side, side]
+    grid = grid.reshape(2, -1)
+
+    def _1d(dim, pos):
+        omega = 1.0 / 10000.0 ** (np.arange(dim // 2, dtype=np.float64)
+                                  / (dim / 2.0))
+        out = np.einsum("m,d->md", pos, omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    return np.concatenate(
+        [_1d(embed_dim // 2, grid[0]), _1d(embed_dim // 2, grid[1])], axis=1
+    )
+
+
+def test_pos_embed_matches_diffusers_channel_order():
+    """Column/width embedding occupies the FIRST channel half (ADVICE r3:
+    row-first diagonally transposes the table for converted checkpoints).
+
+    Pinned both against a structurally independent meshgrid oracle and
+    against hardcoded sin/cos spot values, so a shared re-implementation of
+    the wrong order cannot pass."""
+    cfg = dit_mod.DiTConfig(sample_size=8, hidden_size=8, depth=1,
+                            num_heads=2, caption_dim=8)
+    table = np.asarray(dit_mod.pos_embed_table(cfg))  # [16, 8], side 4
+    oracle = _diffusers_2d_sincos(8, 4)
+    np.testing.assert_allclose(table, oracle, rtol=1e-6, atol=1e-6)
+
+    # hidden 8 -> per-axis dim 4, omega = [1, 0.01]
+    # token 1 = (row 0, col 1): first half encodes col=1, second half col=0
+    np.testing.assert_allclose(
+        table[1], [np.sin(1.0), np.sin(0.01), np.cos(1.0), np.cos(0.01),
+                   0.0, 0.0, 1.0, 1.0], rtol=1e-6, atol=1e-6)
+    # token 4 = (row 1, col 0): halves swap relative to token 1
+    np.testing.assert_allclose(
+        table[4], [0.0, 0.0, 1.0, 1.0,
+                   np.sin(1.0), np.sin(0.01), np.cos(1.0), np.cos(0.01)],
+        rtol=1e-6, atol=1e-6)
+
+    # scaling path agrees with the oracle too
+    cfg_s = dit_mod.DiTConfig(sample_size=8, hidden_size=8, depth=1,
+                              num_heads=2, caption_dim=8,
+                              interpolation_scale=2.0, pos_embed_base_size=2)
+    np.testing.assert_allclose(
+        np.asarray(dit_mod.pos_embed_table(cfg_s)),
+        _diffusers_2d_sincos(8, 4, interpolation_scale=2.0, base_size=2),
+        rtol=1e-6, atol=1e-6)
