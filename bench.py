@@ -260,10 +260,26 @@ def main():
 
         return run
 
+    # Headline policy (VERDICT r3 "what's weak" #3): the flash kernels only
+    # engage when a MEASURED routing table says they win — the analytic
+    # default was never validated at SDXL shapes on chip, and a slow-but-
+    # working kernel would silently sink the number (the fallback below only
+    # catches compile *failure*).  A populated table comes from
+    # scripts/chip_campaign.py -> update_sdpa_table.py.
+    from distrifuser_tpu.ops.sdpa_routing import MEASURED_ROUTES
+    if not MEASURED_ROUTES and "DISTRIFUSER_TPU_FLASH" not in os.environ:
+        os.environ["DISTRIFUSER_TPU_FLASH"] = "0"
+        print("bench provenance: routing table unmeasured -> pinning XLA "
+              "attention (DISTRIFUSER_TPU_FLASH=0)", file=sys.stderr,
+              flush=True)
+
     def warmup_with_flash_fallback(stepwise: bool):
         run = build_run(stepwise)
         try:
+            t0 = time.time()
             run()  # warmup: compile + execute
+            print(f"warmup (compile+run, stepwise={stepwise}): "
+                  f"{time.time() - t0:.1f}s", file=sys.stderr, flush=True)
         except Exception as e:
             if not on_tpu or os.environ.get("DISTRIFUSER_TPU_FLASH") == "0":
                 raise  # flash was never in play; surface the real error

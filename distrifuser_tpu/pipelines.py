@@ -200,6 +200,63 @@ def _build_decoder(cfg: DistriConfig, vae_config: vae_mod.VAEConfig):
     ), False
 
 
+def _batched_generate(cfg, scheduler, prompts, negs, num_images_per_prompt,
+                      seed, latents, in_channels, run_chunk):
+    """Arbitrary prompt counts over the fixed-batch jitted denoise loop.
+
+    The reference passes diffusers' batching straight through
+    (pipelines.py:47-58); here the compiled loop has a static batch of
+    ``cfg.batch_size``, so each prompt is repeated ``num_images_per_prompt``
+    times (diffusers order: a prompt's images are adjacent) and the expanded
+    list runs in batch_size chunks — the tail chunk padded by repeating its
+    last entry, the padded outputs dropped.  Initial noise is drawn ONCE for
+    the whole expanded batch, so results do not depend on the chunking.
+    """
+    assert prompts, "need at least one prompt"
+    assert num_images_per_prompt >= 1, num_images_per_prompt
+    prompts = [p for p in prompts for _ in range(num_images_per_prompt)]
+    negs = [n for n in negs for _ in range(num_images_per_prompt)]
+    total = len(prompts)
+    bs = cfg.batch_size
+    lat_shape = (total, cfg.latent_height, cfg.latent_width, in_channels)
+    if latents is None:
+        latents = jax.random.normal(jax.random.PRNGKey(seed), lat_shape,
+                                    jnp.float32)
+        latents = latents * scheduler.init_noise_sigma
+    else:
+        latents = jnp.asarray(latents, jnp.float32)
+        assert latents.shape == lat_shape, (latents.shape, lat_shape)
+    outs = []
+    for i in range(0, total, bs):
+        cp, cn = prompts[i:i + bs], negs[i:i + bs]
+        cl = latents[i:i + bs]
+        pad = bs - len(cp)
+        if pad:
+            cp = cp + [cp[-1]] * pad
+            cn = cn + [cn[-1]] * pad
+            cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
+        out = run_chunk(cp, cn, cl)
+        outs.append(out[:bs - pad] if pad else out)
+    return jnp.concatenate(outs, axis=0)
+
+
+def _decode_chunked(decode, vae_params, latent, bs, scaling):
+    """VAE-decode in fixed batch_size chunks (pad the tail, drop the padded
+    rows): the jitted decoder traces once per shape, and the sequence-
+    parallel decode's shard_map needs its dp-divisible batch — an arbitrary
+    total from _batched_generate must not reach it directly."""
+    total = latent.shape[0]
+    outs = []
+    for i in range(0, total, bs):
+        cl = latent[i:i + bs]
+        pad = bs - cl.shape[0]
+        if pad:
+            cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
+        img = decode(vae_params, cl / scaling)
+        outs.append(img[:bs - pad] if pad else img)
+    return jnp.concatenate(outs, axis=0)
+
+
 class _DistriPipelineBase:
     """Shared machinery; subclasses define the text-encoding recipe."""
 
@@ -260,6 +317,7 @@ class _DistriPipelineBase:
         seed: int = 0,
         output_type: str = "pil",
         latents=None,
+        num_images_per_prompt: int = 1,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -276,38 +334,33 @@ class _DistriPipelineBase:
             if isinstance(negative_prompt, str)
             else list(negative_prompt)
         )
-        assert len(prompts) == cfg.batch_size, (
-            f"config batch_size={cfg.batch_size}, got {len(prompts)} prompts"
+        assert len(negs) == len(prompts), (
+            f"{len(prompts)} prompts but {len(negs)} negative prompts"
         )
-
-        embeds, added = self._encode(prompts, negs)
-
-        lat_shape = (len(prompts), cfg.latent_height, cfg.latent_width,
-                     self.unet_config.in_channels)
         self.scheduler.set_timesteps(num_inference_steps)
-        if latents is None:
-            # seeded noise, pre-scaled (diffusers passes a torch Generator;
-            # the JAX analog is the integer seed)
-            latents = jax.random.normal(jax.random.PRNGKey(seed), lat_shape,
-                                        jnp.float32)
-            latents = latents * self.scheduler.init_noise_sigma
-        else:
-            # caller-supplied initial noise (already scaled), for golden
-            # comparisons across configs
-            latents = jnp.asarray(latents, jnp.float32)
-            assert latents.shape == lat_shape, (latents.shape, lat_shape)
 
-        latent = self.runner.generate(
-            latents, embeds,
-            guidance_scale=guidance_scale,
-            num_inference_steps=num_inference_steps,
-            added_cond=added,
+        def run_chunk(cp, cn, cl):
+            embeds, added = self._encode(cp, cn)
+            return self.runner.generate(
+                cl, embeds,
+                guidance_scale=guidance_scale,
+                num_inference_steps=num_inference_steps,
+                added_cond=added,
+            )
+
+        # seeded noise for the whole expanded batch (diffusers passes a torch
+        # Generator; the JAX analog is the integer seed); caller-supplied
+        # ``latents`` must cover len(prompts) * num_images_per_prompt images
+        latent = _batched_generate(
+            cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
+            latents, self.unet_config.in_channels, run_chunk,
         )
         if output_type == "latent":
             # one entry per image, matching the 'np'/'pil' contract
             return PipelineOutput(images=list(np.asarray(latent)))
-        image = self._decode(
-            self.vae_params, latent / self.vae_config.scaling_factor
+        image = _decode_chunked(
+            self._decode, self.vae_params, latent,
+            self.distri_config.batch_size, self.vae_config.scaling_factor,
         )
         image = np.asarray(image, np.float32)
         image = np.clip(image / 2 + 0.5, 0.0, 1.0)
@@ -729,6 +782,7 @@ class DistriPixArtPipeline:
         seed: int = 0,
         output_type: str = "pil",
         latents=None,
+        num_images_per_prompt: int = 1,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -745,30 +799,27 @@ class DistriPixArtPipeline:
             if isinstance(negative_prompt, str)
             else list(negative_prompt)
         )
-        assert len(prompts) == cfg.batch_size, (
-            f"config batch_size={cfg.batch_size}, got {len(prompts)} prompts"
+        assert len(negs) == len(prompts), (
+            f"{len(prompts)} prompts but {len(negs)} negative prompts"
         )
-        emb, mask = self._encode(prompts, negs)
-
-        lat_shape = (len(prompts), cfg.latent_height, cfg.latent_width,
-                     self.dit_config.in_channels)
         self.scheduler.set_timesteps(num_inference_steps)
-        if latents is None:
-            latents = jax.random.normal(jax.random.PRNGKey(seed), lat_shape,
-                                        jnp.float32)
-            latents = latents * self.scheduler.init_noise_sigma
-        else:
-            latents = jnp.asarray(latents, jnp.float32)
-            assert latents.shape == lat_shape, (latents.shape, lat_shape)
 
-        latent = self.runner.generate(
-            latents, emb, guidance_scale=guidance_scale,
-            num_inference_steps=num_inference_steps, cap_mask=mask,
+        def run_chunk(cp, cn, cl):
+            emb, mask = self._encode(cp, cn)
+            return self.runner.generate(
+                cl, emb, guidance_scale=guidance_scale,
+                num_inference_steps=num_inference_steps, cap_mask=mask,
+            )
+
+        latent = _batched_generate(
+            cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
+            latents, self.dit_config.in_channels, run_chunk,
         )
         if output_type == "latent":
             return PipelineOutput(images=list(np.asarray(latent)))
-        image = self._decode(
-            self.vae_params, latent / self.vae_config.scaling_factor
+        image = _decode_chunked(
+            self._decode, self.vae_params, latent,
+            self.distri_config.batch_size, self.vae_config.scaling_factor,
         )
         image = np.asarray(image, np.float32)
         image = np.clip(image / 2 + 0.5, 0.0, 1.0)

@@ -124,8 +124,56 @@ def test_batch_of_prompts(devices8):
     lat = np.stack(out.images)
     assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()
-    with pytest.raises(AssertionError, match="batch_size"):
-        pipe("just one", num_inference_steps=2)
+    # fewer prompts than batch_size: padded internally, one image back
+    one = pipe("just one", num_inference_steps=2, output_type="latent")
+    assert len(one.images) == 1
+
+
+def test_prompt_chunking_matches_manual_chunks(devices8):
+    """3 prompts through a batch_size=2 pipeline == the two manual chunk
+    calls with the same per-image initial noise (VERDICT r3 task 8: arbitrary
+    prompt counts chunk instead of asserting)."""
+    pipe, _ = build_sd_pipeline(devices8, 2, batch_size=2)
+    lats = np.asarray(jax.random.normal(jax.random.PRNGKey(9), (3, 16, 16, 4)))
+    kw = dict(num_inference_steps=2, output_type="latent")
+    all3 = pipe(["a cat", "a dog", "a bird"], latents=lats, **kw).images
+    assert len(all3) == 3
+    first2 = pipe(["a cat", "a dog"], latents=lats[:2], **kw).images
+    # the tail chunk pads internally; hand it the padded latents explicitly
+    last1 = pipe(["a bird", "a bird"], latents=np.concatenate(
+        [lats[2:], lats[2:]]), **kw).images
+    np.testing.assert_array_equal(np.stack(all3[:2]), np.stack(first2))
+    np.testing.assert_array_equal(all3[2], last1[0])
+
+
+def test_chunked_decode_and_empty_prompts(devices8):
+    """The decode path handles totals that are not a batch_size multiple
+    (chunked VAE decode), and an empty prompt list fails with a clear
+    message."""
+    pipe, _ = build_sd_pipeline(devices8, 2, batch_size=2)
+    out = pipe(["a cat", "a dog", "a bird"], num_inference_steps=2,
+               output_type="np")
+    assert len(out.images) == 3
+    assert all(np.isfinite(im).all() for im in out.images)
+    with pytest.raises(AssertionError, match="at least one prompt"):
+        pipe([], num_inference_steps=2)
+
+
+def test_num_images_per_prompt(devices8):
+    """num_images_per_prompt expands prompt-major (diffusers order): the
+    expanded call equals an explicit repeated-prompt call on the same
+    latents."""
+    pipe, _ = build_sd_pipeline(devices8, 2, batch_size=2)
+    lats = np.asarray(jax.random.normal(jax.random.PRNGKey(4), (4, 16, 16, 4)))
+    kw = dict(num_inference_steps=2, output_type="latent")
+    expanded = pipe(["a cat", "a dog"], num_images_per_prompt=2,
+                    latents=lats, **kw).images
+    explicit = pipe(["a cat", "a cat", "a dog", "a dog"],
+                    latents=lats, **kw).images
+    assert len(expanded) == 4
+    np.testing.assert_array_equal(np.stack(expanded), np.stack(explicit))
+    # different noise per image of the same prompt
+    assert np.abs(expanded[0] - expanded[1]).max() > 0
 
 
 def test_sdxl_batch_prompts(devices8):

@@ -109,6 +109,47 @@ def test_lookup_requires_matching_head_dim():
         sdpa_routing.MEASURED_ROUTES = old
 
 
+def test_lookup_distance_cap():
+    """A lone long-L measurement must not govern short sequences (ADVICE
+    r3): beyond MAX_BUCKET_DISTANCE log2 steps lookup falls through to the
+    analytic default."""
+    table = {(64, 14): Route("inrepo", 256, 512)}  # L=16384 only
+    old = sdpa_routing.MEASURED_ROUTES
+    sdpa_routing.MEASURED_ROUTES = table
+    try:
+        assert sdpa_routing.lookup(16384, 64) == Route("inrepo", 256, 512)
+        assert sdpa_routing.lookup(8192, 64) is not None   # 1 step away
+        assert sdpa_routing.lookup(1024, 64) is None       # 4 steps away
+        assert sdpa_routing.lookup(2**20, 64) is None      # far the other way
+    finally:
+        sdpa_routing.MEASURED_ROUTES = old
+
+
+def test_updater_tiles_keyed_by_head_dim(tmp_path):
+    """Tuned tiles for one head_dim must not leak onto another head_dim's
+    route at the same L (ADVICE r3)."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 2.0, "inrepo": 1.5}},
+        {"phase": "attn", "L": 4096, "heads": 16, "head_dim": 72,
+         "ms": {"xla": 2.2, "inrepo": 1.8}},
+        {"phase": "tune", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"256x512": 1.2}},
+        {"phase": "tune", "L": 4096, "heads": 16, "head_dim": 72,
+         "ms": {"128x128": 1.6}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][:3] == ("inrepo", 256, 512)
+    assert routes[(72, 12)][:3] == ("inrepo", 128, 128)
+
+
 def test_updater_round_trip(tmp_path):
     import update_sdpa_table as upd
 
