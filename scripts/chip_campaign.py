@@ -265,7 +265,9 @@ def main():
                                        num_inference_steps=4, added_cond=added)
 
             jax.block_until_ready(short())  # compile outside the trace
-            with jax.profiler.trace(trace_dir):
+            # perfetto json.gz alongside the xplane pb: stdlib-parseable by
+            # scripts/analyze_trace.py (no tensorboard in this image)
+            with jax.profiler.trace(trace_dir, create_perfetto_trace=True):
                 jax.block_until_ready(short())
             emit("trace", ok=True, dir=trace_dir)
         except Exception as e:
