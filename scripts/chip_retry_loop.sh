@@ -31,6 +31,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   rc=$?
   echo "=== retry_loop attempt $n exited rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
   if [ "$rc" -eq 0 ]; then
+    # Bake the measured routing table FIRST so the bench below (and the
+    # driver's end-of-round bench) run with measured routing instead of
+    # the pinned-XLA unmeasured fallback.
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= PYTHONPATH=/root/.axon_site:"$PWD" \
+      python scripts/update_sdpa_table.py --log "$LOG" \
+      --label "v5e campaign_r4 $(date -u +%F)" >> "$LOG" 2>&1
+    echo "=== table bake rc=$? $(date -u +%H:%M:%S) ===" >> "$LOG"
     # Chip is warm and .jax_cache is populated: run the headline bench NOW
     # so a real BENCH-style number exists even if the driver's end-of-round
     # run hits another outage, and so the first-vs-second-run compile time
