@@ -221,7 +221,8 @@ class DenoiseRunner:
     # the full loop (traced once per num_steps)
     # ------------------------------------------------------------------
 
-    def _device_loop(self, params, latents, enc, added, gs, num_steps):
+    def _device_loop(self, params, latents, enc, added, gs, num_steps,
+                     start_step=0):
         cfg = self.cfg
         sched = self.scheduler
         my_enc, my_added, _ = self._branch_inputs(enc, added)
@@ -263,24 +264,26 @@ class DenoiseRunner:
                 return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
 
             x, _, _ = lax.fori_loop(
-                0, num_steps, body, (x, state_zeros({}), sstate)
+                start_step, num_steps, body, (x, state_zeros({}), sstate)
             )
             return x
 
         # displaced patch parallelism: sync warmup then stale steady state.
         # counter <= warmup_steps selects sync (reference §2.3), so steps
-        # 0..warmup inclusive are synchronous.
-        n_sync = min(cfg.warmup_steps + 1, num_steps)
+        # 0..warmup inclusive are synchronous.  An img2img entry (start_step
+        # > 0) counts its warmup from the first step actually executed.
+        n_sync = min(cfg.warmup_steps + 1, num_steps - start_step)
 
         def sync_body(i, carry):
             x, ps, ss = carry
             return step_sync(params, i, x, ps, ss, my_enc, my_added, text_kv, gs)
 
         x, pstate, sstate = lax.fori_loop(
-            0, n_sync, sync_body, (x, state_zeros(None), sstate)
+            start_step, start_step + n_sync, sync_body,
+            (x, state_zeros(None), sstate)
         )
 
-        if n_sync >= num_steps:
+        if start_step + n_sync >= num_steps:
             # all steps synchronous (e.g. short A/B runs): a zero-length scan
             # would still compile its dead stale UNet body
             return x
@@ -291,15 +294,17 @@ class DenoiseRunner:
             return (x, ps, ss), None
 
         (x, _, _), _ = lax.scan(
-            stale_body, (x, pstate, sstate), jnp.arange(n_sync, num_steps)
+            stale_body, (x, pstate, sstate),
+            jnp.arange(start_step + n_sync, num_steps)
         )
         return x
 
-    def _build(self, num_steps: int):
+    def _build(self, num_steps: int, start_step: int = 0):
         cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
 
-        device_loop = partial(self._device_loop, num_steps=num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps,
+                              start_step=start_step)
 
         # Inputs/outputs shard over the dp axis on the image-batch dim; with
         # dp_degree == 1 this degenerates to replication.
@@ -372,7 +377,8 @@ class DenoiseRunner:
         donate = (3,) if with_state and cfg.parallelism == "patch" else ()
         return jax.jit(stepper, donate_argnums=donate)
 
-    def _generate_stepwise(self, latents, enc, added, gs, num_steps):
+    def _generate_stepwise(self, latents, enc, added, gs, num_steps,
+                           start_step=0):
         """Python loop over per-step compiled calls (reference no-CUDA-graph
         path, distri_sdxl_unet_pp.py:117-193): same numerics as the fused
         loop, per-step latency visible from the host."""
@@ -386,14 +392,15 @@ class DenoiseRunner:
             else ({} if cfg.parallelism != "patch" else None)
         )
         one_phase = cfg.parallelism != "patch" or cfg.mode == "full_sync"
-        n_sync = num_steps if one_phase else min(cfg.warmup_steps + 1, num_steps)
+        n_sync = (num_steps - start_step if one_phase
+                  else min(cfg.warmup_steps + 1, num_steps - start_step))
 
         key = ("stepwise", num_steps)
         if key not in self._compiled:
             self._compiled[key] = {}
         fns = self._compiled[key]
-        for i in range(num_steps):
-            phase = PHASE_SYNC if i < n_sync else PHASE_STALE
+        for i in range(start_step, num_steps):
+            phase = PHASE_SYNC if i < start_step + n_sync else PHASE_STALE
             with_state = pstate is not None
             fkey = (phase, with_state)
             if fkey not in fns:
@@ -530,12 +537,15 @@ class DenoiseRunner:
         guidance_scale: float = 5.0,
         num_inference_steps: int = 50,
         added_cond: Optional[Dict[str, Any]] = None,
+        start_step: int = 0,
     ):
         """Run the denoising loop.
 
         ``latents``: [B, H/8, W/8, C] initial noise **already scaled** by
-        ``scheduler.init_noise_sigma``.  ``prompt_embeds``: [n_branches, B,
-        L, C] with branch 0 = unconditional (reference rank layout,
+        ``scheduler.init_noise_sigma`` — or, with ``start_step > 0``
+        (img2img), a clean latent noised to that schedule point via
+        ``scheduler.add_noise``.  ``prompt_embeds``: [n_branches, B, L, C]
+        with branch 0 = unconditional (reference rank layout,
         utils.py:98-104).  Returns the denoised latent [B, H/8, W/8, C].
         """
         added = added_cond if added_cond is not None else None
@@ -561,6 +571,8 @@ class DenoiseRunner:
         if added is not None and "text_embeds" in added:
             added = dict(added)
             added["text_embeds"] = jnp.asarray(added["text_embeds"], self.cfg.dtype)
+        assert 0 <= start_step < num_inference_steps, (start_step,
+                                                       num_inference_steps)
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),
@@ -568,15 +580,18 @@ class DenoiseRunner:
                 added,
                 jnp.asarray(guidance_scale, jnp.float32),
                 num_inference_steps,
+                start_step,
             )
         # Re-pin the scheduler tables on every call, not just at build time:
         # a cached jitted loop can RE-trace later (new input shapes), and the
         # trace reads the mutable scheduler — which a generate() with a
         # different step count may have re-tabled in between.
         self.scheduler.set_timesteps(num_inference_steps)
-        if num_inference_steps not in self._compiled:
-            self._compiled[num_inference_steps] = self._build(num_inference_steps)
-        fn = self._compiled[num_inference_steps]
+        key = (num_inference_steps if start_step == 0
+               else (num_inference_steps, start_step))
+        if key not in self._compiled:
+            self._compiled[key] = self._build(num_inference_steps, start_step)
+        fn = self._compiled[key]
         return fn(
             self.params,
             jnp.asarray(latents),

@@ -288,6 +288,11 @@ class _DistriPipelineBase:
             jax.jit(lambda prm, ids, _cfg=ccfg: clip_mod.clip_text_forward(prm, _cfg, ids))
             for ccfg, _ in self.text_encoders
         ]
+        # jitted init-image encode for img2img, for the same reason as the
+        # text encoders above (eager per-call dispatch otherwise)
+        self._encode_image = jax.jit(
+            lambda prm, x: vae_mod.encode(prm, vae_config, x)
+        )
         if distri_config.verbose and distri_config.parallelism == "patch":
             # buffer-volume report at construction, like the reference's
             # create_buffer prints (utils.py:152-158)
@@ -318,6 +323,8 @@ class _DistriPipelineBase:
         output_type: str = "pil",
         latents=None,
         num_images_per_prompt: int = 1,
+        image=None,
+        strength: float = 0.8,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -339,6 +346,53 @@ class _DistriPipelineBase:
         )
         self.scheduler.set_timesteps(num_inference_steps)
 
+        start_step = 0
+        if image is not None:
+            # img2img (beyond the reference, which is text2img-only):
+            # VAE-encode the init image, noise it to the strength-offset
+            # schedule point, and denoise only the remaining tail
+            # (diffusers Img2Img timestep convention).
+            assert latents is None, "pass either image or latents, not both"
+            assert 0.0 < strength <= 1.0, strength
+            # at least one denoise step always runs (strength*steps < 1
+            # would otherwise ask for a zero-length schedule)
+            init_timestep = min(max(int(num_inference_steps * strength), 1),
+                                num_inference_steps)
+            start_step = num_inference_steps - init_timestep
+            # canonical input range: uint8 [0,255] or float [0,1] (the same
+            # range this pipeline's output_type="np" produces) — no value
+            # sniffing beyond the dtype
+            if np.asarray(image).dtype == np.uint8:
+                arr = np.asarray(image, np.float32) / 255.0
+            else:
+                arr = np.asarray(image, np.float32)
+            if arr.ndim == 3:
+                arr = arr[None]
+            if arr.min() < 0.0 or arr.max() > 1.0:
+                raise ValueError(
+                    "init image must be uint8 [0,255] or float [0,1] "
+                    f"(got range [{arr.min():.3f}, {arr.max():.3f}])"
+                )
+            arr = arr * 2.0 - 1.0  # VAE input range [-1,1]
+            n_img = arr.shape[0]
+            assert n_img in (1, len(prompts)), (
+                f"{n_img} init images for {len(prompts)} prompts"
+            )
+            init = self._encode_image(
+                self.vae_params, jnp.asarray(arr)
+            ) * self.vae_config.scaling_factor
+            assert init.shape[1:3] == (cfg.latent_height, cfg.latent_width), (
+                f"init image encodes to {init.shape[1:3]}, config wants "
+                f"{(cfg.latent_height, cfg.latent_width)}"
+            )
+            if n_img == 1 and len(prompts) > 1:
+                init = jnp.tile(init, (len(prompts), 1, 1, 1))
+            # prompt-major expansion, matching _batched_generate
+            init = jnp.repeat(init, num_images_per_prompt, axis=0)
+            noise = jax.random.normal(jax.random.PRNGKey(seed), init.shape,
+                                      jnp.float32)
+            latents = self.scheduler.add_noise(init, noise, start_step)
+
         def run_chunk(cp, cn, cl):
             embeds, added = self._encode(cp, cn)
             return self.runner.generate(
@@ -346,6 +400,7 @@ class _DistriPipelineBase:
                 guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps,
                 added_cond=added,
+                start_step=start_step,
             )
 
         # seeded noise for the whole expanded batch (diffusers passes a torch

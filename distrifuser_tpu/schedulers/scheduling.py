@@ -96,6 +96,16 @@ class BaseScheduler:
         assert self.num_inference_steps is not None, "call set_timesteps first"
         return self._timesteps
 
+    def add_noise(self, original, noise, step_index):
+        """Noise a clean latent to the schedule point ``step_index`` — the
+        img2img entry (diffusers add_noise parity): x_t = sqrt(ac_t) x0 +
+        sqrt(1 - ac_t) eps at t = timesteps()[step_index]."""
+        t = self.timesteps()[step_index]
+        ac = jnp.asarray(self._alphas_cumprod, jnp.float32)[t]
+        x0 = original.astype(jnp.float32)
+        out = jnp.sqrt(ac) * x0 + jnp.sqrt(1.0 - ac) * noise.astype(jnp.float32)
+        return out.astype(original.dtype)
+
     def step(self, sample, model_output, step_index, state):
         raise NotImplementedError
 
@@ -148,6 +158,13 @@ class EulerDiscreteScheduler(BaseScheduler):
     def scale_model_input(self, sample, step_index):
         sigma = self._sigmas[step_index]
         return (sample / jnp.sqrt(sigma**2 + 1.0)).astype(sample.dtype)
+
+    def add_noise(self, original, noise, step_index):
+        """Euler carries the sigma-space latent x = x0 + sigma * eps
+        (diffusers EulerDiscreteScheduler.add_noise)."""
+        sigma = self._sigmas[step_index]
+        out = original.astype(jnp.float32) + sigma * noise.astype(jnp.float32)
+        return out.astype(original.dtype)
 
     def step(self, sample, model_output, step_index, state):
         # Euler works in the sigma-space parameterization x = x0 + sigma * n;

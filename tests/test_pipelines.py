@@ -190,6 +190,60 @@ def test_sdxl_batch_prompts(devices8):
     assert np.isfinite(lat).all()
 
 
+def test_img2img_wiring_matches_manual_latents(devices8):
+    """strength=1.0 img2img == text2img fed the manually noised encode of
+    the same image (pins the encode -> add_noise -> generate wiring), and a
+    partial strength runs fewer steps from a closer start."""
+    import jax.numpy as jnp
+
+    from distrifuser_tpu.models import vae as vae_mod
+
+    pipe, dcfg = build_sd_pipeline(devices8, 2)
+    rng = np.random.RandomState(7)
+    im = rng.rand(32, 32, 3).astype(np.float32)  # [0,1], decoder-sized
+    kw = dict(num_inference_steps=4, output_type="latent", seed=11)
+
+    out_i2i = pipe("a cabin", image=im, strength=1.0, **kw).images[0]
+
+    init = pipe._encode_image(
+        pipe.vae_params, jnp.asarray((im * 2 - 1)[None])
+    ) * pipe.vae_config.scaling_factor
+    pipe.scheduler.set_timesteps(4)
+    noise = jax.random.normal(jax.random.PRNGKey(11), init.shape, jnp.float32)
+    manual = pipe.scheduler.add_noise(init, noise, 0)
+    out_manual = pipe("a cabin", latents=np.asarray(manual), **kw).images[0]
+    np.testing.assert_array_equal(out_i2i, out_manual)
+
+    # partial strength: still finite, and output differs (fewer steps, start
+    # closer to the init image)
+    out_half = pipe("a cabin", image=im, strength=0.5, **kw).images[0]
+    assert np.isfinite(out_half).all()
+    assert np.abs(out_half - out_i2i).max() > 0
+    with pytest.raises(AssertionError, match="not both"):
+        pipe("a cabin", image=im, latents=np.asarray(manual), **kw)
+
+
+def test_img2img_low_strength_stays_closer_to_init(devices8):
+    """Lower strength must reconstruct the init latent more closely — the
+    user-visible img2img contract."""
+    import jax.numpy as jnp
+
+    from distrifuser_tpu.models import vae as vae_mod
+
+    pipe, _ = build_sd_pipeline(devices8, 1)
+    rng = np.random.RandomState(8)
+    im = rng.rand(32, 32, 3).astype(np.float32)
+    init = np.asarray(vae_mod.encode(
+        pipe.vae_params, pipe.vae_config, jnp.asarray((im * 2 - 1)[None])
+    ) * pipe.vae_config.scaling_factor)
+    kw = dict(num_inference_steps=8, output_type="latent", seed=3)
+    d = {}
+    for s in (0.25, 1.0):
+        out = pipe("a cabin", image=im, strength=s, **kw).images[0]
+        d[s] = float(np.abs(out - init[0]).mean())
+    assert d[0.25] < d[1.0], d
+
+
 def test_simple_tokenizer_shapes():
     tok = SimpleTokenizer()
     ids = tok(["hello world", ""])

@@ -148,3 +148,53 @@ def test_steps_inside_scan():
             lambda x0, st: jax.lax.scan(body, (x0, st), jnp.arange(10))
         )(x, state)
         assert np.isfinite(np.asarray(xf)).all()
+
+
+def test_add_noise_ddim_matches_closed_form():
+    """add_noise must land exactly on x_t = sqrt(ac_t) x0 + sqrt(1-ac_t) n at
+    the step's timestep (img2img entry)."""
+    s = DDIMScheduler().set_timesteps(10)
+    key = jax.random.PRNGKey(3)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    for i in (0, 4, 9):
+        t = int(np.asarray(s.timesteps())[i])
+        ac = s._alphas_cumprod[t]
+        want = np.sqrt(ac) * np.asarray(x0) + np.sqrt(1 - ac) * np.asarray(n)
+        np.testing.assert_allclose(np.asarray(s.add_noise(x0, n, i)), want,
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_add_noise_euler_sigma_space():
+    s = EulerDiscreteScheduler().set_timesteps(10)
+    key = jax.random.PRNGKey(4)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    for i in (0, 5):
+        sigma = float(np.asarray(s._sigmas)[i])
+        want = np.asarray(x0) + sigma * np.asarray(n)
+        np.testing.assert_allclose(np.asarray(s.add_noise(x0, n, i)), want,
+                                   rtol=1e-6, atol=1e-6)
+    # at i=0 this is the init_noise_sigma-scaled entry up to the +x0 shift
+    assert float(np.asarray(s._sigmas)[0]) == pytest.approx(
+        (s.init_noise_sigma**2 - 1) ** 0.5, rel=1e-6)
+
+
+def test_add_noise_then_oracle_denoise_recovers_x0():
+    """End-to-end img2img sanity: noise a clean latent to the midpoint, then
+    denoise the remaining steps with the true-noise oracle — DDIM must land
+    back on x0 (the trajectory is exact for an oracle model)."""
+    s = DDIMScheduler().set_timesteps(8)
+    key = jax.random.PRNGKey(5)
+    x0 = jax.random.normal(key, (1, 4, 4, 2))
+    n = jax.random.normal(jax.random.fold_in(key, 1), x0.shape)
+    start = 4
+    x = s.add_noise(x0, n, start)
+    state = s.init_state(x0.shape)
+    for i in range(start, 8):
+        x, state = s.step(x, n, i, state)  # oracle: model predicts n exactly
+    # set_alpha_to_one=False: the trajectory terminates at alpha = ac[0]
+    # (x_final = sqrt(ac0) x0 + sqrt(1-ac0) n), not exactly x0
+    a_last = float(np.asarray(s._alpha_prev)[-1])
+    want = np.sqrt(a_last) * np.asarray(x0) + np.sqrt(1 - a_last) * np.asarray(n)
+    np.testing.assert_allclose(np.asarray(x), want, rtol=1e-4, atol=1e-4)
