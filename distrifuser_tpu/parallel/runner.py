@@ -222,7 +222,15 @@ class DenoiseRunner:
     # ------------------------------------------------------------------
 
     def _device_loop(self, params, latents, enc, added, gs, num_steps,
-                     start_step=0):
+                     start_step=0, end_step=None):
+        # end_step: exclusive stop index (diffusers denoising_end analog);
+        # the schedule tables stay those of the full num_steps run, only
+        # the executed range narrows.  Stateful schedulers (DPM-Solver 2M)
+        # resume a split run with FRESH solver history — the first resumed
+        # step is first-order, exactly as diffusers behaves across separate
+        # base/refiner pipeline objects; only stateless schedulers (DDIM,
+        # Euler) replay the uninterrupted trajectory bit-for-bit.
+        num_steps = num_steps if end_step is None else end_step
         cfg = self.cfg
         sched = self.scheduler
         my_enc, my_added, _ = self._branch_inputs(enc, added)
@@ -299,12 +307,13 @@ class DenoiseRunner:
         )
         return x
 
-    def _build(self, num_steps: int, start_step: int = 0):
+    def _build(self, num_steps: int, start_step: int = 0,
+               end_step: int = None):
         cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
 
         device_loop = partial(self._device_loop, num_steps=num_steps,
-                              start_step=start_step)
+                              start_step=start_step, end_step=end_step)
 
         # Inputs/outputs shard over the dp axis on the image-batch dim; with
         # dp_degree == 1 this degenerates to replication.
@@ -378,10 +387,11 @@ class DenoiseRunner:
         return jax.jit(stepper, donate_argnums=donate)
 
     def _generate_stepwise(self, latents, enc, added, gs, num_steps,
-                           start_step=0):
+                           start_step=0, end_step=None):
         """Python loop over per-step compiled calls (reference no-CUDA-graph
         path, distri_sdxl_unet_pp.py:117-193): same numerics as the fused
         loop, per-step latency visible from the host."""
+        num_exec_end = num_steps if end_step is None else end_step
         cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
         x = jnp.asarray(latents, jnp.float32)
@@ -392,14 +402,14 @@ class DenoiseRunner:
             else ({} if cfg.parallelism != "patch" else None)
         )
         one_phase = cfg.parallelism != "patch" or cfg.mode == "full_sync"
-        n_sync = (num_steps - start_step if one_phase
-                  else min(cfg.warmup_steps + 1, num_steps - start_step))
+        n_sync = (num_exec_end - start_step if one_phase
+                  else min(cfg.warmup_steps + 1, num_exec_end - start_step))
 
         key = ("stepwise", num_steps)
         if key not in self._compiled:
             self._compiled[key] = {}
         fns = self._compiled[key]
-        for i in range(start_step, num_steps):
+        for i in range(start_step, num_exec_end):
             phase = PHASE_SYNC if i < start_step + n_sync else PHASE_STALE
             with_state = pstate is not None
             fkey = (phase, with_state)
@@ -538,6 +548,7 @@ class DenoiseRunner:
         num_inference_steps: int = 50,
         added_cond: Optional[Dict[str, Any]] = None,
         start_step: int = 0,
+        end_step: Optional[int] = None,
     ):
         """Run the denoising loop.
 
@@ -573,6 +584,8 @@ class DenoiseRunner:
             added["text_embeds"] = jnp.asarray(added["text_embeds"], self.cfg.dtype)
         assert 0 <= start_step < num_inference_steps, (start_step,
                                                        num_inference_steps)
+        assert end_step is None or start_step < end_step <= num_inference_steps, (
+            start_step, end_step, num_inference_steps)
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),
@@ -581,16 +594,18 @@ class DenoiseRunner:
                 jnp.asarray(guidance_scale, jnp.float32),
                 num_inference_steps,
                 start_step,
+                end_step,
             )
         # Re-pin the scheduler tables on every call, not just at build time:
         # a cached jitted loop can RE-trace later (new input shapes), and the
         # trace reads the mutable scheduler — which a generate() with a
         # different step count may have re-tabled in between.
         self.scheduler.set_timesteps(num_inference_steps)
-        key = (num_inference_steps if start_step == 0
-               else (num_inference_steps, start_step))
+        key = (num_inference_steps if start_step == 0 and end_step is None
+               else (num_inference_steps, start_step, end_step))
         if key not in self._compiled:
-            self._compiled[key] = self._build(num_inference_steps, start_step)
+            self._compiled[key] = self._build(num_inference_steps, start_step,
+                                              end_step)
         fn = self._compiled[key]
         return fn(
             self.params,

@@ -325,6 +325,8 @@ class _DistriPipelineBase:
         num_images_per_prompt: int = 1,
         image=None,
         strength: float = 0.8,
+        denoising_start: float = None,
+        denoising_end: float = None,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -346,7 +348,35 @@ class _DistriPipelineBase:
         )
         self.scheduler.set_timesteps(num_inference_steps)
 
+        # base+refiner split (diffusers denoising_end / denoising_start
+        # fractions, index-based here): the base stage stops at end_step and
+        # hands its latent to a second pipeline (e.g. an SDXL refiner
+        # checkpoint, which from_pretrained loads like any SDXL UNet) that
+        # resumes at the same fraction.
         start_step = 0
+        end_step = None
+        if denoising_end is not None:
+            assert 0.0 < denoising_end < 1.0, denoising_end
+            # same index mapping as denoising_start below, so matched
+            # fractions hand off without overlap or gap
+            end_step = int(round(num_inference_steps * denoising_end))
+            if end_step < 1:
+                raise ValueError(
+                    f"denoising_end={denoising_end} rounds to zero steps at "
+                    f"num_inference_steps={num_inference_steps}"
+                )
+        if denoising_start is not None:
+            assert 0.0 < denoising_start < 1.0, denoising_start
+            assert image is None, (
+                "denoising_start resumes mid-trajectory latents; use "
+                "image+strength for img2img instead"
+            )
+            assert latents is not None, (
+                "denoising_start requires the mid-trajectory latents from "
+                "the previous stage"
+            )
+            start_step = int(round(num_inference_steps * denoising_start))
+
         if image is not None:
             # img2img (beyond the reference, which is text2img-only):
             # VAE-encode the init image, noise it to the strength-offset
@@ -401,6 +431,7 @@ class _DistriPipelineBase:
                 num_inference_steps=num_inference_steps,
                 added_cond=added,
                 start_step=start_step,
+                end_step=end_step,
             )
 
         # seeded noise for the whole expanded batch (diffusers passes a torch

@@ -244,6 +244,27 @@ def test_img2img_low_strength_stays_closer_to_init(devices8):
     assert d[0.25] < d[1.0], d
 
 
+def test_denoising_split_equals_full_run(devices8):
+    """Base+refiner split protocol: a run stopped at denoising_end plus a
+    second run resumed at the same denoising_start must equal the
+    uninterrupted run (single device: one-phase loop, so the handoff cannot
+    change warmup semantics)."""
+    pipe, dcfg = build_sd_pipeline(devices8, 1)
+    noise = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (1, 16, 16, 4)))
+    kw = dict(num_inference_steps=6, output_type="latent")
+    full = pipe("a canyon", latents=noise, **kw).images[0]
+    mid = pipe("a canyon", latents=noise, denoising_end=0.5, **kw).images[0]
+    assert np.abs(mid - full).max() > 0  # actually stopped early
+    resumed = pipe("a canyon", latents=mid[None], denoising_start=0.5,
+                   **kw).images[0]
+    # bitwise equality does not survive XLA compiling the three loop
+    # programs separately (float re-association); 1e-4 on O(30) latents
+    # is ~1e-5 relative
+    np.testing.assert_allclose(resumed, full, atol=1e-4)
+    with pytest.raises(AssertionError, match="mid-trajectory"):
+        pipe("a canyon", denoising_start=0.5, **kw)
+
+
 def test_simple_tokenizer_shapes():
     tok = SimpleTokenizer()
     ids = tok(["hello world", ""])
