@@ -82,6 +82,14 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         choices=["bfloat16", "float32"],
                         help="model/computation dtype (default: bf16 on TPU, "
                         "fp32 on CPU)")
+    parser.add_argument("--num_images_per_prompt", type=int, default=1,
+                        help="images per prompt (chunked through the "
+                        "fixed-batch compiled loop)")
+    parser.add_argument("--init_image", type=str, default=None,
+                        help="img2img: path to the init image (png/jpg), "
+                        "sized to the configured height x width")
+    parser.add_argument("--strength", type=float, default=0.8,
+                        help="img2img noise strength (with --init_image)")
 
 
 def config_from_args(args) -> DistriConfig:
@@ -112,6 +120,33 @@ def config_from_args(args) -> DistriConfig:
         vae_sp=not args.no_vae_sp,
         dtype=None if args.dtype is None else getattr(jnp, args.dtype),
     )
+
+
+def img2img_kwargs(args) -> dict:
+    """--init_image/--strength -> pipeline img2img kwargs; {} when off.
+
+    Loads the image EAGERLY so a bad path fails before the multi-minute
+    model load, not after."""
+    if getattr(args, "init_image", None) is None:
+        return {}
+    import numpy as np
+    from PIL import Image
+
+    arr = np.asarray(Image.open(args.init_image).convert("RGB"))
+    return {"image": arr, "strength": args.strength}
+
+
+def save_images(output, args) -> None:
+    """Save PIL output(s); multiple images get an _{i} suffix before the
+    extension (splitext, so non-.png paths work too)."""
+    if not is_main_process() or args.output_type != "pil":
+        return
+    root, ext = os.path.splitext(args.output_path)
+    for i, im in enumerate(output.images):
+        path = (args.output_path if len(output.images) == 1
+                else f"{root}_{i}{ext}")
+        im.save(path)
+        print(f"saved {path}")
 
 
 def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler,

@@ -40,6 +40,9 @@ def main():
             f"--parallelism {args.parallelism} is a UNet strategy; the DiT "
             "supports 'patch' (displaced) or 'pipefusion'"
         )
+    if args.init_image is not None:
+        parser.error("img2img is a UNet-pipeline feature (diffusers' PixArt "
+                     "is text2img-only); --init_image is not supported here")
 
     import jax
     import jax.numpy as jnp

@@ -47,6 +47,9 @@ def main():
                         metavar=("K", "N"), help="process chunk k of n")
     parser.add_argument("--results_dir", type=str, default="results/coco")
     args = parser.parse_args()
+    if args.init_image is not None or args.num_images_per_prompt != 1:
+        parser.error("the COCO protocol is one text2img image per caption; "
+                     "--init_image/--num_images_per_prompt do not apply")
 
     distri_config = config_from_args(args)
     pipeline = load_sdxl_pipeline(args, distri_config)

@@ -39,6 +39,9 @@ def get_args() -> argparse.Namespace:
 
 def main():
     args = get_args()
+    from common import img2img_kwargs, save_images
+
+    i2i = img2img_kwargs(args)  # loads --init_image before the model
     distri_config = config_from_args(args)
     pipeline = load_sdxl_pipeline(args, distri_config)
     pipeline.set_progress_bar_config(disable=not is_main_process())
@@ -50,6 +53,8 @@ def main():
             guidance_scale=args.guidance_scale,
             seed=seed,
             output_type=args.output_type,
+            num_images_per_prompt=args.num_images_per_prompt,
+            **i2i,
         )
 
     if args.dump_hlo:
@@ -74,9 +79,7 @@ def main():
 
     if args.mode == "generation":
         output = run(args.seed)
-        if is_main_process() and args.output_type == "pil":
-            output.images[0].save(args.output_path)
-            print(f"saved {args.output_path}")
+        save_images(output, args)
         return
 
     # benchmark (reference run_sdxl.py:124-153)

@@ -2,7 +2,14 @@
 which uses mode="stale_gn" — sd_example.py:6)."""
 import argparse
 
-from common import add_distri_args, config_from_args, is_main_process, load_sd_pipeline
+from common import (
+    add_distri_args,
+    config_from_args,
+    img2img_kwargs,
+    is_main_process,
+    load_sd_pipeline,
+    save_images,
+)
 
 
 def main():
@@ -11,6 +18,7 @@ def main():
     parser.set_defaults(sync_mode="stale_gn", image_size=[512, 512], guidance_scale=7.5)
     args = parser.parse_args()
 
+    i2i = img2img_kwargs(args)  # loads --init_image before the model
     distri_config = config_from_args(args)
     pipeline = load_sd_pipeline(args, distri_config)
     pipeline.set_progress_bar_config(disable=not is_main_process())
@@ -21,10 +29,10 @@ def main():
         guidance_scale=args.guidance_scale,
         seed=args.seed,
         output_type=args.output_type,
+        num_images_per_prompt=args.num_images_per_prompt,
+        **i2i,
     )
-    if is_main_process() and args.output_type == "pil":
-        output.images[0].save(args.output_path)
-        print(f"saved {args.output_path}")
+    save_images(output, args)
 
 
 if __name__ == "__main__":

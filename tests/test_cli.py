@@ -48,3 +48,12 @@ def test_tokenizer_fallback_is_loud(capsys):
     err = capsys.readouterr().err
     assert "WARNING" in err
     assert "/nonexistent/tokenizer/dir" in err
+
+
+def test_img2img_flags_parse():
+    args = _args(["--init_image", "in.png", "--strength", "0.5",
+                  "--num_images_per_prompt", "3"])
+    assert args.init_image == "in.png"
+    assert args.strength == 0.5
+    assert args.num_images_per_prompt == 3
+    assert _args([]).init_image is None

@@ -56,12 +56,10 @@ def test_analyze_synthetic_trace():
     assert rep["exposed_us"] == 50.0
     assert rep["collective_kinds"] == {"all-gather": 1, "collective-permute": 1}
 
-
-@pytest.mark.slow
-def test_real_runner_trace_overlap(devices8, tmp_path):
-    """Trace the real displaced-patch generation (tiny SDXL config, 8-dev
-    mesh) and require the analyzer to find its collectives executing
-    concurrently with compute."""
+def _tiny_patch_runner(devices8, **cfg_overrides):
+    """Tiny-SDXL displaced-patch runner + its generate inputs (shared by the
+    trace tests below — one place for the 8-patch geometry and the
+    added-cond embed math)."""
     from distrifuser_tpu import DistriConfig
     from distrifuser_tpu.models import unet as unet_mod
     from distrifuser_tpu.parallel.runner import make_runner
@@ -70,7 +68,8 @@ def test_real_runner_trace_overlap(devices8, tmp_path):
     ucfg = unet_mod.tiny_config(sdxl=True)
     depth = len(ucfg.block_out_channels) - 1
     cfg = DistriConfig(devices=devices8, height=8 * 16 * (1 << depth),
-                       width=128, warmup_steps=1, parallelism="patch")
+                       width=128, warmup_steps=1, parallelism="patch",
+                       **cfg_overrides)
     params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg)
     runner = make_runner(cfg, ucfg, params, get_scheduler("ddim"))
     lat = jnp.zeros((1, cfg.latent_height, cfg.latent_width, ucfg.in_channels))
@@ -80,13 +79,42 @@ def test_real_runner_trace_overlap(devices8, tmp_path):
     added = {"text_embeds": jnp.zeros((2, 1, emb)),
              "time_ids": jnp.zeros((2, 1, 6))}
 
-    def gen():
+    def gen(steps):
         return runner.generate(lat, enc, guidance_scale=5.0,
-                               num_inference_steps=3, added_cond=added)
+                               num_inference_steps=steps, added_cond=added)
 
-    jax.block_until_ready(gen())  # compile outside the trace
+    return gen
+
+
+@pytest.mark.slow
+def test_comm_batch_reduces_collective_launches(devices8, tmp_path):
+    """comm_batch=True must show up in the runtime trace as fewer collective
+    launch events per generation (the reference's comm_checkpoint rationale,
+    utils.py:181-190: bound launch overhead by batching the refresh
+    exchanges).  Bitwise carry equivalence is pinned elsewhere
+    (tests/test_comm_batch.py); this checks the launch-count claim itself."""
+    counts = {}
+    for batch in (False, True):
+        gen = _tiny_patch_runner(devices8, comm_batch=batch)
+        jax.block_until_ready(gen(4))
+        d = tmp_path / f"trace_{batch}"
+        with jax.profiler.trace(str(d), create_perfetto_trace=True):
+            jax.block_until_ready(gen(4))
+        rep = analyze_trace.analyze(
+            analyze_trace.load_events(analyze_trace.find_perfetto(str(d))))
+        counts[batch] = rep["n_collective_events"]
+    assert counts[True] < counts[False], counts
+
+
+@pytest.mark.slow
+def test_real_runner_trace_overlap(devices8, tmp_path):
+    """Trace the real displaced-patch generation (tiny SDXL config, 8-dev
+    mesh) and require the analyzer to find its collectives executing
+    concurrently with compute."""
+    gen = _tiny_patch_runner(devices8)
+    jax.block_until_ready(gen(3))  # compile outside the trace
     with jax.profiler.trace(str(tmp_path), create_perfetto_trace=True):
-        jax.block_until_ready(gen())
+        jax.block_until_ready(gen(3))
 
     path = analyze_trace.find_perfetto(str(tmp_path))
     assert path is not None and "perfetto" in os.path.basename(path)
