@@ -166,11 +166,19 @@ def sdpa(q, k, v, *, heads: int):
         # still guards), so a probe misjudgment can never override an
         # operator's choice
         explicit = os.environ.get("DISTRIFUSER_TPU_FLASH_IMPL")
+        lq, lk = q.shape[1], k.shape[1]
         if route.impl == "upstream" and not interpret and (
             explicit == "upstream" or _upstream_flash_available()
         ):
+            # tiles generalize across the log2 bucket; drop any that do not
+            # divide THIS call's lengths (the kernel would assert at trace)
+            ubq = (route.block_q
+                   if route.block_q and lq % route.block_q == 0 else None)
+            ubk = (route.block_k
+                   if route.block_k and lk % route.block_k == 0 else None)
             try:
-                return upstream_flash_sdpa(q, k, v, heads=heads)
+                return upstream_flash_sdpa(q, k, v, heads=heads,
+                                           block_q=ubq, block_k=ubk)
             except Exception as e:  # unstable jax.experimental surface:
                 # degrade to the in-repo kernel instead of dying at trace time
                 print(
@@ -178,9 +186,11 @@ def sdpa(q, k, v, *, heads: int):
                     f"({type(e).__name__}: {e}); using in-repo Pallas kernel",
                     file=sys.stderr,
                 )
+                # upstream-tuned tiles do not transfer across kernels; the
+                # in-repo fallback runs its own defaults
+                route = Route("inrepo")
         bq = route.block_q or DEFAULT_BLOCK_Q
         bk = route.block_k or DEFAULT_BLOCK_K
-        lq, lk = q.shape[1], k.shape[1]
         bq = bq if lq % bq == 0 else DEFAULT_BLOCK_Q
         bk = bk if lk % bk == 0 else DEFAULT_BLOCK_K
         return flash_sdpa(

@@ -67,16 +67,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("heads",))
-def upstream_flash_sdpa(q, k, v, *, heads: int):
+@functools.partial(jax.jit, static_argnames=("heads", "block_q", "block_k"))
+def upstream_flash_sdpa(q, k, v, *, heads: int, block_q: int = None,
+                        block_k: int = None):
     """jax.experimental's tuned TPU flash kernel under the sdpa signature.
 
     The upstream kernel (pallas/ops/tpu/flash_attention) carries
-    per-generation block-size tuning the in-repo kernel lacks;
-    scripts/bench_attention.py measures both against the XLA path at real
-    SDXL shapes and DISTRIFUSER_TPU_FLASH_IMPL selects the winner.
+    per-generation block-size defaults; ``block_q``/``block_k`` override
+    them (forward blocks only — inference has no backward pass), letting
+    the chip campaign's tune phase sweep this kernel the same way it
+    sweeps the in-repo one.
     """
-    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
 
     b, lq, c = q.shape
     lk = k.shape[1]
@@ -85,9 +90,15 @@ def upstream_flash_sdpa(q, k, v, *, heads: int):
     def to_heads(x, l):
         return x.reshape(b, l, heads, d).transpose(0, 2, 1, 3)
 
+    block_sizes = None
+    if block_q is not None or block_k is not None:
+        bq = min(block_q or 512, lq)
+        bk = min(block_k or 1024, lk)
+        block_sizes = BlockSizes(block_q=bq, block_k_major=bk, block_k=bk,
+                                 block_b=1)
     o = flash_attention(
         to_heads(q, lq), to_heads(k, lk), to_heads(v, lk),
-        causal=False, sm_scale=1.0 / d**0.5,
+        causal=False, sm_scale=1.0 / d**0.5, block_sizes=block_sizes,
     )
     return o.transpose(0, 2, 1, 3).reshape(b, lq, c)
 

@@ -127,10 +127,20 @@ def main():
                     res[name] = f"failed:{type(e).__name__}"
             emit("attn", L=L, heads=H, head_dim=d, ms=res)
 
-    # ---------------- tune: in-repo kernel tile sweep ----------------------
+    # ---------------- tune: flash-kernel tile sweeps -----------------------
     if "tune" in phases and left() > 600:
-        from distrifuser_tpu.ops.flash_attention import flash_sdpa
+        from distrifuser_tpu.ops.flash_attention import (
+            flash_sdpa, upstream_flash_sdpa,
+        )
 
+        sweeps = [  # (phase name, kernel, tile grid)
+            ("tune", flash_sdpa,
+             [(bq, bk) for bq in (128, 256, 512)
+              for bk in (128, 256, 512, 1024)]),
+            ("tune_upstream", upstream_flash_sdpa,
+             [(bq, bk) for bq in (256, 512, 1024)
+              for bk in (512, 1024, 2048)]),
+        ]
         for (L, C, H) in [(4096, 640, 10), (16384, 640, 10)]:
             if left() < 300:
                 emit("tune", L=L, skipped="deadline")
@@ -139,20 +149,20 @@ def main():
             q = jax.random.normal(ks[0], (2, L, C), jnp.bfloat16)
             k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
             v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
-            res = {}
-            for bq in (128, 256, 512):
-                for bk in (128, 256, 512, 1024):
+            for phase_name, kernel, grid in sweeps:
+                res = {}
+                for bq, bk in grid:
                     if L % bq or L % bk:
                         continue
                     try:
                         res[f"{bq}x{bk}"] = round(timed(
-                            jax.jit(lambda bq=bq, bk=bk: flash_sdpa(
+                            jax.jit(lambda bq=bq, bk=bk, kern=kernel: kern(
                                 q, k, v, heads=H, block_q=bq, block_k=bk)),
                             10,
                         ) * 1e3, 3)
                     except Exception as e:
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
-            emit("tune", L=L, heads=H, head_dim=C // H, ms=res)
+                emit(phase_name, L=L, heads=H, head_dim=C // H, ms=res)
 
     # ---------------- full-model latencies --------------------------------
     def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather",

@@ -150,6 +150,28 @@ def test_updater_tiles_keyed_by_head_dim(tmp_path):
     assert routes[(72, 12)][:3] == ("inrepo", 128, 128)
 
 
+def test_updater_upstream_tune_can_win(tmp_path):
+    """A tuned upstream sweep that beats the default-tile attn comparison
+    flips the route to upstream and carries its tiles."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 2.0, "inrepo": 1.5, "upstream": 1.8}},
+        {"phase": "tune", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"256x512": 1.4}},
+        {"phase": "tune_upstream", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"512x1024": 1.1, "256x512": 1.3}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][:3] == ("upstream", 512, 1024)
+
+
 def test_updater_round_trip(tmp_path):
     import update_sdpa_table as upd
 
