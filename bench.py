@@ -127,7 +127,11 @@ def main():
                         choices=["auto", "fused", "stepwise"],
                         help="auto: stepwise first (records a number in "
                         "minutes), then the fused loop if budget remains; "
-                        "fused/stepwise force a single mode")
+                        "fused/stepwise force a single mode.  (The hybrid "
+                        "loop is a multi-chip feature — DistriConfig"
+                        "(hybrid_loop=True) — and cannot engage on this "
+                        "bench's single-chip config, where the fused "
+                        "program already carries one UNet body.)")
     # Total wall clock from FIRST process start, chosen to undercut the
     # driver's observed ~30 min outer window.  The remote-compile service
     # has taken 15-25+ min for the fused 50-step program on bad days
@@ -239,14 +243,14 @@ def main():
             ),
         }
 
-    def build_run(stepwise: bool):
+    def build_run(mode: str):
         cfg = DistriConfig(
             devices=devices[:1],  # single-chip headline number
             height=size,
             width=size,
             warmup_steps=4,
             parallelism="patch",
-            use_cuda_graph=not stepwise,
+            use_cuda_graph=mode != "stepwise",
         )
         runner = make_runner(cfg, ucfg, params, scheduler)
 
@@ -273,12 +277,12 @@ def main():
               "attention (DISTRIFUSER_TPU_FLASH=0)", file=sys.stderr,
               flush=True)
 
-    def warmup_with_flash_fallback(stepwise: bool):
-        run = build_run(stepwise)
+    def warmup_with_flash_fallback(mode: str):
+        run = build_run(mode)
         try:
             t0 = time.time()
             run()  # warmup: compile + execute
-            print(f"warmup (compile+run, stepwise={stepwise}): "
+            print(f"warmup (compile+run, mode={mode}): "
                   f"{time.time() - t0:.1f}s", file=sys.stderr, flush=True)
         except Exception as e:
             if not on_tpu or os.environ.get("DISTRIFUSER_TPU_FLASH") == "0":
@@ -288,7 +292,7 @@ def main():
             print(f"flash-attention path failed ({type(e).__name__}: {e}); "
                   "falling back to XLA attention", file=sys.stderr)
             os.environ["DISTRIFUSER_TPU_FLASH"] = "0"
-            run = build_run(stepwise)
+            run = build_run(mode)
             run()
         return run
 
@@ -334,8 +338,8 @@ def main():
             print(f"mfu line skipped: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
-    def measure(stepwise: bool) -> dict:
-        run = warmup_with_flash_fallback(stepwise)
+    def measure(mode: str) -> dict:
+        run = warmup_with_flash_fallback(mode)
         times = []
         for _ in range(args.test_times):
             t0 = time.perf_counter()
@@ -349,35 +353,39 @@ def main():
             else 0.0
         )
         return {
-            "metric": metric + ("_stepwise" if stepwise else ""),
+            "metric": metric + ("" if mode == "fused" else f"_{mode}"),
             "value": round(val, 4),
             "unit": "s",
             "vs_baseline": round(vs, 3),
         }
 
     try:
-        if args.mode in ("fused", "stepwise"):
-            r = measure(stepwise=args.mode == "stepwise")
+        if args.mode != "auto":
+            r = measure(args.mode)
             # record BEFORE the MFU extra: if the watchdog fires during the
             # MFU lowering, it flushes this real number instead of rc=2
             _BEST.update(r)
             _print_mfu(r["value"])
             _emit(r)
         else:
-            # auto: fast path first so SOMETHING real is on record, then
-            # upgrade to the fused loop if the remaining budget can plausibly
-            # absorb its compile (minutes on good days, 15-25+ min on bad).
-            _BEST.update(measure(stepwise=True))
+            # auto: fast path first so SOMETHING real is on record, then the
+            # fused loop if the remaining budget can plausibly absorb its
+            # compile (minutes on good days, 15-25+ min on bad).  The
+            # single-chip fused program carries ONE UNet body (the is_sp
+            # one-phase collapse in runner._device_loop), so there is no
+            # separate hybrid rung here — hybrid pays off multi-chip, where
+            # --mode hybrid selects it explicitly.
+            _BEST.update(measure("stepwise"))
             print(f"stepwise result recorded: {_BEST} "
                   f"({remaining():.0f}s budget left)", file=sys.stderr,
                   flush=True)
             if remaining() > args.fused_min_budget_s:
                 try:
-                    fused = measure(stepwise=False)
-                    if fused["value"] > 0:
+                    r = measure("fused")
+                    if 0 < r["value"] < _BEST["value"]:
                         # plain update (same four keys): no instant where the
                         # watchdog could observe an empty _BEST
-                        _BEST.update(fused)
+                        _BEST.update(r)
                 except Exception as e:
                     print(f"fused attempt failed ({type(e).__name__}: {e}); "
                           "keeping stepwise result", file=sys.stderr,

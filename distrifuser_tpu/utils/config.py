@@ -124,6 +124,13 @@ class DistriConfig:
     # faster with 1/n the activation HBM.  Disable to replicate the dense
     # decode instead.
     vae_sp: bool = True
+    # Hybrid loop (displaced patch only): sync warmup through the per-step
+    # programs + ONE fused stale-only scan.  Same numerics as the fully
+    # fused loop; the big program carries one UNet body instead of two, so
+    # its (remote) compile roughly halves — the resilient choice when the
+    # compile service is slow.  Per-step dispatch overhead applies only to
+    # the warmup steps.
+    hybrid_loop: bool = False
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)

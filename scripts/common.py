@@ -82,6 +82,10 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         choices=["bfloat16", "float32"],
                         help="model/computation dtype (default: bf16 on TPU, "
                         "fp32 on CPU)")
+    parser.add_argument("--hybrid_loop", action="store_true",
+                        help="multi-chip: per-step sync warmup + one fused "
+                        "stale-only scan — same numerics, roughly half the "
+                        "big program's (remote) compile")
     parser.add_argument("--num_images_per_prompt", type=int, default=1,
                         help="images per prompt (chunked through the "
                         "fixed-batch compiled loop)")
@@ -117,6 +121,7 @@ def config_from_args(args) -> DistriConfig:
         attn_impl=args.attn_impl,
         ulysses_degree=args.ulysses_degree,
         comm_batch=args.comm_batch,
+        hybrid_loop=args.hybrid_loop,
         vae_sp=not args.no_vae_sp,
         dtype=None if args.dtype is None else getattr(jnp, args.dtype),
     )
