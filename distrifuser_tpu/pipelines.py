@@ -327,6 +327,14 @@ class _DistriPipelineBase:
         strength: float = 0.8,
         denoising_start: float = None,
         denoising_end: float = None,
+        original_size=None,
+        crops_coords_top_left=(0, 0),
+        target_size=None,
+        aesthetic_score: float = 6.0,
+        negative_original_size=None,
+        negative_crops_coords_top_left=None,
+        negative_target_size=None,
+        negative_aesthetic_score: float = 2.5,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -423,8 +431,21 @@ class _DistriPipelineBase:
                                       jnp.float32)
             latents = self.scheduler.add_noise(init, noise, start_step)
 
+        # SDXL micro-conditioning pass-through (diffusers kwargs the
+        # reference forwards, pipelines.py:47-58); SD 1.x/2.x ignores it
+        micro_cond = {
+            "original_size": original_size,
+            "crops_coords_top_left": crops_coords_top_left,
+            "target_size": target_size,
+            "aesthetic_score": aesthetic_score,
+            "negative_original_size": negative_original_size,
+            "negative_crops_coords_top_left": negative_crops_coords_top_left,
+            "negative_target_size": negative_target_size,
+            "negative_aesthetic_score": negative_aesthetic_score,
+        }
+
         def run_chunk(cp, cn, cl):
-            embeds, added = self._encode(cp, cn)
+            embeds, added = self._encode(cp, cn, micro_cond)
             return self.runner.generate(
                 cl, embeds,
                 guidance_scale=guidance_scale,
@@ -463,7 +484,7 @@ class _DistriPipelineBase:
         _, cparams = self.text_encoders[which]
         return self._clip_jitted[which](cparams, np.asarray(ids))
 
-    def _encode(self, prompts, negs):
+    def _encode(self, prompts, negs, micro_cond=None):
         raise NotImplementedError
 
 
@@ -548,7 +569,7 @@ class DistriSDXLPipeline(_DistriPipelineBase):
             sched, toks, list(zip(text_configs, text_params)),
         )
 
-    def _encode(self, prompts, negs):
+    def _encode(self, prompts, negs, micro_cond=None):
         cfg = self.distri_config
         texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
         n_br = 2 if cfg.do_classifier_free_guidance else 1
@@ -579,12 +600,32 @@ class DistriSDXLPipeline(_DistriPipelineBase):
                 f"per-id={ucfg.addition_time_embed_dim}); only the SDXL-base "
                 "(6) and refiner-style (5) layouts are supported"
             )
-        if n_ids == 5:
-            ids = [cfg.height, cfg.width, 0, 0, 6.0]  # diffusers' default score
+        mc = micro_cond or {}
+        o_sz = mc.get("original_size") or (cfg.height, cfg.width)
+        crops = mc.get("crops_coords_top_left") or (0, 0)
+        t_sz = mc.get("target_size") or (cfg.height, cfg.width)
+
+        def _ids(size, crop, target, score):
+            if n_ids == 5:
+                return [size[0], size[1], crop[0], crop[1], score]
+            return [size[0], size[1], crop[0], crop[1], target[0], target[1]]
+
+        pos = _ids(o_sz, crops, t_sz, mc.get("aesthetic_score", 6.0))
+        if n_br == 2:
+            # the uncond branch takes the negative_* micro-conditioning
+            # (diffusers semantics: negative sizes default to the positive
+            # ones, but the refiner's negative_aesthetic_score defaults to
+            # 2.5 — the branches differ by default on that layout)
+            neg = _ids(
+                mc.get("negative_original_size") or o_sz,
+                mc.get("negative_crops_coords_top_left") or crops,
+                mc.get("negative_target_size") or t_sz,
+                mc.get("negative_aesthetic_score", 2.5),
+            )
+            time_ids = jnp.asarray([neg, pos], jnp.float32)[:, None]
         else:
-            ids = [cfg.height, cfg.width, 0, 0, cfg.height, cfg.width]
-        time_ids = jnp.asarray(ids, jnp.float32)
-        time_ids = jnp.tile(time_ids[None, None], (n_br, b, 1))
+            time_ids = jnp.asarray([pos], jnp.float32)[:, None]
+        time_ids = jnp.tile(time_ids, (1, b, 1))
         added = {"text_embeds": pooled, "time_ids": time_ids}
         return emb, added
 
@@ -657,7 +698,9 @@ class DistriSDPipeline(_DistriPipelineBase):
             sched, toks, list(zip(text_configs, text_params)),
         )
 
-    def _encode(self, prompts, negs):
+    def _encode(self, prompts, negs, micro_cond=None):
+        # SD 1.x/2.x has no micro-conditioning; the kwarg is accepted for
+        # the shared __call__ contract and ignored
         cfg = self.distri_config
         texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
         n_br = 2 if cfg.do_classifier_free_guidance else 1

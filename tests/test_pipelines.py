@@ -244,6 +244,72 @@ def test_img2img_low_strength_stays_closer_to_init(devices8):
     assert d[0.25] < d[1.0], d
 
 
+def test_sdxl_micro_conditioning_kwargs(devices8):
+    """original_size / crops / target_size flow into the SDXL time_ids
+    (diffusers kwargs the reference forwards): explicit defaults equal the
+    implicit ones bitwise; a different original_size changes the output."""
+    pipe, dcfg = build_sdxl_pipeline(devices8, 2)
+    kw = dict(num_inference_steps=2, output_type="latent", seed=5)
+    base = pipe("a fox", **kw).images[0]
+    explicit = pipe("a fox", original_size=(dcfg.height, dcfg.width),
+                    crops_coords_top_left=(0, 0),
+                    target_size=(dcfg.height, dcfg.width), **kw).images[0]
+    np.testing.assert_array_equal(base, explicit)
+    shifted = pipe("a fox", original_size=(4 * dcfg.height, 4 * dcfg.width),
+                   crops_coords_top_left=(64, 64), **kw).images[0]
+    assert np.abs(shifted - base).max() > 0
+    # negative_* reach ONLY the uncond branch: symmetric explicit values
+    # equal the default, an asymmetric negative size changes the output
+    sym = pipe("a fox", negative_original_size=(dcfg.height, dcfg.width),
+               **kw).images[0]
+    np.testing.assert_array_equal(base, sym)
+    asym = pipe("a fox", negative_original_size=(4 * dcfg.height,
+                                                 4 * dcfg.width),
+                **kw).images[0]
+    assert np.abs(asym - base).max() > 0
+
+
+def test_refiner_layout_aesthetic_score(devices8):
+    """5-id refiner-style UNet: aesthetic_score conditions the positive
+    branch, negative_aesthetic_score (diffusers default 2.5) the uncond
+    branch — so the branches differ by default and equalizing the scores
+    changes the output."""
+    import dataclasses
+
+    from distrifuser_tpu.models.clip import CLIPTextConfig, init_clip_params
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriSDXLPipeline
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.clip import tiny_clip_config
+
+    dcfg = DistriConfig(devices=devices8[:2], height=128, width=128,
+                        warmup_steps=1)
+    tc1 = tiny_clip_config(hidden=16)
+    tc2 = CLIPTextConfig(vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=32,
+                         projection_dim=32)
+    base_ucfg = tiny_config(cross_attention_dim=32, sdxl=True)
+    # pooled(32) + 5 * addition_time_embed_dim(8) = 72: the refiner layout
+    ucfg = dataclasses.replace(base_ucfg,
+                               projection_class_embeddings_input_dim=72)
+    pipe = DistriSDXLPipeline.from_params(
+        dcfg, ucfg, init_unet_params(jax.random.PRNGKey(0), ucfg),
+        tiny_vae_config(),
+        init_vae_params(jax.random.PRNGKey(1), tiny_vae_config()),
+        [tc1, tc2],
+        [init_clip_params(jax.random.PRNGKey(2), tc1),
+         init_clip_params(jax.random.PRNGKey(3), tc2)],
+    )
+    kw = dict(num_inference_steps=2, output_type="latent", seed=5)
+    default = pipe("a fox", **kw).images[0]  # scores 6.0 vs 2.5
+    equalized = pipe("a fox", negative_aesthetic_score=6.0, **kw).images[0]
+    assert np.abs(default - equalized).max() > 0
+    repeat = pipe("a fox", **kw).images[0]
+    np.testing.assert_array_equal(default, repeat)
+
+
 def test_denoising_split_equals_full_run(devices8):
     """Base+refiner split protocol: a run stopped at denoising_end plus a
     second run resumed at the same denoising_start must equal the
