@@ -50,6 +50,7 @@ from ..models.dit import DiTConfig
 from ..ops.attention import sdpa
 from ..schedulers import BaseScheduler
 from ..utils.config import (
+    CFG_AXIS,
     DP_AXIS,
     SP_AXIS,
     SP_R_AXIS,
@@ -330,38 +331,20 @@ class DiTDenoiseRunner:
         eps_full = all_gather_seq(eps_rows, self.seq_axes)
         return eps_full, kv_new
 
-    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
+    def _make_step(self, params, enc, cap_mask, gs, batch):
+        """Per-device step closure + the local branch count and dtype —
+        shared by the fused loop and the hybrid pair of programs."""
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
         my_enc, _, _ = branch_select(cfg, enc)
         my_mask, _, _ = branch_select(cfg, cap_mask)
         cap_bias = dit_mod.caption_mask_bias(my_mask)
-        batch = latents.shape[0]
         compute_dtype = params["proj_in"]["kernel"].dtype
-
-        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
         pos = dit_mod.pos_embed_table(dcfg, compute_dtype)
         cap_kv = dit_mod.precompute_caption_kv(params, dcfg, my_enc)
         ts = sched.timesteps()
         temb_all = jax.vmap(lambda t: dit_mod.t_embed(params, dcfg, t))(ts)
         c6_all = jax.vmap(lambda e: dit_mod.adaln_table(params, dcfg, e))(temb_all)
-
-        bloc = my_enc.shape[0]
-        sstate = sched.init_state(x.shape)
-        if cfg.attn_impl in ("ulysses", "usp"):
-            # exact and stateless: a minimal placeholder keeps the block
-            # scan's xs structure uniform
-            kv0 = jnp.zeros((dcfg.depth, 1), compute_dtype)
-        elif cfg.attn_impl == "ring":
-            chunk = dcfg.num_tokens // cfg.n_device_per_batch
-            kv0 = jnp.zeros(
-                (dcfg.depth, bloc, chunk, 2 * dcfg.hidden_size), compute_dtype
-            )
-        else:
-            kv0 = jnp.zeros(
-                (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
-                compute_dtype,
-            )
 
         def step(x, sstate, kv, s, phase_sync):
             eps, kv = self._eval_model(
@@ -371,6 +354,34 @@ class DiTDenoiseRunner:
             guided = combine_guidance(cfg, eps, gs, batch)
             x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
             return x, sstate, kv
+
+        return step, my_enc.shape[0], compute_dtype
+
+    def _kv0(self, bloc, compute_dtype):
+        cfg, dcfg = self.cfg, self.dcfg
+        if cfg.attn_impl in ("ulysses", "usp"):
+            # exact and stateless: a minimal placeholder keeps the block
+            # scan's xs structure uniform
+            return jnp.zeros((dcfg.depth, 1), compute_dtype)
+        if cfg.attn_impl == "ring":
+            chunk = dcfg.num_tokens // cfg.n_device_per_batch
+            return jnp.zeros(
+                (dcfg.depth, bloc, chunk, 2 * dcfg.hidden_size), compute_dtype
+            )
+        return jnp.zeros(
+            (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
+            compute_dtype,
+        )
+
+    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
+        cfg, dcfg = self.cfg, self.dcfg
+        batch = latents.shape[0]
+        step, bloc, compute_dtype = self._make_step(
+            params, enc, cap_mask, gs, batch
+        )
+        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+        sstate = self.scheduler.init_state(x.shape)
+        kv0 = self._kv0(bloc, compute_dtype)
 
         full_sync = cfg.mode == "full_sync" or not cfg.is_sp
         n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
@@ -410,6 +421,80 @@ class DiTDenoiseRunner:
             )(params, latents, enc, cap_mask, gs)
 
         return jax.jit(loop)
+
+    def _build_hybrid(self, num_steps: int):
+        """Two ONE-body programs instead of one two-body program
+        (cfg.hybrid_loop; the DiT analog of runner._build_stale_scan): the
+        sync warmup fori and the stale scan each carry a single transformer
+        body, roughly halving the big program's (remote) compile at
+        identical numerics.  The carry crosses the jit boundary: tokens and
+        scheduler state are replicated within a dp group (the CFG-combined
+        scheduler step makes them identical on every device of the group),
+        while the stale KV state varies per device and is laid out along
+        (dp, cfg, sp) on a fresh leading axis."""
+        cfg, dcfg = self.cfg, self.dcfg
+        self.scheduler.set_timesteps(num_steps)
+        n_sync = min(cfg.warmup_steps + 1, num_steps)
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+        seq = (self.seq_axes if isinstance(self.seq_axes, tuple)
+               else (self.seq_axes,))
+        kv_spec = P((DP_AXIS, CFG_AXIS) + seq)  # usp mesh has sp_u/sp_r
+        # scheduler-state leaves: x-shaped (batch-led, ndim>=3) shard over
+        # dp; scalars (DPM's lambda_prev/have_prev) replicate
+        ss_shapes = self.scheduler.init_state(
+            (1, dcfg.num_tokens, dcfg.token_dim)
+        )
+        ss_spec = jax.tree.map(
+            lambda l: P(DP_AXIS) if jnp.ndim(l) >= 3 else P(), ss_shapes
+        )
+
+        def device_sync(params, latents, enc, cap_mask, gs):
+            batch = latents.shape[0]
+            step, bloc, compute_dtype = self._make_step(
+                params, enc, cap_mask, gs, batch
+            )
+            x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+            sstate = self.scheduler.init_state(x.shape)
+
+            def sync_body(i, carry):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, True)
+
+            x, sstate, kv = lax.fori_loop(
+                0, n_sync, sync_body,
+                (x, sstate, self._kv0(bloc, compute_dtype)),
+            )
+            return x, sstate, kv[None]
+
+        def device_stale(params, x, sstate, kv, enc, cap_mask, gs):
+            batch = x.shape[0]
+            step, _, _ = self._make_step(params, enc, cap_mask, gs, batch)
+
+            def stale_body(carry, i):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, False), None
+
+            (x, _, _), _ = lax.scan(
+                stale_body, (x, sstate, kv[0]),
+                jnp.arange(n_sync, num_steps),
+            )
+            return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+        sync = jax.jit(lambda p, l, e, m, g: shard_map(
+            device_sync, mesh=self.mesh,
+            in_specs=(P(), lat_spec, enc_spec, enc_spec, P()),
+            out_specs=(lat_spec, ss_spec, kv_spec),
+            check_vma=False,
+        )(p, l, e, m, g))
+        stale = jax.jit(lambda p, x, ss, kv, e, m, g: shard_map(
+            device_stale, mesh=self.mesh,
+            in_specs=(P(), lat_spec, ss_spec, kv_spec, enc_spec, enc_spec,
+                      P()),
+            out_specs=lat_spec,
+            check_vma=False,
+        )(p, x, ss, kv, e, m, g), donate_argnums=(1, 2, 3))
+        return sync, stale
 
     def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
         """Per-device stale-state and per-step collective volumes (elements)
@@ -460,11 +545,25 @@ class DiTDenoiseRunner:
         [n_br, B, Lt] (1 = real caption token) masks padded text tokens out
         of cross-attention (PixArt semantics); None attends to all."""
         self.scheduler.set_timesteps(num_inference_steps)
-        if num_inference_steps not in self._compiled:
-            self._compiled[num_inference_steps] = self._build(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
         if cap_mask is None:
             cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
+        cap_mask = jnp.asarray(cap_mask, jnp.float32)
+        hybrid = (
+            self.cfg.hybrid_loop and self.cfg.is_sp
+            and self.cfg.mode != "full_sync"
+            and min(self.cfg.warmup_steps + 1, num_inference_steps)
+            < num_inference_steps
+        )
+        if hybrid:
+            key = ("hybrid", num_inference_steps)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_hybrid(num_inference_steps)
+            sync, stale = self._compiled[key]
+            x, sstate, kv = sync(self.params, latents, enc, cap_mask, gs)
+            return stale(self.params, x, sstate, kv, enc, cap_mask, gs)
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(num_inference_steps)
         return self._compiled[num_inference_steps](
-            self.params, latents, enc, jnp.asarray(cap_mask, jnp.float32), gs
+            self.params, latents, enc, cap_mask, gs
         )

@@ -618,7 +618,9 @@ class DistriSDXLPipeline(_DistriPipelineBase):
             # 2.5 — the branches differ by default on that layout)
             neg = _ids(
                 mc.get("negative_original_size") or o_sz,
-                mc.get("negative_crops_coords_top_left") or crops,
+                # diffusers defaults the uncond crops to (0, 0), NOT to the
+                # positive crops
+                mc.get("negative_crops_coords_top_left") or (0, 0),
                 mc.get("negative_target_size") or t_sz,
                 mc.get("negative_aesthetic_score", 2.5),
             )

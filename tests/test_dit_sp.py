@@ -356,3 +356,41 @@ def test_comm_report_layouts():
     cfg1 = sp_config(1, do_cfg=False)
     r1 = DiTDenoiseRunner(cfg1, dcfg, params, get_scheduler("ddim"))
     assert r1.comm_report()["per_step_collective_elems"] == 0
+
+
+@pytest.mark.parametrize("impl,sched", [
+    ("gather", "ddim"),
+    ("ring", "ddim"),
+    ("gather", "dpm-solver"),  # scheduler state crosses the hybrid boundary
+    ("usp", "ddim"),           # factored sp_u x sp_r mesh axes in kv_spec
+])
+def test_hybrid_matches_fused(impl, sched):
+    """cfg.hybrid_loop (two one-body programs, carry across the jit
+    boundary) must equal the fused two-body loop."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    kw = dict(attn_impl=impl, warmup_steps=1)
+    if impl == "usp":
+        kw["ulysses_degree"] = 2
+    fused = DiTDenoiseRunner(sp_config(4, do_cfg=True, **kw), dcfg, params,
+                             get_scheduler(sched))
+    hybrid = DiTDenoiseRunner(sp_config(4, do_cfg=True, hybrid_loop=True,
+                                        **kw), dcfg, params,
+                              get_scheduler(sched))
+    a = np.asarray(fused.generate(lat, enc, guidance_scale=4.0,
+                                  num_inference_steps=5))
+    b = np.asarray(hybrid.generate(lat, enc, guidance_scale=4.0,
+                                   num_inference_steps=5))
+    np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+def test_hybrid_all_sync_short_run():
+    """Runs where every step is sync take the plain fused path (the hybrid
+    gate requires a non-empty stale tail)."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    r = DiTDenoiseRunner(sp_config(4, do_cfg=True, hybrid_loop=True,
+                                   warmup_steps=4), dcfg, params,
+                         get_scheduler("ddim"))
+    out = r.generate(lat, enc, guidance_scale=4.0, num_inference_steps=2)
+    assert np.isfinite(np.asarray(out)).all()

@@ -267,6 +267,16 @@ def test_sdxl_micro_conditioning_kwargs(devices8):
                                                  4 * dcfg.width),
                 **kw).images[0]
     assert np.abs(asym - base).max() > 0
+    # the uncond crops default to (0, 0) — NOT to the positive crops
+    # (diffusers semantics)
+    crop = pipe("a fox", crops_coords_top_left=(32, 32), **kw).images[0]
+    crop_explicit = pipe("a fox", crops_coords_top_left=(32, 32),
+                         negative_crops_coords_top_left=(0, 0),
+                         **kw).images[0]
+    np.testing.assert_array_equal(crop, crop_explicit)
+    crop_sym = pipe("a fox", crops_coords_top_left=(32, 32),
+                    negative_crops_coords_top_left=(32, 32), **kw).images[0]
+    assert np.abs(crop_sym - crop).max() > 0
 
 
 def test_refiner_layout_aesthetic_score(devices8):
