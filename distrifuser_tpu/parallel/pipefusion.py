@@ -45,6 +45,7 @@ cross-step scalars stay correct while patches of adjacent steps interleave.
 
 from __future__ import annotations
 
+import types
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -59,7 +60,7 @@ from ..models.dit import DiTConfig
 from ..ops.linear import linear
 from .guidance import branch_select, combine_guidance
 from ..schedulers import BaseScheduler
-from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
+from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 
 
 def _tree_dynamic_index(tree, i):
@@ -149,7 +150,7 @@ class PipeFusionRunner:
                 f"{cfg.latent_height}, but DiTConfig.sample_size is "
                 f"{dcfg.sample_size} (square latents only for the DiT)"
             )
-        self._compiled: Dict[int, Any] = {}
+        self._compiled: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------
     # pieces
@@ -192,7 +193,10 @@ class PipeFusionRunner:
     # the device program
     # ------------------------------------------------------------------
 
-    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
+    def _tick_ctx(self, params, enc, cap_mask, gs, batch, num_steps, n_sync):
+        """Setup + the two tick closures, shared by the fused loop and the
+        hybrid pair of programs (everything here is carry-free: the ticks
+        are pure functions of their carry)."""
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
         n_stage = self.stages
@@ -200,6 +204,7 @@ class PipeFusionRunner:
         n_tok = dcfg.num_tokens
         chunk = n_tok // n_patch
         hid = dcfg.hidden_size
+        d_in = dcfg.token_dim
         d_out = dcfg.token_out_dim
         p_idx = lax.axis_index(SP_AXIS)
         is_first = p_idx == 0
@@ -208,11 +213,9 @@ class PipeFusionRunner:
         my_enc = self._branch_enc(enc)
         my_mask, _, _ = branch_select(cfg, cap_mask)
         cap_bias = dit_mod.caption_mask_bias(my_mask)
-        batch = latents.shape[0]
         bloc = my_enc.shape[0]  # batch inside the pipeline (2B when folded)
 
         compute_dtype = params["proj_in"]["kernel"].dtype
-        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))  # [B, N, D_in]
         pos = dit_mod.pos_embed_table(dcfg, compute_dtype)
 
         blocks_local = params["blocks"]  # leaves [Lp, ...] (sharded over sp)
@@ -225,19 +228,10 @@ class PipeFusionRunner:
         temb_all = jax.vmap(lambda t: dit_mod.t_embed(params, dcfg, t))(ts)  # [T, hid]
         c6_all = jax.vmap(lambda e: dit_mod.adaln_table(params, dcfg, e))(temb_all)
 
-        l_per = dcfg.depth // n_stage
-        kv_cache = jnp.zeros((l_per, 2, bloc, n_tok, hid), compute_dtype)
-
-        # scheduler state stacked per patch (DPM's scalars must advance with
-        # each patch's own step sequence while steps interleave in flight)
-        sstate = jax.vmap(
-            lambda _: sched.init_state((batch, chunk, x.shape[-1]))
-        )(jnp.arange(n_patch))
-
         def embed_chunk(x_full, m, s):
             """Patch m of the latent, scaled + embedded for step s."""
             rows = lax.dynamic_slice(
-                x_full, (0, m * chunk, 0), (batch, chunk, x.shape[-1])
+                x_full, (0, m * chunk, 0), (batch, chunk, d_in)
             )
             rows = sched.scale_model_input(rows, s)
             tok = rows.astype(compute_dtype)
@@ -249,7 +243,7 @@ class PipeFusionRunner:
         def sched_patch(x_full, sstate, eps_guided, m, s, pred):
             """Scheduler-step patch m's rows with its stacked state slice."""
             rows = lax.dynamic_slice(
-                x_full, (0, m * chunk, 0), (batch, chunk, x.shape[-1])
+                x_full, (0, m * chunk, 0), (batch, chunk, d_in)
             )
             st = _tree_dynamic_index(sstate, m)
             new_rows, new_st = sched.step(rows, eps_guided.astype(jnp.float32), s, st)
@@ -259,14 +253,6 @@ class PipeFusionRunner:
             x_full = jnp.where(pred, x_new, x_full)
             sstate = _tree_dynamic_update(sstate, new_st, m, pred)
             return x_full, sstate
-
-        # full_sync runs every step as the exact mega-patch (mirroring
-        # dit_sp.py): the displaced schedule below never engages
-        n_sync = (
-            num_steps
-            if cfg.mode == "full_sync"
-            else min(cfg.warmup_steps + 1, num_steps)
-        )
 
         # ---------------- phase 1: synchronous mega-patch warmup ----------
         def warmup_tick(carry, tau):
@@ -323,16 +309,6 @@ class PipeFusionRunner:
             )
             return (x_full, sstate, kv_cache, ring), None
 
-        ring0 = jnp.zeros((bloc, n_tok, hid), compute_dtype)
-        carry = (x, sstate, kv_cache, ring0)
-        n_warm_ticks = n_sync * n_stage + 1
-        carry, _ = lax.scan(warmup_tick, carry, jnp.arange(n_warm_ticks))
-        x, sstate, kv_cache, _ = carry
-
-        if n_sync >= num_steps:
-            x_full = lax.psum(jnp.where(is_first, x, 0.0), SP_AXIS)
-            return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
-
         # ---------------- phase 2: displaced patch streaming --------------
         n_items = (num_steps - n_sync) * n_patch
 
@@ -382,14 +358,60 @@ class PipeFusionRunner:
             )
             return (x_full, sstate, kv_cache, ring), None
 
-        ring0 = jnp.zeros((bloc, chunk, hid), compute_dtype)
+        return types.SimpleNamespace(
+            warmup_tick=warmup_tick, steady_tick=steady_tick,
+            n_items=n_items, n_stage=n_stage, is_first=is_first, bloc=bloc,
+            chunk=chunk, hid=hid, compute_dtype=compute_dtype,
+            l_per=dcfg.depth // n_stage, n_tok=n_tok,
+        )
+
+    def _init_carry(self, ctx, latents):
+        """(x tokens, per-patch scheduler state, stale KV cache)."""
+        dcfg, sched = self.dcfg, self.scheduler
+        batch = latents.shape[0]
+        x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+        # scheduler state stacked per patch (DPM's scalars must advance with
+        # each patch's own step sequence while steps interleave in flight)
+        sstate = jax.vmap(
+            lambda _: sched.init_state((batch, ctx.chunk, dcfg.token_dim))
+        )(jnp.arange(self.patches))
+        kv_cache = jnp.zeros(
+            (ctx.l_per, 2, ctx.bloc, ctx.n_tok, ctx.hid), ctx.compute_dtype
+        )
+        return x, sstate, kv_cache
+
+    def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
+        cfg, dcfg = self.cfg, self.dcfg
+        batch = latents.shape[0]
+        # full_sync runs every step as the exact mega-patch (mirroring
+        # dit_sp.py): the displaced schedule never engages
+        n_sync = (
+            num_steps
+            if cfg.mode == "full_sync"
+            else min(cfg.warmup_steps + 1, num_steps)
+        )
+        ctx = self._tick_ctx(params, enc, cap_mask, gs, batch, num_steps,
+                             n_sync)
+        x, sstate, kv_cache = self._init_carry(ctx, latents)
+
+        ring0 = jnp.zeros((ctx.bloc, ctx.n_tok, ctx.hid), ctx.compute_dtype)
+        carry = (x, sstate, kv_cache, ring0)
+        n_warm_ticks = n_sync * ctx.n_stage + 1
+        carry, _ = lax.scan(ctx.warmup_tick, carry, jnp.arange(n_warm_ticks))
+        x, sstate, kv_cache, _ = carry
+
+        if n_sync >= num_steps:
+            x_full = lax.psum(jnp.where(ctx.is_first, x, 0.0), SP_AXIS)
+            return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
+
+        ring0 = jnp.zeros((ctx.bloc, ctx.chunk, ctx.hid), ctx.compute_dtype)
         carry = (x, sstate, kv_cache, ring0)
         carry, _ = lax.scan(
-            steady_tick, carry, jnp.arange(n_items + n_stage)
+            ctx.steady_tick, carry, jnp.arange(ctx.n_items + ctx.n_stage)
         )
         x, _, _, _ = carry
 
-        x_full = lax.psum(jnp.where(is_first, x, 0.0), SP_AXIS)
+        x_full = lax.psum(jnp.where(ctx.is_first, x, 0.0), SP_AXIS)
         return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
 
     # ------------------------------------------------------------------
@@ -436,18 +458,21 @@ class PipeFusionRunner:
     # public API
     # ------------------------------------------------------------------
 
-    def _build(self, num_steps: int):
-        cfg = self.cfg
-        self.scheduler.set_timesteps(num_steps)
-        device_loop = partial(self._device_loop, num_steps=num_steps)
-
+    def _specs(self):
+        """(param_specs, lat_spec, enc_spec) shared by both builders."""
         block_specs = jax.tree.map(lambda _: P(SP_AXIS), self.params["blocks"])
         param_specs = {
             k: (block_specs if k == "blocks" else jax.tree.map(lambda _: P(), v))
             for k, v in self.params.items()
         }
-        lat_spec = P(DP_AXIS)
-        enc_spec = P(None, DP_AXIS)
+        return param_specs, P(DP_AXIS), P(None, DP_AXIS)
+
+    def _build(self, num_steps: int):
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps)
+
+        param_specs, lat_spec, enc_spec = self._specs()
 
         def loop(params, latents, enc, cap_mask, gs):
             return shard_map(
@@ -460,6 +485,69 @@ class PipeFusionRunner:
 
         return jax.jit(loop)
 
+    def _build_hybrid(self, num_steps: int):
+        """Warmup and steady phases as two ONE-body programs
+        (cfg.hybrid_loop; same lever as dit_sp._build_hybrid): each program
+        traces the stage stack once instead of twice, roughly halving the
+        big program's (remote) compile.  The inter-phase carry — tokens,
+        per-patch scheduler state, stale KV cache — is per-device state; it
+        crosses the jit boundary with a fresh leading axis laid out over
+        (dp, cfg, sp).  The ring buffer does NOT cross: the steady phase
+        starts from a zero ring exactly as the fused loop does."""
+        cfg, dcfg = self.cfg, self.dcfg
+        self.scheduler.set_timesteps(num_steps)
+        n_sync = min(cfg.warmup_steps + 1, num_steps)
+
+        param_specs, lat_spec, enc_spec = self._specs()
+        state_spec = P((DP_AXIS, CFG_AXIS, SP_AXIS))  # prefix for any pytree
+
+        def device_warm(params, latents, enc, cap_mask, gs):
+            batch = latents.shape[0]
+            ctx = self._tick_ctx(params, enc, cap_mask, gs, batch, num_steps,
+                                 n_sync)
+            x, sstate, kv_cache = self._init_carry(ctx, latents)
+            ring0 = jnp.zeros((ctx.bloc, ctx.n_tok, ctx.hid),
+                              ctx.compute_dtype)
+            carry, _ = lax.scan(
+                ctx.warmup_tick, (x, sstate, kv_cache, ring0),
+                jnp.arange(n_sync * ctx.n_stage + 1),
+            )
+            x, sstate, kv_cache, _ = carry
+            add_dev = lambda t: jax.tree.map(lambda l: l[None], t)  # noqa: E731
+            return add_dev(x), add_dev(sstate), add_dev(kv_cache)
+
+        def device_steady(params, x, sstate, kv_cache, enc, cap_mask, gs):
+            x, sstate, kv_cache = jax.tree.map(
+                lambda l: l[0], (x, sstate, kv_cache)
+            )
+            batch = x.shape[0]
+            ctx = self._tick_ctx(params, enc, cap_mask, gs, batch, num_steps,
+                                 n_sync)
+            ring0 = jnp.zeros((ctx.bloc, ctx.chunk, ctx.hid),
+                              ctx.compute_dtype)
+            carry, _ = lax.scan(
+                ctx.steady_tick, (x, sstate, kv_cache, ring0),
+                jnp.arange(ctx.n_items + ctx.n_stage),
+            )
+            x = carry[0]
+            x_full = lax.psum(jnp.where(ctx.is_first, x, 0.0), SP_AXIS)
+            return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
+
+        warm = jax.jit(lambda p, l, e, m, g: shard_map(
+            device_warm, mesh=cfg.mesh,
+            in_specs=(param_specs, lat_spec, enc_spec, enc_spec, P()),
+            out_specs=(state_spec, state_spec, state_spec),
+            check_vma=False,
+        )(p, l, e, m, g))
+        steady = jax.jit(lambda p, x, ss, kv, e, m, g: shard_map(
+            device_steady, mesh=cfg.mesh,
+            in_specs=(param_specs, state_spec, state_spec, state_spec,
+                      enc_spec, enc_spec, P()),
+            out_specs=lat_spec,
+            check_vma=False,
+        )(p, x, ss, kv, e, m, g), donate_argnums=(1, 2, 3))
+        return warm, steady
+
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
                  cap_mask=None):
         """latents [B, H/8, W/8, C] fp32, enc [2, B, Lt, caption_dim]
@@ -471,11 +559,25 @@ class PipeFusionRunner:
         # re-trace later and must not read tables left by a different step
         # count (see DenoiseRunner.generate).
         self.scheduler.set_timesteps(num_inference_steps)
-        if num_inference_steps not in self._compiled:
-            self._compiled[num_inference_steps] = self._build(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
         if cap_mask is None:
             cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
+        cap_mask = jnp.asarray(cap_mask, jnp.float32)
+        hybrid = (
+            self.cfg.hybrid_loop and self.cfg.mode != "full_sync"
+            and self.stages > 1
+            and min(self.cfg.warmup_steps + 1, num_inference_steps)
+            < num_inference_steps
+        )
+        if hybrid:
+            key = ("hybrid", num_inference_steps)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_hybrid(num_inference_steps)
+            warm, steady = self._compiled[key]
+            x, sstate, kv = warm(self.params, latents, enc, cap_mask, gs)
+            return steady(self.params, x, sstate, kv, enc, cap_mask, gs)
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(num_inference_steps)
         return self._compiled[num_inference_steps](
-            self.params, latents, enc, jnp.asarray(cap_mask, jnp.float32), gs
+            self.params, latents, enc, cap_mask, gs
         )

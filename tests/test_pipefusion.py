@@ -353,3 +353,25 @@ def test_inapplicable_knobs_rejected():
     with pytest.raises(ValueError, match="use_cuda_graph"):
         PipeFusionRunner(pipe_config(4, do_cfg=False, use_cuda_graph=False),
                          dcfg, params, get_scheduler("ddim"))
+
+
+@pytest.mark.parametrize("sched", ["ddim", "dpm-solver"])
+def test_hybrid_matches_fused(sched):
+    """cfg.hybrid_loop (warmup + steady phases as two one-body programs,
+    carry across the jit boundary) must equal the fused loop — incl. the
+    per-patch DPM scheduler state crossing the boundary."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    from distrifuser_tpu.parallel.pipefusion import PipeFusionRunner
+    from distrifuser_tpu.utils.config import DistriConfig as _DC
+
+    def build(**kw):
+        cfg = _DC(devices=jax.devices()[:4], height=128, width=128,
+                  warmup_steps=1, **kw)
+        return PipeFusionRunner(cfg, dcfg, params, get_scheduler(sched))
+
+    a = np.asarray(build().generate(lat, enc, guidance_scale=4.0,
+                                    num_inference_steps=5))
+    b = np.asarray(build(hybrid_loop=True).generate(
+        lat, enc, guidance_scale=4.0, num_inference_steps=5))
+    np.testing.assert_allclose(a, b, atol=2e-4)
