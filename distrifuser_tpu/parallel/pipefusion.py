@@ -563,17 +563,8 @@ class PipeFusionRunner:
         if cap_mask is None:
             cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
         cap_mask = jnp.asarray(cap_mask, jnp.float32)
-        hybrid = (
-            self.cfg.hybrid_loop and self.cfg.mode != "full_sync"
-            and self.stages > 1
-            and min(self.cfg.warmup_steps + 1, num_inference_steps)
-            < num_inference_steps
-        )
-        if hybrid:
-            key = ("hybrid", num_inference_steps)
-            if key not in self._compiled:
-                self._compiled[key] = self._build_hybrid(num_inference_steps)
-            warm, steady = self._compiled[key]
+        if self._hybrid_dispatch(num_inference_steps):
+            warm, steady = self._ensure_hybrid(num_inference_steps)
             x, sstate, kv = warm(self.params, latents, enc, cap_mask, gs)
             return steady(self.params, x, sstate, kv, enc, cap_mask, gs)
         if num_inference_steps not in self._compiled:
@@ -581,3 +572,24 @@ class PipeFusionRunner:
         return self._compiled[num_inference_steps](
             self.params, latents, enc, cap_mask, gs
         )
+
+    def _hybrid_dispatch(self, num_steps: int) -> bool:
+        cfg = self.cfg
+        return (cfg.hybrid_loop and cfg.mode != "full_sync"
+                and self.stages > 1
+                and min(cfg.warmup_steps + 1, num_steps) < num_steps)
+
+    def _ensure_hybrid(self, num_steps: int):
+        key = ("hybrid", num_steps)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_hybrid(num_steps)
+        return self._compiled[key]
+
+    def prepare(self, num_steps: int) -> None:
+        """Pre-build exactly the program(s) generate() will dispatch to."""
+        self.scheduler.set_timesteps(num_steps)
+        if self._hybrid_dispatch(num_steps):
+            self._ensure_hybrid(num_steps)
+            return
+        if num_steps not in self._compiled:
+            self._compiled[num_steps] = self._build(num_steps)

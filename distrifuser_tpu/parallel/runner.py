@@ -380,6 +380,31 @@ class DenoiseRunner:
         # x and the incoming state die at this call; let XLA reuse the HBM
         return jax.jit(loop, donate_argnums=(1, 2))
 
+    def _hybrid_dispatch(self) -> bool:
+        cfg = self.cfg
+        return (cfg.hybrid_loop and cfg.parallelism == "patch"
+                and cfg.mode != "full_sync" and cfg.is_sp)
+
+    def _ensure_stale_scan(self, num_steps: int, n_sync: int):
+        skey = ("stale_scan", num_steps, n_sync)
+        if skey not in self._compiled:
+            self._compiled[skey] = self._build_stale_scan(num_steps, n_sync)
+        return self._compiled[skey]
+
+    def prepare(self, num_steps: int) -> None:
+        """Pre-build exactly the program(s) generate() will dispatch to
+        (pipelines.prepare delegates here).  Per-step programs build
+        lazily; hybrid mode pre-builds the big stale-scan program."""
+        if not self.cfg.use_compiled_step:
+            return
+        if self._hybrid_dispatch():
+            n_sync = min(self.cfg.warmup_steps + 1, num_steps)
+            if n_sync < num_steps:
+                self._ensure_stale_scan(num_steps, n_sync)
+            return
+        if num_steps not in self._compiled:
+            self._compiled[num_steps] = self._build(num_steps)
+
     def _generate_hybrid(self, latents, enc, added, gs, num_steps):
         """Sync warmup via per-step programs + one fused stale-only scan."""
         cfg = self.cfg
@@ -399,10 +424,7 @@ class DenoiseRunner:
             )
         if n_sync >= num_steps:
             return x
-        skey = ("stale_scan", num_steps, n_sync)
-        if skey not in self._compiled:
-            self._compiled[skey] = self._build_stale_scan(num_steps, n_sync)
-        return self._compiled[skey](
+        return self._ensure_stale_scan(num_steps, n_sync)(
             self.params, x, pstate, sstate, enc, added, gs
         )
 
@@ -672,9 +694,7 @@ class DenoiseRunner:
                 start_step,
                 end_step,
             )
-        if (getattr(self.cfg, "hybrid_loop", False)
-                and self.cfg.parallelism == "patch"
-                and self.cfg.mode != "full_sync" and self.cfg.is_sp
+        if (self._hybrid_dispatch()
                 and start_step == 0 and end_step is None):
             return self._generate_hybrid(
                 jnp.asarray(latents), jnp.asarray(prompt_embeds), added,

@@ -303,15 +303,13 @@ class _DistriPipelineBase:
         pass
 
     def prepare(self, num_inference_steps: int = 50, **kwargs) -> None:
-        """AOT-compile the denoise loop (the reference's record/capture phase,
-        pipelines.py:60-165).  In per-step mode (use_cuda_graph=False) steps
-        compile lazily on first use, like the reference's no-graph path."""
-        if not self.distri_config.use_compiled_step:
-            return
-        if num_inference_steps not in self.runner._compiled:
-            self.runner._compiled[num_inference_steps] = self.runner._build(
-                num_inference_steps
-            )
+        """Pre-build the denoise loop program(s) (the reference's
+        record/capture phase, pipelines.py:60-165).  Delegates to the
+        runner so the prepared program is exactly the one generate() will
+        dispatch to (fused, or the hybrid stale-scan).  In per-step mode
+        (use_cuda_graph=False) steps compile lazily on first use, like the
+        reference's no-graph path."""
+        self.runner.prepare(num_inference_steps)
 
     def __call__(
         self,
@@ -845,11 +843,7 @@ class DistriPixArtPipeline:
         pass
 
     def prepare(self, num_inference_steps: int = 20, **kwargs) -> None:
-        if num_inference_steps not in self.runner._compiled:
-            self.scheduler.set_timesteps(num_inference_steps)
-            self.runner._compiled[num_inference_steps] = self.runner._build(
-                num_inference_steps
-            )
+        self.runner.prepare(num_inference_steps)
 
     def _encode(self, prompts, negs):
         cfg = self.distri_config
