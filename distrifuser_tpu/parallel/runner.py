@@ -484,10 +484,13 @@ class DenoiseRunner:
         return jax.jit(stepper, donate_argnums=donate)
 
     def _generate_stepwise(self, latents, enc, added, gs, num_steps,
-                           start_step=0, end_step=None):
+                           start_step=0, end_step=None, callback=None):
         """Python loop over per-step compiled calls (reference no-CUDA-graph
         path, distri_sdxl_unet_pp.py:117-193): same numerics as the fused
-        loop, per-step latency visible from the host."""
+        loop, per-step latency visible from the host.
+        ``callback(step_index, timestep, latents)`` fires after each step —
+        the diffusers legacy-callback signature; only this mode has a host
+        loop to fire it from."""
         num_exec_end = num_steps if end_step is None else end_step
         cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
@@ -516,6 +519,8 @@ class DenoiseRunner:
             x, pstate, sstate = fns[fkey](
                 self.params, jnp.asarray(i), x, pstate, sstate, enc, added, gs
             )
+            if callback is not None:
+                callback(i, self.scheduler.timesteps()[i], x)
         return x
 
     # ------------------------------------------------------------------
@@ -647,6 +652,7 @@ class DenoiseRunner:
         added_cond: Optional[Dict[str, Any]] = None,
         start_step: int = 0,
         end_step: Optional[int] = None,
+        callback=None,
     ):
         """Run the denoising loop.
 
@@ -684,6 +690,11 @@ class DenoiseRunner:
                                                        num_inference_steps)
         assert end_step is None or start_step < end_step <= num_inference_steps, (
             start_step, end_step, num_inference_steps)
+        if callback is not None and self.cfg.use_compiled_step:
+            raise ValueError(
+                "per-step callbacks need the host loop: build the config "
+                "with use_cuda_graph=False (reference no-CUDA-graph path)"
+            )
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),
@@ -693,6 +704,7 @@ class DenoiseRunner:
                 num_inference_steps,
                 start_step,
                 end_step,
+                callback,
             )
         if (self._hybrid_dispatch()
                 and start_step == 0 and end_step is None):

@@ -235,7 +235,7 @@ def _batched_generate(cfg, scheduler, prompts, negs, num_images_per_prompt,
             cp = cp + [cp[-1]] * pad
             cn = cn + [cn[-1]] * pad
             cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
-        out = run_chunk(cp, cn, cl)
+        out = run_chunk(cp, cn, cl, bs - pad)
         outs.append(out[:bs - pad] if pad else out)
     return jnp.concatenate(outs, axis=0)
 
@@ -333,6 +333,7 @@ class _DistriPipelineBase:
         negative_crops_coords_top_left=None,
         negative_target_size=None,
         negative_aesthetic_score: float = 2.5,
+        callback=None,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -343,6 +344,12 @@ class _DistriPipelineBase:
             )
         if not cfg.do_classifier_free_guidance:
             guidance_scale = 1.0
+        if callback is not None and cfg.use_compiled_step:
+            # fail before any encode/VAE work, not inside the first chunk
+            raise ValueError(
+                "per-step callbacks need the host loop: build the config "
+                "with use_cuda_graph=False (reference no-CUDA-graph path)"
+            )
         prompts = [prompt] if isinstance(prompt, str) else list(prompt)
         negs = (
             [negative_prompt] * len(prompts)
@@ -442,8 +449,14 @@ class _DistriPipelineBase:
             "negative_aesthetic_score": negative_aesthetic_score,
         }
 
-        def run_chunk(cp, cn, cl):
+        def run_chunk(cp, cn, cl, n_real):
             embeds, added = self._encode(cp, cn, micro_cond)
+            # diffusers legacy signature callback(step, timestep, latents);
+            # padded tail rows are stripped before the user sees them.
+            # With more images than batch_size the callback fires per chunk
+            # (step indices restart per chunk).
+            cb = (None if callback is None
+                  else (lambda i, t, x: callback(i, t, x[:n_real])))
             return self.runner.generate(
                 cl, embeds,
                 guidance_scale=guidance_scale,
@@ -451,6 +464,7 @@ class _DistriPipelineBase:
                 added_cond=added,
                 start_step=start_step,
                 end_step=end_step,
+                callback=cb,
             )
 
         # seeded noise for the whole expanded batch (diffusers passes a torch
@@ -929,7 +943,7 @@ class DistriPixArtPipeline:
         )
         self.scheduler.set_timesteps(num_inference_steps)
 
-        def run_chunk(cp, cn, cl):
+        def run_chunk(cp, cn, cl, _n_real):
             emb, mask = self._encode(cp, cn)
             return self.runner.generate(
                 cl, emb, guidance_scale=guidance_scale,

@@ -85,6 +85,25 @@ def test_start_step_stepwise_matches_fused(devices8):
         fused.generate(lat, enc, num_inference_steps=4, start_step=4)
 
 
+def test_stepwise_callback(devices8):
+    """callback(step, timestep, latents) — the diffusers legacy signature —
+    fires once per executed step; the fused loop rejects it loudly."""
+    stepw, cfg, ucfg = build(devices8, 2, use_cuda_graph=False)
+    lat, enc = inputs(cfg, ucfg)
+    seen = []
+    out = stepw.generate(
+        lat, enc, num_inference_steps=4,
+        callback=lambda i, t, x: seen.append((i, int(t), x.shape)))
+    assert [i for i, _, _ in seen] == [0, 1, 2, 3]
+    ts = [t for _, t, _ in seen]
+    assert ts == sorted(ts, reverse=True) and ts[-1] >= 0  # descending sched
+    assert all(s == np.asarray(out).shape for _, _, s in seen)
+    fused, cfg2, _ = build(devices8, 2, use_cuda_graph=True)
+    with pytest.raises(ValueError, match="use_cuda_graph=False"):
+        fused.generate(lat, enc, num_inference_steps=2,
+                       callback=lambda i, t, x: None)
+
+
 def test_hybrid_matches_fused(devices8):
     """Hybrid loop (per-step sync warmup + fused stale-only scan) must equal
     the fully fused loop — it is the compile-time-resilient execution of the
