@@ -374,7 +374,8 @@ def main():
             # single-chip fused program carries ONE UNet body (the is_sp
             # one-phase collapse in runner._device_loop), so there is no
             # separate hybrid rung here — hybrid pays off multi-chip, where
-            # --mode hybrid selects it explicitly.
+            # the scripts' --hybrid_loop flag (DistriConfig.hybrid_loop)
+            # selects it; bench.py's --mode only covers auto/fused/stepwise.
             _BEST.update(measure("stepwise"))
             print(f"stepwise result recorded: {_BEST} "
                   f"({remaining():.0f}s budget left)", file=sys.stderr,

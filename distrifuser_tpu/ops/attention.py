@@ -67,6 +67,21 @@ def _upstream_flash_available() -> bool:
     return _UPSTREAM_PROBE_OK
 
 
+def _largest_dividing_tile(preferred: int, length: int):
+    """Largest power-of-2 tile <= ``preferred`` that divides ``length``.
+
+    Walks down from the power-of-2 floor of min(preferred, length) by
+    halving; returns None below 128 (the TPU lane minimum) — callers treat
+    that as "no usable tile".
+    """
+    tile = 1 << (min(preferred, length).bit_length() - 1)
+    while tile >= 128:
+        if length % tile == 0:
+            return tile
+        tile //= 2
+    return None
+
+
 def _resolve_route(q, k, heads: int) -> Route:
     """Pick the SDPA backend for this shape.
 
@@ -75,6 +90,13 @@ def _resolve_route(q, k, heads: int) -> Route:
     off-TPU is for tests only; _IMPL/_BQ/_BK select kernel and tiles), then
     the checked-in measured table, then the analytic default (flash for
     long block-aligned sequences on TPU).
+
+    NOTE: env overrides are read at TRACE time. jit caches do not key on
+    os.environ, so changing DISTRIFUSER_TPU_FLASH* after a program has
+    been traced silently keeps the old route; call
+    ``jax.clear_caches()`` (or build a fresh runner/pipeline) after
+    changing them.  The overrides are a research escape hatch — the
+    supported configuration surface is DistriConfig + the measured table.
     """
     b, lq, c = q.shape
     lk = k.shape[1]
@@ -170,12 +192,20 @@ def sdpa(q, k, v, *, heads: int):
         if route.impl == "upstream" and not interpret and (
             explicit == "upstream" or _upstream_flash_available()
         ):
-            # tiles generalize across the log2 bucket; drop any that do not
-            # divide THIS call's lengths (the kernel would assert at trace)
-            ubq = (route.block_q
-                   if route.block_q and lq % route.block_q == 0 else None)
-            ubk = (route.block_k
-                   if route.block_k and lk % route.block_k == 0 else None)
+            # tiles generalize across the log2 bucket but may not divide
+            # THIS call's lengths (the kernel would assert at trace).  A
+            # non-dividing tile cannot simply be dropped: the kernel fills
+            # a lone None with its hardcoded 512/1024 defaults, which may
+            # themselves not divide (e.g. Lk=57600 % 1024 != 0) — so fit
+            # each tile down to the largest power-of-2 divisor, and if
+            # either cannot be fitted pass NO tiles (full upstream
+            # per-generation defaults) rather than a mixed pair.
+            ubq, ubk = route.block_q, route.block_k
+            if ubq or ubk:
+                ubq = _largest_dividing_tile(ubq or 512, lq)
+                ubk = _largest_dividing_tile(ubk or 1024, lk)
+                if ubq is None or ubk is None:
+                    ubq = ubk = None
             try:
                 return upstream_flash_sdpa(q, k, v, heads=heads,
                                            block_q=ubq, block_k=ubk)

@@ -231,3 +231,18 @@ def test_updater_accepts_bench_attention_lines(tmp_path):
     assert len(attn) == 1 and not tune
     routes = upd.build_routes(attn, tune)
     assert routes[(64, 12)][0] == "inrepo"  # failed upstream excluded
+
+
+def test_largest_dividing_tile():
+    """Tile fitting for the upstream kernel (ADVICE r4): a tuned tile that
+    does not divide the call's length is halved to the largest power-of-2
+    divisor instead of being dropped (which would mix in the kernel's
+    hardcoded 512/1024 defaults — themselves non-dividing for shapes like
+    Lk=57600)."""
+    fit = attention._largest_dividing_tile
+    assert fit(512, 4096) == 512          # already divides
+    assert fit(1024, 57600) == 256        # 1024, 512 fail; 256 divides
+    assert fit(512, 57600) == 256
+    assert fit(1024, 77) is None          # below the 128 lane minimum
+    assert fit(128, 384) == 128
+    assert fit(1024, 1000) is None        # no pow2 >=128 divides 1000
