@@ -116,6 +116,10 @@ class DenoiseRunner:
             )
         _check_geometry(distri_config, unet_config)
         self._compiled: Dict[int, Any] = {}
+        # fused-mode per-step callback target (_build_fused_callback): the
+        # compiled program's io_callback reads this indirection so one
+        # program serves any callback object
+        self._active_callback = None
 
     # ------------------------------------------------------------------
     # per-device pieces (run inside shard_map)
@@ -432,12 +436,14 @@ class DenoiseRunner:
     # per-step (uncompiled-loop) mode: the reference's --no_cuda_graph
     # ------------------------------------------------------------------
 
-    def _build_stepwise(self, phase, with_state: bool):
-        """One jitted denoising step driven from Python.
+    def _make_stepper(self, phase, with_state: bool):
+        """Un-jitted shard_map'd single step with the global-array signature.
 
         The patch state crosses the shard_map boundary here, so its leaves are
         laid out along ("cfg","sp") on axis 0: stale activations vary across
         CFG branches and (for the ring layout) across patch peers.
+        Returns (stepper, donate_argnums): _build_stepwise jits it directly;
+        _build_fused_callback embeds it in a compiled scan.
         """
         cfg = self.cfg
         # Patch-parallel state varies across CFG branches and (ring layout)
@@ -481,7 +487,96 @@ class DenoiseRunner:
         # (gather-layout state is O(L) per layer — the dominant allocation at
         # high resolution).  The fused loop gets this for free from the scan.
         donate = (3,) if with_state and cfg.parallelism == "patch" else ()
+        return stepper, donate
+
+    def _build_stepwise(self, phase, with_state: bool):
+        """One jitted denoising step driven from Python."""
+        stepper, donate = self._make_stepper(phase, with_state)
         return jax.jit(stepper, donate_argnums=donate)
+
+    def _stepwise_state_seed(self):
+        """Initial patch-state value for a host-driven loop — mirrors what
+        each parallelism mode expects before its first step."""
+        cfg = self.cfg
+        if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate":
+            return {"step": jnp.asarray(0)}
+        return {} if cfg.parallelism != "patch" else None
+
+    def _fire_callback(self, i, t, x):
+        """Host-side trampoline for the fused-mode per-step callback
+        (io_callback target).  Reads the active callback from the instance
+        so one compiled program serves any callback object."""
+        cb = self._active_callback
+        if cb is not None:
+            cb(int(i), t, x)
+
+    def _build_fused_callback(self, num_steps: int, start_step: int = 0,
+                              end_step: int = None):
+        """Fused loop variant that fires per-step host callbacks.
+
+        The reference gets diffusers' legacy callback for free in ALL modes
+        because even its CUDA-graph path keeps the step loop in Python
+        (pipelines.py:47-58 delegation to diffusers __call__).  Our fused
+        mode has no host loop, so the callback rides
+        ``jax.experimental.io_callback(ordered=True)`` inside the compiled
+        program: the scan body is the shard_map'd stepwise step (stepwise
+        state layout crossing the shard_map boundary each step), and after
+        each step the GLOBAL latents ship to the host and reach
+        ``self._active_callback``.  Both segments use ``lax.scan`` — ordered
+        effects are unsupported in ``while_loop``/``fori_loop`` bodies.
+
+        Built only when a callback is actually passed: the callback-free
+        fused program keeps its in-device carry and never syncs the host.
+        """
+        from jax.experimental import io_callback
+
+        cfg = self.cfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        num_exec_end = num_steps if end_step is None else end_step
+        one_phase = (cfg.parallelism != "patch" or cfg.mode == "full_sync"
+                     or not cfg.is_sp)
+        n_sync = (num_exec_end - start_step if one_phase
+                  else min(cfg.warmup_steps + 1, num_exec_end - start_step))
+        seed = self._stepwise_state_seed()
+        seed_step, _ = self._make_stepper(PHASE_SYNC, seed is not None)
+        sync_step, _ = self._make_stepper(PHASE_SYNC, True)
+        stale_step, _ = self._make_stepper(PHASE_STALE, True)
+
+        def loop(params, latents, enc, added, gs):
+            x = latents.astype(jnp.float32)
+            sstate = sched.init_state(x.shape)
+            tsteps = sched.timesteps()
+            # carry structure without unrolling a step: sync steps never
+            # read their input state (see _device_loop.state_zeros), so
+            # zeros of the eval_shape'd GLOBAL state layout start the scan
+            _, pshape, _ = jax.eval_shape(
+                seed_step, params, jnp.asarray(0), x, seed, sstate, enc,
+                added, gs,
+            )
+            ps = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), pshape)
+
+            def body_for(step_fn):
+                def body(carry, i):
+                    x, ps, ss = carry
+                    x, ps, ss = step_fn(params, i, x, ps, ss, enc, added, gs)
+                    io_callback(self._fire_callback, None, i, tsteps[i], x,
+                                ordered=True)
+                    return (x, ps, ss), None
+                return body
+
+            (x, ps, sstate), _ = lax.scan(
+                body_for(sync_step), (x, ps, sstate),
+                jnp.arange(start_step, start_step + n_sync),
+            )
+            if start_step + n_sync < num_exec_end:
+                (x, ps, sstate), _ = lax.scan(
+                    body_for(stale_step), (x, ps, sstate),
+                    jnp.arange(start_step + n_sync, num_exec_end),
+                )
+            return x
+
+        return jax.jit(loop)
 
     def _generate_stepwise(self, latents, enc, added, gs, num_steps,
                            start_step=0, end_step=None, callback=None):
@@ -496,11 +591,7 @@ class DenoiseRunner:
         self.scheduler.set_timesteps(num_steps)
         x = jnp.asarray(latents, jnp.float32)
         sstate = self.scheduler.init_state(x.shape)
-        pstate: Any = (
-            {"step": jnp.asarray(0)}
-            if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate"
-            else ({} if cfg.parallelism != "patch" else None)
-        )
+        pstate: Any = self._stepwise_state_seed()
         one_phase = (cfg.parallelism != "patch" or cfg.mode == "full_sync"
                      or not cfg.is_sp)
         n_sync = (num_exec_end - start_step if one_phase
@@ -691,10 +782,34 @@ class DenoiseRunner:
         assert end_step is None or start_step < end_step <= num_inference_steps, (
             start_step, end_step, num_inference_steps)
         if callback is not None and self.cfg.use_compiled_step:
-            raise ValueError(
-                "per-step callbacks need the host loop: build the config "
-                "with use_cuda_graph=False (reference no-CUDA-graph path)"
-            )
+            # fused/hybrid modes: the callback rides io_callback inside a
+            # dedicated compiled loop (_build_fused_callback) — same step
+            # numerics, one dispatch, per-step host sync only in THIS
+            # program.  Callback-free generates keep the host-free loop.
+            self.scheduler.set_timesteps(num_inference_steps)
+            key = ("fused_cb", num_inference_steps, start_step, end_step)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_fused_callback(
+                    num_inference_steps, start_step, end_step
+                )
+            self._active_callback = callback
+            try:
+                out = self._compiled[key](
+                    self.params,
+                    jnp.asarray(latents),
+                    prompt_embeds,
+                    added,
+                    jnp.asarray(guidance_scale, jnp.float32),
+                )
+                # block_until_ready only waits on the OUTPUT buffer; host
+                # callbacks drain on a separate thread, so without this
+                # barrier an async-dispatch backend could reach the finally
+                # (clearing _active_callback) before the last steps fire
+                jax.effects_barrier()
+                jax.block_until_ready(out)
+                return out
+            finally:
+                self._active_callback = None
         if not self.cfg.use_compiled_step:
             return self._generate_stepwise(
                 jnp.asarray(latents),
