@@ -143,7 +143,9 @@ def img2img_kwargs(args) -> dict:
 
 def save_images(output, args) -> None:
     """Save PIL output(s); multiple images get an _{i} suffix before the
-    extension (splitext, so non-.png paths work too)."""
+    extension (splitext, so non-.png paths work too).  A weightless-
+    tokenizer run drops a sidecar warning next to the images so the
+    artifact itself says it must not be quality-judged."""
     if not is_main_process() or args.output_type != "pil":
         return
     root, ext = os.path.splitext(args.output_path)
@@ -152,6 +154,11 @@ def save_images(output, args) -> None:
                 else f"{root}_{i}{ext}")
         im.save(path)
         print(f"saved {path}")
+    if getattr(output, "weightless_tokenizer", False):
+        warn_path = f"{root}.WEIGHTLESS_TOKENIZER.txt"
+        with open(warn_path, "w") as f:
+            f.write(output.warning + "\n")
+        print(f"WARNING: {output.warning} (marker: {warn_path})")
 
 
 def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler,

@@ -84,6 +84,14 @@ def main():
         if is_main_process():
             output.images[0].save(path)
             print(f"[{i}] {path}")
+            if getattr(output, "weightless_tokenizer", False):
+                # one marker per results dir: the whole set is invalid for
+                # quality metrics, not just one image
+                marker = os.path.join(out_dir, "WEIGHTLESS_TOKENIZER.txt")
+                if not os.path.exists(marker):
+                    with open(marker, "w") as f:
+                        f.write(output.warning + "\n")
+                    print(f"WARNING: {output.warning}")
 
 
 if __name__ == "__main__":

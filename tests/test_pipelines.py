@@ -258,25 +258,40 @@ def test_sdxl_micro_conditioning_kwargs(devices8):
     shifted = pipe("a fox", original_size=(4 * dcfg.height, 4 * dcfg.width),
                    crops_coords_top_left=(64, 64), **kw).images[0]
     assert np.abs(shifted - base).max() > 0
-    # negative_* reach ONLY the uncond branch: symmetric explicit values
-    # equal the default, an asymmetric negative size changes the output
+    # 6-id base layout (diffusers 0.24.0 gating): a LONE negative size is
+    # ignored — the uncond branch reuses the positive add_time_ids unless
+    # BOTH negative_original_size AND negative_target_size are passed
+    lone = pipe("a fox", negative_original_size=(4 * dcfg.height,
+                                                 4 * dcfg.width),
+                **kw).images[0]
+    np.testing.assert_array_equal(base, lone)
+    # with both given, the negative set reaches ONLY the uncond branch:
+    # values equal to the positive defaults are a bitwise no-op, an
+    # asymmetric negative size changes the output
     sym = pipe("a fox", negative_original_size=(dcfg.height, dcfg.width),
+               negative_target_size=(dcfg.height, dcfg.width),
                **kw).images[0]
     np.testing.assert_array_equal(base, sym)
     asym = pipe("a fox", negative_original_size=(4 * dcfg.height,
                                                  4 * dcfg.width),
+                negative_target_size=(dcfg.height, dcfg.width),
                 **kw).images[0]
     assert np.abs(asym - base).max() > 0
-    # the uncond crops default to (0, 0) — NOT to the positive crops
-    # (diffusers semantics)
+    # custom positive crops are REUSED by the uncond branch when the
+    # negative set is inactive; activating it resets uncond crops to (0, 0)
+    # unless negative_crops_coords_top_left overrides them
     crop = pipe("a fox", crops_coords_top_left=(32, 32), **kw).images[0]
-    crop_explicit = pipe("a fox", crops_coords_top_left=(32, 32),
-                         negative_crops_coords_top_left=(0, 0),
-                         **kw).images[0]
-    np.testing.assert_array_equal(crop, crop_explicit)
-    crop_sym = pipe("a fox", crops_coords_top_left=(32, 32),
-                    negative_crops_coords_top_left=(32, 32), **kw).images[0]
-    assert np.abs(crop_sym - crop).max() > 0
+    crop_reused = pipe("a fox", crops_coords_top_left=(32, 32),
+                       negative_original_size=(dcfg.height, dcfg.width),
+                       negative_target_size=(dcfg.height, dcfg.width),
+                       negative_crops_coords_top_left=(32, 32),
+                       **kw).images[0]
+    np.testing.assert_array_equal(crop, crop_reused)
+    crop_zeroed = pipe("a fox", crops_coords_top_left=(32, 32),
+                       negative_original_size=(dcfg.height, dcfg.width),
+                       negative_target_size=(dcfg.height, dcfg.width),
+                       **kw).images[0]
+    assert np.abs(crop_zeroed - crop).max() > 0
 
 
 def test_refiner_layout_aesthetic_score(devices8):
@@ -368,3 +383,23 @@ def test_caller_supplied_latents(devices8):
     np.testing.assert_array_equal(a, b)
     with pytest.raises(AssertionError):
         pipe("a pier", num_inference_steps=2, latents=lat0[:, :8])
+
+
+def test_weightless_tokenizer_flag_on_output(devices8):
+    """Hash-tokenizer runs carry the warning ON the artifact (VERDICT r4
+    weak #5): the PipelineOutput says it must not be quality-judged; a
+    real-tokenizer pipeline emits a clean output."""
+    pipe, _ = build_sdxl_pipeline(devices8, 1)
+    out = pipe("a fox", num_inference_steps=1, output_type="latent", seed=0)
+    assert out.weightless_tokenizer
+    assert "SimpleTokenizer" in out.warning
+
+    class _FakeRealTok:
+        model_max_length = 77
+
+        def __call__(self, texts, max_length=77, **kw):
+            return {"input_ids": np.zeros((len(texts), max_length), np.int64)}
+
+    pipe.tokenizers = [_FakeRealTok(), _FakeRealTok()]
+    out2 = pipe("a fox", num_inference_steps=1, output_type="latent", seed=0)
+    assert not out2.weightless_tokenizer and out2.warning is None
