@@ -4,5 +4,6 @@ from .scheduling import (
     DDIMScheduler,
     DPMSolverMultistepScheduler,
     EulerDiscreteScheduler,
+    FlowMatchEulerScheduler,
     get_scheduler,
 )
