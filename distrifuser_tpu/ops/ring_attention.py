@@ -61,12 +61,18 @@ def _online_merge(carry, s, vh):
     return acc, m_new, l
 
 
-def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int):
+def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int,
+              kv_static=None):
     """The ring online-softmax driver, shared by the UNet's displaced ring
-    attention (below) and the VAE's exact sp mid attention
-    (models/vae.py): merge the own KV chunk fresh, then stream the rotating
-    buffer around the axis for n-1 hops, merging each arrival.  Returns the
-    normalized fp32 accumulator [B, heads, Lq, D] (callers cast/reshape)."""
+    attention (below), the VAE's exact sp mid attention (models/vae.py),
+    and the MMDiT's joint attention (parallel/mmdit_sp.py): merge the own
+    KV chunk fresh, then stream the rotating buffer around the axis for
+    n-1 hops, merging each arrival.  ``kv_static`` [B, Ls, 2C] is an
+    optional NON-rotating block merged before the ring — the MMDiT's
+    replicated context KV, which every device holds in full (the online
+    softmax is merge-order invariant up to fp rounding, so a static block
+    composes exactly).  Returns the normalized fp32 accumulator
+    [B, heads, Lq, D] (callers cast/reshape)."""
     b, lq, c = q.shape
     d = c // heads
     s, vh = _chunk_scores(q, kv_own, heads)
@@ -74,6 +80,9 @@ def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int):
     m = jnp.full((b, heads, lq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((b, heads, lq, 1), jnp.float32)
     acc, m, l = _online_merge((acc, m, l), s, vh)
+    if kv_static is not None:
+        s, vh = _chunk_scores(q, kv_static, heads)
+        acc, m, l = _online_merge((acc, m, l), s, vh)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

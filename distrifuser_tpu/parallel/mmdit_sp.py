@@ -1,0 +1,353 @@
+"""Displaced patch parallelism for the MMDiT (SD3-class joint transformer).
+
+DistriFusion's method applied to the joint-attention architecture.  The
+token-major layout makes this the same shape as parallel/dit_sp.py: the
+image-token sequence shards over the ``sp`` axis, and JOINT attention is
+the only op that crosses patch boundaries — but here the attended keys are
+``concat(context, image)``, which splits the problem cleanly in two:
+
+* the **context stream** is short (77-333 tokens) and must stay exact (its
+  activations feed every later block's modulation of the image stream), so
+  every device computes the FULL context stream, replicated.  Its K/V need
+  no assembly, no staleness, no collective.
+* the **image stream**'s K/V are the only cross-device exchange:
+  - sync phase (steps <= warmup, reference counter semantics §2.3): each
+    block's fresh local image K/V are all-gathered — exact joint attention;
+  - stale phase: each block attends over the previous step's gathered
+    image K/V with its own slot overwritten fresh (the reference's
+    pp/attn.py:135-140 displaced semantics), then all-gathers fresh K/V
+    into the scan carry — consumed only next step, so XLA overlaps the
+    collective with the remaining blocks' compute.
+
+The replicated context stream does duplicate its (small) compute per
+device; at SD3 scale that is ~¼ of one stream's tokens at n=8 vs a 4096-
+token image sequence — noise next to the image-side saving.
+
+Two layouts, selected by ``attn_impl`` (the same pair the UNet offers):
+"gather" carries the full gathered stale image KV (reference buffer
+layout, O(L) state); "ring" carries only the own chunk (O(L/n)) and
+streams peers through the shared online-softmax ring, with the replicated
+context KV merged as a NON-rotating static block (ring_pass kv_static) —
+no refresh collective at all.  The head-sharding ulysses/usp layouts are
+undefined for joint attention's two-origin queries and are rejected
+loudly in __init__ rather than silently falling back.
+
+Every device returns the full latent and steps the scheduler replicated —
+the DenoiseRunner/DiTDenoiseRunner contract, so pipelines treat all three
+interchangeably.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models import dit as dit_mod
+from ..models import mmdit as mm
+from ..models.mmdit import MMDiTConfig
+from ..ops.linear import linear
+from ..schedulers import BaseScheduler
+from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
+from .collectives import all_gather_seq
+from .guidance import branch_select, combine_guidance
+
+
+class MMDiTDenoiseRunner:
+    """Compiled displaced-patch generation loop for an MMDiT.
+
+    API mirrors DiTDenoiseRunner.generate, with SD3 conditioning inputs:
+    ``enc`` [n_br, B, Lc, joint_attention_dim] sequence embeddings and
+    ``pooled`` [n_br, B, pooled_projection_dim] pooled text embeddings.
+    """
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        mmdit_config: MMDiTConfig,
+        params,
+        scheduler: BaseScheduler,
+    ):
+        self.cfg = distri_config
+        self.mcfg = mmdit_config
+        self.params = params
+        self.scheduler = scheduler
+        if distri_config.attn_impl not in ("gather", "ring"):
+            raise ValueError(
+                f"attn_impl={distri_config.attn_impl!r}: the MMDiT runner "
+                "implements 'gather' (reference-style full stale KV) and "
+                "'ring' (O(L/n) state; the replicated context KV rides the "
+                "ring as a non-rotating static block) — the head-sharding "
+                "ulysses/usp layouts are not defined for joint attention's "
+                "two-origin queries"
+            )
+        if distri_config.comm_batch:
+            raise ValueError(
+                "comm_batch applies to the UNet's per-layer halo/moment "
+                "exchanges; the MMDiT path has one collective kind already"
+            )
+        n = distri_config.n_device_per_batch
+        if mmdit_config.num_tokens % n != 0:
+            raise ValueError(
+                f"token count {mmdit_config.num_tokens} must be divisible "
+                f"by the sp degree {n}"
+            )
+        if (distri_config.height // 8 != mmdit_config.sample_size) or (
+            distri_config.width // 8 != mmdit_config.sample_size
+        ):
+            raise ValueError(
+                f"DistriConfig {distri_config.height}x{distri_config.width} "
+                f"implies latent {distri_config.latent_height}, but "
+                f"MMDiTConfig.sample_size is {mmdit_config.sample_size}"
+            )
+        self._compiled: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+
+    def _eval_model(self, params, x_full, s, kv_state, phase_sync,
+                    ctx0, vec_all, pos):
+        """One MMDiT evaluation on this device's token rows.
+
+        Returns (full guided-input velocity [Bl, N, D_out], new kv_state).
+        ``kv_state``: gathered [depth, 2, Bl, N, hidden] stale image K/V.
+        ``ctx0``: [Bl, Lc, hidden] projected context entering block 0 —
+        recomputed per step is unnecessary (it is timestep-independent),
+        but the stream EVOLVES through the blocks, so it restarts from
+        ctx0 each step (unlike dit_sp's per-block constant caption KV).
+        """
+        cfg, mcfg = self.cfg, self.mcfg
+        sched = self.scheduler
+        n = cfg.n_device_per_batch
+        chunk = mcfg.num_tokens // n
+        sp_idx = lax.axis_index(SP_AXIS)
+        offset = sp_idx * chunk
+        compute_dtype = params["proj_in"]["kernel"].dtype
+
+        x_in = sched.scale_model_input(x_full, s)
+        rows = lax.dynamic_slice(
+            x_in, (0, offset, 0), (x_in.shape[0], chunk, x_in.shape[2])
+        ).astype(compute_dtype)
+        if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+            rows = jnp.concatenate([rows, rows], axis=0)
+        pos_rows = lax.dynamic_slice(pos, (offset, 0), (chunk, pos.shape[1]))
+        h = linear(params["proj_in"], rows) + pos_rows[None]
+        vec = vec_all[s]
+
+        no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
+
+        def block_body_gather(carry, xs):
+            hx, hc = carry
+            bp, kv_blk = xs  # kv_blk [2, Bl, N, hid] stale gathered image KV
+            assembled = {}
+
+            def assemble(k_fresh, v_fresh):
+                if phase_sync:
+                    kv = (all_gather_seq(k_fresh), all_gather_seq(v_fresh))
+                else:
+                    kv = (
+                        lax.dynamic_update_slice(
+                            kv_blk[0], k_fresh, (0, offset, 0)
+                        ),
+                        lax.dynamic_update_slice(
+                            kv_blk[1], v_fresh, (0, offset, 0)
+                        ),
+                    )
+                assembled["kv"] = kv
+                return kv
+
+            hx, hc, (k, v) = mm.mmdit_block(
+                bp, mcfg, hx, hc, vec, kv_assemble=assemble
+            )
+            # refresh for the NEXT step: deferred consumption lets XLA
+            # overlap the gather with the remaining blocks' compute
+            if phase_sync:
+                fresh = jnp.stack(list(assembled["kv"]))
+            elif no_refresh:
+                fresh = kv_blk
+            else:
+                fresh = jnp.stack([all_gather_seq(k), all_gather_seq(v)])
+            return (hx, hc), fresh
+
+        def block_body_ring(carry, xs):
+            from ..ops.ring_attention import ring_pass
+
+            hx, hc = carry
+            bp, kv_blk = xs  # kv_blk [Bl, chunk, 2*hid] own stale chunk
+            fresh_box = {}
+
+            def core(cq, xq, ckv, xkv):
+                ck, cv = ckv
+                xk, xv = xkv
+                kv_own = jnp.concatenate([xk, xv], axis=-1)
+                fresh_box["kv"] = kv_own
+                static = jnp.concatenate([ck, cv], axis=-1)
+                # sync phase rotates fresh peer chunks (exact); stale phase
+                # rotates each peer's previous-step chunk from the carry.
+                # The replicated context KV never moves: it merges as a
+                # static block into every device's online softmax.
+                rotating = kv_own if phase_sync else kv_blk
+                q = jnp.concatenate([cq, xq], axis=1)
+                out = ring_pass(q, kv_own, rotating, n, SP_AXIS,
+                                heads=mcfg.num_heads, kv_static=static)
+                b_, lq_ = q.shape[0], q.shape[1]
+                out = out.astype(xq.dtype).transpose(0, 2, 1, 3)
+                return out.reshape(b_, lq_, mcfg.hidden_size)
+
+            hx, hc, _ = mm.mmdit_block(bp, mcfg, hx, hc, vec, attn_core=core)
+            # next step's stale state is this step's own fresh chunk — no
+            # refresh collective at all (ring_attention.py semantics)
+            if phase_sync or not no_refresh:
+                fresh = fresh_box["kv"]
+            else:
+                fresh = kv_blk
+            return (hx, hc), fresh
+
+        block_body = (block_body_ring if cfg.attn_impl == "ring"
+                      else block_body_gather)
+        (h, _), kv_new = lax.scan(
+            block_body, (h, ctx0), (params["blocks"], kv_state)
+        )
+        out_rows = mm.final_layer(params, mcfg, h, vec)
+        out_full = all_gather_seq(out_rows)
+        return out_full, kv_new
+
+    def _make_step(self, params, enc, pooled, gs, batch):
+        """Per-device step closure + local branch count and dtype."""
+        cfg, mcfg = self.cfg, self.mcfg
+        sched = self.scheduler
+        my_enc, _, _ = branch_select(cfg, enc)
+        my_pooled, _, _ = branch_select(cfg, pooled)
+        compute_dtype = params["proj_in"]["kernel"].dtype
+        pos = mm.pos_embed_cropped(mcfg, compute_dtype)
+        ctx0 = linear(params["ctx_in"], my_enc.astype(compute_dtype))
+        ts = sched.timesteps()
+        # [S, Bl, hidden] — the conditioning vec varies per step (timestep
+        # features) AND per batch row (pooled text), unlike the DiT's
+        # scalar-timestep adaLN table
+        vec_all = jax.vmap(
+            lambda t: mm.cond_vec(params, mcfg, t, my_pooled)
+        )(ts)
+
+        def step(x, sstate, kv, s, phase_sync):
+            out, kv = self._eval_model(
+                params, x, s, kv, phase_sync, ctx0, vec_all, pos
+            )
+            guided = combine_guidance(cfg, out, gs, batch)
+            x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
+            return x, sstate, kv
+
+        return step, my_enc.shape[0], compute_dtype
+
+    def _kv0(self, bloc, compute_dtype):
+        mcfg = self.mcfg
+        if self.cfg.attn_impl == "ring":
+            chunk = mcfg.num_tokens // self.cfg.n_device_per_batch
+            return jnp.zeros(
+                (mcfg.depth, bloc, chunk, 2 * mcfg.hidden_size),
+                compute_dtype,
+            )
+        return jnp.zeros(
+            (mcfg.depth, 2, bloc, mcfg.num_tokens, mcfg.hidden_size),
+            compute_dtype,
+        )
+
+    def _device_loop(self, params, latents, enc, pooled, gs, num_steps):
+        cfg, mcfg = self.cfg, self.mcfg
+        batch = latents.shape[0]
+        step, bloc, compute_dtype = self._make_step(
+            params, enc, pooled, gs, batch
+        )
+        x = dit_mod.patchify(mcfg, latents.astype(jnp.float32))
+        sstate = self.scheduler.init_state(x.shape)
+        kv0 = self._kv0(bloc, compute_dtype)
+
+        full_sync = cfg.mode == "full_sync" or not cfg.is_sp
+        n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
+
+        def sync_body(i, carry):
+            x, ss, kv = carry
+            return step(x, ss, kv, i, True)
+
+        x, sstate, kv = lax.fori_loop(0, n_sync, sync_body, (x, sstate, kv0))
+
+        if n_sync < num_steps:
+            def stale_body(carry, i):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, False), None
+
+            (x, _, _), _ = lax.scan(
+                stale_body, (x, sstate, kv), jnp.arange(n_sync, num_steps)
+            )
+        return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+    # ------------------------------------------------------------------
+
+    def _build(self, num_steps: int):
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps)
+        lat_spec = P(DP_AXIS)
+        enc_spec = P(None, DP_AXIS)
+
+        def loop(params, latents, enc, pooled, gs):
+            return shard_map(
+                device_loop,
+                mesh=cfg.mesh,
+                in_specs=(P(), lat_spec, enc_spec, enc_spec, P()),
+                out_specs=lat_spec,
+                check_vma=False,
+            )(params, latents, enc, pooled, gs)
+
+        return jax.jit(loop)
+
+    def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
+        """Per-device stale-state and per-step collective volumes (elements)
+        for the configured joint layout — closed-form, no tracing."""
+        cfg, mcfg = self.cfg, self.mcfg
+        n = cfg.n_device_per_batch
+        layout = cfg.attn_impl
+        if not cfg.is_sp:
+            return {"layout": layout, "kv_state_elems": 0,
+                    "per_step_collective_elems": 0}
+        n_br_local = (
+            1 if cfg.cfg_split or not cfg.do_classifier_free_guidance else 2
+        )
+        b = batch_size * n_br_local
+        n_tok, hid, depth = mcfg.num_tokens, mcfg.hidden_size, mcfg.depth
+        chunk = n_tok // n
+        out_gather = b * n_tok * mcfg.patch_size**2 * mcfg.out_channels
+        if layout == "ring":
+            state = depth * b * chunk * 2 * hid
+            # (n-1) ppermute hops of the local 2C chunk per block, in-step;
+            # no refresh collective (next state = own fresh chunk)
+            per_step = depth * (n - 1) * b * chunk * 2 * hid + out_gather
+        else:
+            state = depth * 2 * b * n_tok * hid
+            per_step = depth * 2 * b * n_tok * hid + out_gather
+        return {"layout": layout, "kv_state_elems": int(state),
+                "per_step_collective_elems": int(per_step)}
+
+    def generate(self, latents, enc, pooled, guidance_scale=5.0,
+                 num_inference_steps=20):
+        """``latents`` [B, H/8, W/8, C] noise already scaled by
+        init_noise_sigma; ``enc`` [n_br, B, Lc, joint_dim]; ``pooled``
+        [n_br, B, pooled_dim].  Returns the denoised latent NHWC."""
+        self.scheduler.set_timesteps(num_inference_steps)
+        gs = jnp.asarray(guidance_scale, jnp.float32)
+        if num_inference_steps not in self._compiled:
+            self._compiled[num_inference_steps] = self._build(
+                num_inference_steps
+            )
+        return self._compiled[num_inference_steps](
+            self.params, latents, enc, jnp.asarray(pooled), gs
+        )
+
+    def prepare(self, num_steps: int) -> None:
+        """Pre-build exactly the program generate() will dispatch to."""
+        self.scheduler.set_timesteps(num_steps)
+        if num_steps not in self._compiled:
+            self._compiled[num_steps] = self._build(num_steps)

@@ -1,0 +1,327 @@
+"""Displaced patch parallelism on the MMDiT (parallel/mmdit_sp.py).
+
+Oracle: per-patch sequential evaluation with per-block gathered image-KV
+caches — stale step s attends jointly over concat(context KV, cache with
+the patch's own rows fresh), exactly the runner's assembly.  The context
+stream restarts from ctx0 every evaluation and, in the stale phase, sees
+each patch's own-fresh view of the image KV (the displaced approximation
+extends to the context stream by construction — pinned here so the choice
+cannot drift silently).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu.models import dit as dit_mod
+from distrifuser_tpu.models import mmdit as mm
+from distrifuser_tpu.ops.attention import sdpa
+from distrifuser_tpu.ops.linear import linear
+from distrifuser_tpu.parallel.mmdit_sp import MMDiTDenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.utils.config import DistriConfig
+
+
+def make_model():
+    mcfg = mm.tiny_mmdit_config()
+    params = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+    return mcfg, params
+
+
+def make_inputs(mcfg, batch=1, lc=5):
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (batch, mcfg.sample_size, mcfg.sample_size, mcfg.in_channels)
+    )
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, batch, lc, mcfg.joint_attention_dim)
+    )
+    pooled = jax.random.normal(
+        jax.random.fold_in(k, 2), (2, batch, mcfg.pooled_projection_dim)
+    )
+    return lat, enc, pooled
+
+
+def dense_loop(params, mcfg, sched, lat, enc, pooled, gs, num_steps,
+               do_cfg=True):
+    """Single-device reference: full mmdit_forward per branch per step."""
+    sched.set_timesteps(num_steps)
+    ts = sched.timesteps()
+    x = lat.astype(jnp.float32)
+    sstate = sched.init_state(x.shape)
+    branches = (0, 1) if do_cfg else (0,)
+    for s in range(num_steps):
+        x_in = sched.scale_model_input(x, s)
+        outs = {
+            br: mm.mmdit_forward(params, mcfg, x_in, ts[s], enc[br],
+                                 pooled[br])
+            for br in branches
+        }
+        v = (outs[0] + gs * (outs[1] - outs[0])) if do_cfg else outs[0]
+        x, sstate = sched.step(x, v.astype(jnp.float32), s, sstate)
+    return x
+
+
+def oracle_displaced(params, mcfg, sched, lat, enc, pooled, gs, num_steps,
+                     warmup_steps, n, do_cfg=True, refresh=True):
+    sched.set_timesteps(num_steps)
+    ts = sched.timesteps()
+    x = dit_mod.patchify(mcfg, lat.astype(jnp.float32))
+    batch, n_tok, _ = x.shape
+    chunk = n_tok // n
+    n_sync = min(warmup_steps + 1, num_steps)
+    hid = mcfg.hidden_size
+    pos = mm.pos_embed_cropped(mcfg, jnp.float32)
+    branches = (0, 1) if do_cfg else (0,)
+
+    ctx0 = {br: linear(params["ctx_in"], enc[br]) for br in branches}
+    cache = {br: [(jnp.zeros((batch, n_tok, hid)),
+                   jnp.zeros((batch, n_tok, hid)))
+                  for _ in range(mcfg.depth)] for br in branches}
+    sstate = sched.init_state(x.shape)
+
+    def run_stack(br, tokens, s, sync, offset):
+        vec = mm.cond_vec(params, mcfg, ts[s], pooled[br])
+        pos_rows = jax.lax.dynamic_slice_in_dim(pos, offset, tokens.shape[1], 0)
+        h = linear(params["proj_in"], tokens) + pos_rows[None]
+        ctx = ctx0[br]
+        fresh = []
+        for l in range(mcfg.depth):
+            bp = jax.tree.map(lambda a: a[l], params["blocks"])
+
+            def assemble(k, v, l=l):
+                if sync:
+                    return k, v
+                ck, cv = cache[br][l]
+                return (
+                    jax.lax.dynamic_update_slice(ck, k, (0, offset, 0)),
+                    jax.lax.dynamic_update_slice(cv, v, (0, offset, 0)),
+                )
+
+            h, ctx, (k, v) = mm.mmdit_block(bp, mcfg, h, ctx, vec,
+                                            kv_assemble=assemble)
+            fresh.append((k, v))
+        return mm.final_layer(params, mcfg, h, vec), fresh
+
+    def combine(out):
+        if not do_cfg:
+            return out[0]
+        return out[0] + gs * (out[1] - out[0])
+
+    for s in range(num_steps):
+        x_in = sched.scale_model_input(x, s)
+        if s < n_sync:
+            out, fr = {}, {}
+            for br in branches:
+                out[br], fr[br] = run_stack(br, x_in, s, True, 0)
+                cache[br] = fr[br]
+        else:
+            out = {br: [] for br in branches}
+            fresh_all = {br: [[] for _ in range(mcfg.depth)]
+                         for br in branches}
+            for p in range(n):
+                rows = x_in[:, p * chunk:(p + 1) * chunk]
+                for br in branches:
+                    e, fr = run_stack(br, rows, s, False, p * chunk)
+                    out[br].append(e)
+                    for l in range(mcfg.depth):
+                        fresh_all[br][l].append(fr[l])
+            out = {br: jnp.concatenate(v, axis=1) for br, v in out.items()}
+            if refresh:
+                for br in branches:
+                    cache[br] = [
+                        (jnp.concatenate([kv[0] for kv in fresh_all[br][l]],
+                                         axis=1),
+                         jnp.concatenate([kv[1] for kv in fresh_all[br][l]],
+                                         axis=1))
+                        for l in range(mcfg.depth)
+                    ]
+        x, sstate = sched.step(x, combine(out).astype(jnp.float32), s, sstate)
+
+    return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+
+def sp_config(n_dev, do_cfg, **kw):
+    return DistriConfig(
+        devices=jax.devices()[:n_dev], height=256, width=256,
+        do_classifier_free_guidance=do_cfg, split_batch=do_cfg, **kw,
+    )
+
+
+def test_full_sync_matches_dense():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, mode="full_sync")
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=3)
+    ref = dense_loop(params, mcfg, get_scheduler("flow-euler"), lat, enc,
+                     pooled, 1.0, 3, do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_displaced_matches_oracle():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1)
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=6)
+    ref = oracle_displaced(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cfg_split_composes():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(8, do_cfg=True, warmup_steps=1)
+    assert cfg.cfg_split and cfg.n_device_per_batch == 4
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=4.0,
+                          num_inference_steps=5)
+    ref = oracle_displaced(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 4.0, 5,
+        warmup_steps=1, n=4, do_cfg=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cfg_folded():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = DistriConfig(
+        devices=jax.devices()[:2], height=256, width=256,
+        do_classifier_free_guidance=True, split_batch=False, warmup_steps=1,
+    )
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=4.0,
+                          num_inference_steps=4)
+    ref = oracle_displaced(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 4.0, 4,
+        warmup_steps=1, n=2, do_cfg=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_no_sync_mode():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1, mode="no_sync")
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=6)
+    ref = oracle_displaced(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False, refresh=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    ref_refresh = oracle_displaced(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False, refresh=True,
+    )
+    assert not np.allclose(np.asarray(out), np.asarray(ref_refresh),
+                           rtol=2e-4, atol=2e-4)
+
+
+def test_rejected_knobs_and_geometry():
+    mcfg, params = make_model()
+    with pytest.raises(ValueError, match="gather"):
+        MMDiTDenoiseRunner(sp_config(4, do_cfg=False, attn_impl="ring"),
+                           mcfg, params, get_scheduler("flow-euler"))
+    with pytest.raises(ValueError, match="comm_batch"):
+        MMDiTDenoiseRunner(sp_config(4, do_cfg=False, comm_batch=True),
+                           mcfg, params, get_scheduler("flow-euler"))
+    with pytest.raises(ValueError, match="sample_size"):
+        MMDiTDenoiseRunner(
+            DistriConfig(devices=jax.devices()[:2], height=128, width=128),
+            mcfg, params, get_scheduler("flow-euler"))
+
+
+def test_comm_report():
+    mcfg, params = make_model()
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1)
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    rep = runner.comm_report()
+    assert rep["layout"] == "gather"
+    assert rep["kv_state_elems"] == (
+        mcfg.depth * 2 * mcfg.num_tokens * mcfg.hidden_size
+    )
+    assert rep["per_step_collective_elems"] > rep["kv_state_elems"]
+
+
+def test_ring_matches_gather():
+    """attn_impl='ring': O(L/n) state + static context block, same displaced
+    numerics as 'gather' (online vs plain softmax differ only in
+    rounding)."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=5)
+    outs = {}
+    for impl in ("gather", "ring"):
+        cfg = sp_config(4, do_cfg=False, warmup_steps=1, attn_impl=impl)
+        runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                    get_scheduler("flow-euler"))
+        outs[impl] = np.asarray(runner.generate(lat, enc, pooled, **kw))
+    np.testing.assert_allclose(outs["ring"], outs["gather"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_full_sync_matches_dense():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, mode="full_sync", attn_impl="ring")
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=3)
+    ref = dense_loop(params, mcfg, get_scheduler("flow-euler"), lat, enc,
+                     pooled, 1.0, 3, do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_no_sync_matches_gather_no_sync():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=5)
+    outs = {}
+    for impl in ("gather", "ring"):
+        cfg = sp_config(4, do_cfg=False, warmup_steps=1, mode="no_sync",
+                        attn_impl=impl)
+        runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                    get_scheduler("flow-euler"))
+        outs[impl] = np.asarray(runner.generate(lat, enc, pooled, **kw))
+    np.testing.assert_allclose(outs["ring"], outs["gather"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_comm_report():
+    mcfg, params = make_model()
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1, attn_impl="ring")
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    rep = runner.comm_report()
+    assert rep["layout"] == "ring"
+    chunk = mcfg.num_tokens // 4
+    assert rep["kv_state_elems"] == mcfg.depth * chunk * 2 * mcfg.hidden_size
+    gather = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1), mcfg, params,
+        get_scheduler("flow-euler"),
+    ).comm_report()
+    # gather carries all n chunks; ring only the own one
+    assert rep["kv_state_elems"] * 4 == gather["kv_state_elems"]
