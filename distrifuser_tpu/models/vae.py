@@ -46,6 +46,10 @@ class VAEConfig:
     layers_per_block: int = 2
     norm_num_groups: int = 32
     scaling_factor: float = 0.13025  # SDXL; SD 1.x uses 0.18215
+    # SD3-family VAEs re-center the latent: x = latent / scaling + shift
+    # before decode (and (x - shift) * scaling after encode); 0.0 for
+    # SD 1.x/2.x/SDXL keeps the legacy formula untouched
+    shift_factor: float = 0.0
 
 
 def sdxl_vae_config() -> VAEConfig:
@@ -71,6 +75,7 @@ def vae_config_from_json(source) -> VAEConfig:
         layers_per_block=cfg.get("layers_per_block", 2),
         norm_num_groups=cfg.get("norm_num_groups", 32),
         scaling_factor=cfg.get("scaling_factor", 0.18215),
+        shift_factor=cfg.get("shift_factor") or 0.0,
     )
 
 

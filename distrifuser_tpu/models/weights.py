@@ -359,6 +359,148 @@ def convert_pixart_state_dict(
     return _cast(tree, dtype)
 
 
+def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
+    """diffusers SD3Transformer2DModel state_dict -> mmdit.py param tree.
+
+    Mapping conventions (pinned by tests/test_mmdit_weights.py against a
+    synthetic state dict — real-checkpoint validation needs mounted SD3
+    weights, which this image does not have; the layout follows the
+    published diffusers module structure):
+
+    * ``pos_embed.proj`` (ps x ps patch-embed conv) -> ``proj_in`` linear
+      over patchify's (p, q, c) token order; the fixed sin-cos
+      ``pos_embed.pos_embed`` buffer is ignored (computed functionally by
+      mmdit.pos_embed_cropped);
+    * per-block q/k/v (``attn.to_{q,k,v}``, ``attn.add_{q,k,v}_proj``)
+      fuse into ``x_qkv``/``c_qkv`` [h, 3h];
+    * adaLN chunk orders differ per module family and are normalized to
+      mmdit_block's (shift, scale, gate) x (attn, mlp):
+      - ``norm1.linear`` / ``norm1_context.linear`` (AdaLayerNormZero,
+        6 chunks) are already (shift, scale, gate, shift, scale, gate);
+      - the FINAL block's ``norm1_context.linear`` and the top-level
+        ``norm_out.linear`` (AdaLayerNormContinuous, 2 chunks) are
+        (scale, shift) and get SWAPPED into (shift, scale);
+    * the final block has no context attn-out/MLP (context_pre_only) and
+      no context queries: the uniform stacked layout zero-fills
+      ``c_out``/``c_fc*``/the gate+MLP modulation chunks/the q third of
+      ``c_qkv`` — all of which feed only the DISCARDED final context
+      stream (gates are zero, so the context residual passes through
+      bit-exactly).
+    """
+    get = lambda k: np.asarray(sd[k])
+
+    def lin(key):
+        w = {"kernel": get(f"{key}.weight").T}
+        if f"{key}.bias" in sd:
+            w["bias"] = get(f"{key}.bias")
+        return w
+
+    def fused3(kq, kk, kv):
+        """Three [h_out, h_in] torch linears -> one [h_in, 3h_out] kernel."""
+        out = {"kernel": np.concatenate(
+            [get(f"{kq}.weight").T, get(f"{kk}.weight").T,
+             get(f"{kv}.weight").T], axis=1)}
+        if f"{kq}.bias" in sd:
+            out["bias"] = np.concatenate(
+                [get(f"{kq}.bias"), get(f"{kk}.bias"), get(f"{kv}.bias")])
+        return out
+
+    def swap_scale_shift(m):
+        """AdaLayerNormContinuous (scale, shift) -> (shift, scale)."""
+        w, b = m["kernel"], m["bias"]
+        h = w.shape[1] // 2
+        return {
+            "kernel": np.concatenate([w[:, h:], w[:, :h]], axis=1),
+            "bias": np.concatenate([b[h:], b[:h]]),
+        }
+
+    n_blocks = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("transformer_blocks.")
+    )
+    blocks = []
+    for i in range(n_blocks):
+        b = f"transformer_blocks.{i}"
+        hidden = get(f"{b}.attn.to_q.weight").shape[0]
+        pre_only = f"{b}.attn.to_add_out.weight" not in sd
+
+        if pre_only:
+            # context stream of the last block: K/V only.  Zero the query
+            # third (its attention rows are computed and discarded) and
+            # every output-side context weight; map the 2-chunk continuous
+            # modulation into the (shift, scale) attn slots with zero gates.
+            kdt = get(f"{b}.attn.add_k_proj.weight").dtype
+            ckv = {
+                "kernel": np.concatenate(
+                    [np.zeros((hidden, hidden), kdt),
+                     get(f"{b}.attn.add_k_proj.weight").T,
+                     get(f"{b}.attn.add_v_proj.weight").T], axis=1),
+                "bias": np.concatenate(
+                    [np.zeros((hidden,), kdt),
+                     get(f"{b}.attn.add_k_proj.bias"),
+                     get(f"{b}.attn.add_v_proj.bias")]),
+            }
+            cont = swap_scale_shift(lin(f"{b}.norm1_context.linear"))
+            zeros_mod_w = np.zeros_like(cont["kernel"])
+            zeros_mod_b = np.zeros_like(cont["bias"])
+            c_mod = {
+                # (shift, scale) into the attn slots; gate + all MLP slots 0
+                "kernel": np.concatenate(
+                    [cont["kernel"], zeros_mod_w[:, :hidden],
+                     zeros_mod_w, zeros_mod_w[:, :hidden]], axis=1),
+                "bias": np.concatenate(
+                    [cont["bias"], zeros_mod_b[:hidden],
+                     zeros_mod_b, zeros_mod_b[:hidden]]),
+            }
+            zlin = {"kernel": np.zeros((hidden, hidden), ckv["kernel"].dtype),
+                    "bias": np.zeros((hidden,), ckv["kernel"].dtype)}
+            mlp_w = get(f"{b}.ff.net.0.proj.weight")
+            zfc1 = {"kernel": np.zeros((hidden, mlp_w.shape[0]), mlp_w.dtype),
+                    "bias": np.zeros((mlp_w.shape[0],), mlp_w.dtype)}
+            zfc2 = {"kernel": np.zeros((mlp_w.shape[0], hidden), mlp_w.dtype),
+                    "bias": np.zeros((hidden,), mlp_w.dtype)}
+            c_out, c_fc1, c_fc2 = zlin, zfc1, zfc2
+        else:
+            ckv = fused3(f"{b}.attn.add_q_proj", f"{b}.attn.add_k_proj",
+                         f"{b}.attn.add_v_proj")
+            c_mod = lin(f"{b}.norm1_context.linear")
+            c_out = lin(f"{b}.attn.to_add_out")
+            c_fc1 = lin(f"{b}.ff_context.net.0.proj")
+            c_fc2 = lin(f"{b}.ff_context.net.2")
+
+        blocks.append({
+            "x_mod": lin(f"{b}.norm1.linear"),
+            "c_mod": c_mod,
+            "x_qkv": fused3(f"{b}.attn.to_q", f"{b}.attn.to_k",
+                            f"{b}.attn.to_v"),
+            "c_qkv": ckv,
+            "x_out": lin(f"{b}.attn.to_out.0"),
+            "c_out": c_out,
+            "x_fc1": lin(f"{b}.ff.net.0.proj"),
+            "x_fc2": lin(f"{b}.ff.net.2"),
+            "c_fc1": c_fc1,
+            "c_fc2": c_fc2,
+        })
+
+    pw = get("pos_embed.proj.weight")  # conv [hidden, C, ps, ps]
+    hidden = pw.shape[0]
+    proj_in = {
+        "kernel": pw.transpose(2, 3, 1, 0).reshape(-1, hidden),
+        "bias": get("pos_embed.proj.bias"),
+    }
+    tree = {
+        "proj_in": proj_in,
+        "ctx_in": lin("context_embedder"),
+        "t_fc1": lin("time_text_embed.timestep_embedder.linear_1"),
+        "t_fc2": lin("time_text_embed.timestep_embedder.linear_2"),
+        "pool_fc1": lin("time_text_embed.text_embedder.linear_1"),
+        "pool_fc2": lin("time_text_embed.text_embedder.linear_2"),
+        "final_mod": swap_scale_shift(lin("norm_out.linear")),
+        "final_out": lin("proj_out"),
+        "blocks": _stack_layers(blocks),
+    }
+    return _cast(tree, dtype)
+
+
 # ---------------------------------------------------------------------------
 # on-disk cache of converted trees
 # ---------------------------------------------------------------------------
