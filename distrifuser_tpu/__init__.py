@@ -12,7 +12,8 @@ from .utils.config import DistriConfig, init_multihost
 
 def __getattr__(name):
     # Lazy pipeline exports keep `import distrifuser_tpu` light.
-    if name in ("DistriSDXLPipeline", "DistriSDPipeline", "DistriPixArtPipeline"):
+    if name in ("DistriSDXLPipeline", "DistriSDPipeline",
+                "DistriPixArtPipeline", "DistriSD3Pipeline"):
         from . import pipelines
 
         return getattr(pipelines, name)

@@ -43,7 +43,7 @@ from .models.weights import (
     load_sharded_safetensors,
 )
 from .parallel.runner import make_runner
-from .schedulers import BaseScheduler, get_scheduler
+from .schedulers import BaseScheduler, FlowMatchEulerScheduler, get_scheduler
 from .utils.config import DistriConfig
 
 
@@ -143,6 +143,32 @@ def _scheduler_from_snapshot(root: str, name: str | BaseScheduler) -> BaseSchedu
             if k in sc:
                 kwargs[k] = sc[k]
     return get_scheduler(name, **kwargs)
+
+
+def _check_scheduler_family(scheduler: BaseScheduler, *, flow: bool,
+                            family: str) -> None:
+    """Reject scheduler/model-family mismatches LOUDLY at construction.
+
+    A rectified-flow sampler integrates the model output as a velocity
+    over flow sigmas; the diffusion samplers integrate it as
+    epsilon/v over beta schedules.  Crossing them runs without error and
+    produces garbage images — the one failure mode a user cannot debug
+    from the output alone, so every pipeline constructor calls this.
+    """
+    is_flow = isinstance(scheduler, FlowMatchEulerScheduler)
+    if flow and not is_flow:
+        raise ValueError(
+            f"{family} is a rectified-flow model family: the scheduler "
+            "must be FlowMatchEulerScheduler ('flow-euler'), got "
+            f"{type(scheduler).__name__}"
+        )
+    if not flow and is_flow:
+        raise ValueError(
+            f"'flow-euler' on {family}: this family predicts epsilon/v "
+            "over a beta schedule, not a rectified-flow velocity — use "
+            "ddim / euler / dpm-solver ('flow-euler' is for "
+            "DistriSD3Pipeline)"
+        )
 
 
 def _tokenize(tok, texts: List[str]) -> np.ndarray:
@@ -262,11 +288,12 @@ def _batched_generate(cfg, scheduler, prompts, negs, num_images_per_prompt,
     return jnp.concatenate(outs, axis=0)
 
 
-def _decode_chunked(decode, vae_params, latent, bs, scaling):
+def _decode_chunked(decode, vae_params, latent, bs, scaling, shift=0.0):
     """VAE-decode in fixed batch_size chunks (pad the tail, drop the padded
     rows): the jitted decoder traces once per shape, and the sequence-
     parallel decode's shard_map needs its dp-divisible batch — an arbitrary
-    total from _batched_generate must not reach it directly."""
+    total from _batched_generate must not reach it directly.  ``shift`` is
+    the SD3-family latent re-centering (VAEConfig.shift_factor)."""
     total = latent.shape[0]
     outs = []
     for i in range(0, total, bs):
@@ -274,7 +301,7 @@ def _decode_chunked(decode, vae_params, latent, bs, scaling):
         pad = bs - cl.shape[0]
         if pad:
             cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
-        img = decode(vae_params, cl / scaling)
+        img = decode(vae_params, cl / scaling + shift)
         outs.append(img[:bs - pad] if pad else img)
     return jnp.concatenate(outs, axis=0)
 
@@ -293,6 +320,8 @@ class _DistriPipelineBase:
         tokenizers,
         text_encoders,  # list of (CLIPTextConfig, params)
     ):
+        _check_scheduler_family(scheduler, flow=False,
+                                family=type(self).__name__)
         self.distri_config = distri_config
         self.unet_config = unet_config
         self.vae_config = vae_config
@@ -781,6 +810,8 @@ class DistriPixArtPipeline:
         from .parallel.dit_sp import DiTDenoiseRunner
         from .parallel.pipefusion import PipeFusionRunner
 
+        _check_scheduler_family(scheduler, flow=False,
+                                family="DistriPixArtPipeline")
         cfg = distri_config
         self.distri_config = cfg
         self.dit_config = dit_config
@@ -1013,3 +1044,300 @@ def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
             flush=True,
         )
         return SimpleTokenizer(vocab_size=vocab_size, eos=1, bos=0)
+
+
+class DistriSD3Pipeline:
+    """SD3-class MMDiT pipeline — a model family BEYOND the reference
+    (whose diffusers 0.24 pin predates SD3 entirely); built so the same
+    displaced-patch machinery covers the current diffusion architecture.
+
+    Text conditioning follows the published SD3 recipe: both CLIP
+    encoders' penultimate hidden states concatenate along features and
+    zero-pad to joint_attention_dim; T5 states (or zeros when no T5 is
+    loaded — SD3 supports dropping it) append along the TOKEN axis; the
+    pooled vector is the concat of both CLIP projected embeddings.
+    Sampling is rectified-flow Euler (schedulers.FlowMatchEulerScheduler),
+    denoising runs on parallel/mmdit_sp.MMDiTDenoiseRunner, and the
+    SD3-family VAE re-centering (shift_factor) applies at decode.
+    """
+
+    def __init__(
+        self,
+        distri_config: DistriConfig,
+        mmdit_config,
+        mmdit_params,
+        vae_config: vae_mod.VAEConfig,
+        vae_params,
+        scheduler: BaseScheduler,
+        tokenizers,       # [clip_l_tok, clip_g_tok, t5_tok_or_None]
+        text_encoders,    # [(CLIPTextConfig, params) x 2]
+        t5_config=None,
+        t5_params=None,
+        max_t5_tokens: int = 77,
+    ):
+        from .parallel.mmdit_sp import MMDiTDenoiseRunner
+
+        _check_scheduler_family(scheduler, flow=True,
+                                family="DistriSD3Pipeline (SD3-class MMDiT)")
+        cfg = distri_config
+        self.distri_config = cfg
+        self.mmdit_config = mmdit_config
+        self.vae_config = vae_config
+        self.vae_params = vae_params
+        self.scheduler = scheduler
+        self.tokenizers = tokenizers
+        self.text_encoders = text_encoders
+        self.t5 = (t5_config, t5_params)
+        self.max_t5_tokens = max_t5_tokens
+        pooled_dim = sum(
+            tc.projection_dim or tc.hidden_size for tc, _ in text_encoders
+        )
+        if pooled_dim != mmdit_config.pooled_projection_dim:
+            raise ValueError(
+                f"CLIP projected widths sum to {pooled_dim}, but the "
+                f"transformer expects pooled_projection_dim="
+                f"{mmdit_config.pooled_projection_dim}"
+            )
+        clip_dim = sum(tc.hidden_size for tc, _ in text_encoders)
+        if clip_dim > mmdit_config.joint_attention_dim:
+            raise ValueError(
+                f"CLIP hidden widths sum to {clip_dim} > joint_attention_dim "
+                f"{mmdit_config.joint_attention_dim}"
+            )
+        self.runner = MMDiTDenoiseRunner(cfg, mmdit_config, mmdit_params,
+                                         scheduler)
+        self._decode, self.vae_decode_parallel = _build_decoder(cfg, vae_config)
+        self._clip_jitted = [
+            jax.jit(lambda prm, ids, _cfg=ccfg: clip_mod.clip_text_forward(
+                prm, _cfg, ids))
+            for ccfg, _ in text_encoders
+        ]
+        if t5_params is not None:
+            from .models.t5 import t5_encode
+
+            self._t5_jitted = jax.jit(
+                lambda prm, ids, mask: t5_encode(prm, t5_config, ids, mask)
+            )
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        distri_config: DistriConfig,
+        pretrained_model_name_or_path: str,
+        scheduler: str | BaseScheduler = "flow-euler",
+        dtype=None,
+        variant: Optional[str] = None,
+        max_t5_tokens: int = 77,
+        **kwargs,
+    ) -> "DistriSD3Pipeline":
+        """Load a local SD3 snapshot (transformer/, vae/, text_encoder/,
+        text_encoder_2/, optional text_encoder_3/ (T5), tokenizer*/).
+        The T5 encoder is optional exactly as in the published pipeline —
+        absent weights degrade to the zero-embedding path."""
+        from .models import mmdit as mmdit_mod
+        from .models import t5 as t5_mod
+        from .models.weights import convert_mmdit_state_dict, convert_t5_state_dict
+
+        root = pretrained_model_name_or_path
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root!r} is not a local model directory (no network egress)."
+            )
+        dtype = dtype or distri_config.dtype
+        mcfg = _config_from_snapshot(
+            root, "transformer", mmdit_mod.mmdit_config_from_json,
+            mmdit_mod.sd3_config,
+        )
+        mmdit_params = convert_mmdit_state_dict(
+            load_sharded_safetensors(os.path.join(root, "transformer"),
+                                     variant=variant), dtype
+        )
+        vae_params = convert_vae_state_dict(
+            load_sharded_safetensors(os.path.join(root, "vae"),
+                                     variant=variant), dtype
+        )
+        encs, toks = [], []
+        for sub, tok_sub in (("text_encoder", "tokenizer"),
+                             ("text_encoder_2", "tokenizer_2")):
+            ccfg = _config_from_snapshot(
+                root, sub, clip_mod.clip_config_from_json,
+                clip_mod.tiny_clip_config,
+            )
+            cparams = convert_clip_state_dict(
+                load_sharded_safetensors(os.path.join(root, sub),
+                                         variant=variant), dtype
+            )
+            encs.append((ccfg, cparams))
+            toks.append(_tokenizer_or_fallback(os.path.join(root, tok_sub)))
+        t5cfg = t5p = None
+        if os.path.isdir(os.path.join(root, "text_encoder_3")):
+            t5cfg = _config_from_snapshot(
+                root, "text_encoder_3", t5_mod.t5_config_from_json,
+                t5_mod.t5_v1_1_xxl_config,
+            )
+            t5p = convert_t5_state_dict(
+                load_sharded_safetensors(os.path.join(root, "text_encoder_3"),
+                                         variant=variant), dtype
+            )
+            toks.append(_t5_tokenizer_or_fallback(
+                os.path.join(root, "tokenizer_3"), t5cfg.vocab_size))
+        else:
+            toks.append(None)
+        from .native import release_mappings
+
+        release_mappings()
+        if isinstance(scheduler, BaseScheduler):
+            sched = scheduler  # family-checked by __init__
+        elif scheduler != "flow-euler":
+            raise ValueError(
+                f"scheduler={scheduler!r}: SD3-class MMDiTs are "
+                "rectified-flow models — only 'flow-euler' (or a "
+                "FlowMatchEulerScheduler instance) is valid"
+            )
+        else:
+            # SD3 scheduler_config carries the flow shift, not betas
+            shift = 3.0
+            sc_path = os.path.join(root, "scheduler", "scheduler_config.json")
+            if os.path.exists(sc_path):
+                import json as _json
+
+                with open(sc_path) as f:
+                    shift = _json.load(f).get("shift", 3.0)
+            sched = FlowMatchEulerScheduler(shift=shift)
+        return cls(distri_config, mcfg, mmdit_params,
+                   _config_from_snapshot(root, "vae",
+                                         vae_mod.vae_config_from_json,
+                                         vae_mod.sd_vae_config),
+                   vae_params, sched, toks, encs, t5cfg, t5p,
+                   max_t5_tokens=max_t5_tokens)
+
+    @classmethod
+    def from_params(cls, distri_config, mmdit_config, mmdit_params,
+                    vae_config, vae_params, clip_configs, clip_params,
+                    t5_config=None, t5_params=None, scheduler="flow-euler",
+                    tokenizers=None, max_t5_tokens: int = 77):
+        sched = (scheduler if isinstance(scheduler, BaseScheduler)
+                 else get_scheduler(scheduler))
+        toks = tokenizers or [
+            SimpleTokenizer(tc.vocab_size) for tc in clip_configs
+        ] + [SimpleTokenizer(t5_config.vocab_size, eos=1, bos=0)
+             if t5_config else None]
+        return cls(distri_config, mmdit_config, mmdit_params, vae_config,
+                   vae_params, sched, toks, list(zip(clip_configs,
+                                                     clip_params)),
+                   t5_config, t5_params, max_t5_tokens=max_t5_tokens)
+
+    # -- reference API ----------------------------------------------------
+    def set_progress_bar_config(self, **kwargs):
+        pass
+
+    def prepare(self, num_inference_steps: int = 20, **kwargs) -> None:
+        self.runner.prepare(num_inference_steps)
+
+    def _encode(self, prompts, negs):
+        cfg = self.distri_config
+        mcfg = self.mmdit_config
+        texts = negs + prompts if cfg.do_classifier_free_guidance else prompts
+        n_br = 2 if cfg.do_classifier_free_guidance else 1
+        b = len(prompts)
+
+        clip_states, pooleds = [], []
+        for which in range(2):
+            ids = _tokenize(self.tokenizers[which], texts)
+            out = self._clip_jitted[which](
+                self.text_encoders[which][1], np.asarray(ids))
+            clip_states.append(out["hidden_states"][-2])
+            pooleds.append(out.get("text_embeds", out["pooler_output"]))
+        clip_emb = jnp.concatenate(clip_states, axis=-1)
+        pad = mcfg.joint_attention_dim - clip_emb.shape[-1]
+        clip_emb = jnp.pad(clip_emb, ((0, 0), (0, 0), (0, pad)))
+        pooled = jnp.concatenate(pooleds, axis=-1)
+
+        t5cfg, t5p = self.t5
+        if t5p is None:
+            t5_emb = jnp.zeros(
+                (clip_emb.shape[0], self.max_t5_tokens,
+                 mcfg.joint_attention_dim), clip_emb.dtype,
+            )
+        else:
+            tok = self.tokenizers[2]
+            if isinstance(tok, SimpleTokenizer):
+                ids = tok(texts, self.max_t5_tokens)
+                mask = (ids != tok.eos).astype(np.float32)
+                first_eos = np.argmax(ids == tok.eos, axis=1)
+                mask[np.arange(len(ids)), first_eos] = 1.0
+            else:
+                out = tok(texts, padding="max_length",
+                          max_length=self.max_t5_tokens, truncation=True,
+                          return_tensors="np")
+                ids = np.asarray(out["input_ids"])
+                mask = np.asarray(out["attention_mask"], np.float32)
+            t5_emb = self._t5_jitted(
+                t5p, jnp.asarray(ids, jnp.int32), jnp.asarray(mask))
+        enc = jnp.concatenate([clip_emb, t5_emb.astype(clip_emb.dtype)],
+                              axis=1)
+        enc = enc.reshape(n_br, b, *enc.shape[1:])
+        pooled = pooled.reshape(n_br, b, -1)
+        return enc, pooled
+
+    def __call__(
+        self,
+        prompt: str | List[str],
+        negative_prompt: str | List[str] = "",
+        num_inference_steps: int = 28,
+        guidance_scale: float = 7.0,
+        seed: int = 0,
+        output_type: str = "pil",
+        latents=None,
+        num_images_per_prompt: int = 1,
+        **kwargs,
+    ) -> PipelineOutput:
+        cfg = self.distri_config
+        if "height" in kwargs or "width" in kwargs:
+            raise ValueError(
+                "height and width are fixed in DistriConfig (reference "
+                "pipelines.py:47-55)"
+            )
+        if not cfg.do_classifier_free_guidance:
+            guidance_scale = 1.0
+        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+        negs = (
+            [negative_prompt] * len(prompts)
+            if isinstance(negative_prompt, str)
+            else list(negative_prompt)
+        )
+        assert len(negs) == len(prompts), (
+            f"{len(prompts)} prompts but {len(negs)} negative prompts"
+        )
+        self.scheduler.set_timesteps(num_inference_steps)
+
+        def run_chunk(cp, cn, cl, _n_real):
+            enc, pooled = self._encode(cp, cn)
+            return self.runner.generate(
+                cl, enc, pooled, guidance_scale=guidance_scale,
+                num_inference_steps=num_inference_steps,
+            )
+
+        latent = _batched_generate(
+            cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
+            latents, self.mmdit_config.in_channels, run_chunk,
+        )
+        toks = [t for t in self.tokenizers if t is not None]
+        if output_type == "latent":
+            return _mk_output(list(np.asarray(latent)), toks)
+        image = _decode_chunked(
+            self._decode, self.vae_params, latent,
+            self.distri_config.batch_size, self.vae_config.scaling_factor,
+            self.vae_config.shift_factor,
+        )
+        image = np.asarray(image, np.float32)
+        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
+        if output_type == "np":
+            return _mk_output(list(image), toks)
+        from PIL import Image
+
+        return _mk_output(
+            [Image.fromarray((im * 255).round().astype(np.uint8))
+             for im in image],
+            toks,
+        )

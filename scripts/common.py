@@ -22,7 +22,11 @@ from distrifuser_tpu import DistriConfig
 from distrifuser_tpu.models import clip as clip_mod
 from distrifuser_tpu.models import unet as unet_mod
 from distrifuser_tpu.models import vae as vae_mod
-from distrifuser_tpu.pipelines import DistriSDPipeline, DistriSDXLPipeline
+from distrifuser_tpu.pipelines import (
+    DistriSD3Pipeline,
+    DistriSDPipeline,
+    DistriSDXLPipeline,
+)
 
 
 def add_distri_args(parser: argparse.ArgumentParser) -> None:
@@ -42,7 +46,7 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--image_size", type=int, nargs="*", default=[1024, 1024])
     parser.add_argument("--guidance_scale", type=float, default=5.0)
     parser.add_argument("--scheduler", type=str, default="ddim",
-                        choices=["ddim", "euler", "dpm-solver"])
+                        choices=["ddim", "euler", "dpm-solver", "flow-euler"])
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--no_split_batch", action="store_true",
                         help="disable CFG batch splitting")
@@ -216,6 +220,59 @@ def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Dis
         )
     if args.random_weights:
         return _random_sdxl_pipeline(distri_config, scheduler, tiny=getattr(args, 'tiny_model', False))
+    raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
+
+
+def _random_sd3_pipeline(distri_config: DistriConfig, scheduler,
+                         tiny: bool = False) -> DistriSD3Pipeline:
+    import dataclasses
+
+    from distrifuser_tpu.models import mmdit as mmdit_mod
+
+    if tiny:
+        mcfg = mmdit_mod.tiny_mmdit_config()
+        vcfg = vae_mod.tiny_vae_config()
+        tc1 = clip_mod.tiny_clip_config(hidden=16)
+        tc2 = clip_mod.CLIPTextConfig(
+            vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=32, projection_dim=8,
+        )
+    else:
+        # SD3-medium geometry: both CLIPs carry projections (pooled
+        # 768 + 1280 = 2048); hidden concat 2048 pads to joint dim 4096
+        mcfg = mmdit_mod.sd3_config(
+            sample_size=distri_config.latent_height
+        )
+        vcfg = dataclasses.replace(
+            vae_mod.sdxl_vae_config(), latent_channels=16,
+            scaling_factor=1.5305, shift_factor=0.0609,
+        )
+        tc1 = dataclasses.replace(clip_mod.clip_vit_l_config(),
+                                  projection_dim=768)
+        tc2 = clip_mod.open_clip_bigg_config()
+    dt = distri_config.dtype
+    return DistriSD3Pipeline.from_params(
+        distri_config, mcfg,
+        mmdit_mod.init_mmdit_params(jax.random.PRNGKey(0), mcfg, dt),
+        vcfg, vae_mod.init_vae_params(jax.random.PRNGKey(1), vcfg, dt),
+        [tc1, tc2],
+        [clip_mod.init_clip_params(jax.random.PRNGKey(2), tc1, dt),
+         clip_mod.init_clip_params(jax.random.PRNGKey(3), tc2, dt)],
+        scheduler=scheduler,
+    )
+
+
+def load_sd3_pipeline(args, distri_config: DistriConfig,
+                      scheduler=None) -> DistriSD3Pipeline:
+    scheduler = scheduler or args.scheduler
+    if args.model_path:
+        return DistriSD3Pipeline.from_pretrained(
+            distri_config, args.model_path, scheduler=scheduler
+        )
+    if args.random_weights:
+        return _random_sd3_pipeline(
+            distri_config, scheduler, tiny=getattr(args, "tiny_model", False)
+        )
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 

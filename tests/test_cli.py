@@ -57,3 +57,29 @@ def test_img2img_flags_parse():
     assert args.strength == 0.5
     assert args.num_images_per_prompt == 3
     assert _args([]).init_image is None
+
+
+def test_sd3_scheduler_guard_and_loader(devices8, monkeypatch):
+    """sd3_example's CLI guard refuses non-flow schedulers BEFORE any model
+    build; load_sd3_pipeline builds the tiny random-weight stack from the
+    shared flag surface."""
+    args = _args(["--random_weights", "--tiny_model",
+                  "--image_size", "256", "256", "--scheduler", "flow-euler"])
+    cfg = common.config_from_args(args)
+    pipe = common.load_sd3_pipeline(args, cfg)
+    from distrifuser_tpu.schedulers import FlowMatchEulerScheduler
+
+    assert isinstance(pipe.scheduler, FlowMatchEulerScheduler)
+    assert pipe.mmdit_config.sample_size == 32
+    with pytest.raises(SystemExit, match="model_path"):
+        common.load_sd3_pipeline(_args(["--scheduler", "flow-euler"]), cfg)
+    # the CLI guard itself (scripts/sd3_example.py): a diffusion scheduler
+    # on the flow model exits before touching any weights
+    import sd3_example
+
+    monkeypatch.setattr(sys, "argv", [
+        "sd3_example.py", "--random_weights", "--tiny_model",
+        "--scheduler", "ddim",
+    ])
+    with pytest.raises(SystemExit, match="flow-euler"):
+        sd3_example.main()
