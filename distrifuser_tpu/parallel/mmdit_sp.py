@@ -255,7 +255,13 @@ class MMDiTDenoiseRunner:
             compute_dtype,
         )
 
-    def _device_loop(self, params, latents, enc, pooled, gs, num_steps):
+    def _device_loop(self, params, latents, enc, pooled, gs, num_steps,
+                     start_step=0, end_step=None):
+        # end_step: exclusive stop index; start_step > 0 is the img2img
+        # entry (latents already noised to that schedule point via
+        # scheduler.add_noise) — warmup counts from the first step actually
+        # executed, the same convention as runner._device_loop
+        num_steps = num_steps if end_step is None else end_step
         cfg, mcfg = self.cfg, self.mcfg
         batch = latents.shape[0]
         step, bloc, compute_dtype = self._make_step(
@@ -266,30 +272,36 @@ class MMDiTDenoiseRunner:
         kv0 = self._kv0(bloc, compute_dtype)
 
         full_sync = cfg.mode == "full_sync" or not cfg.is_sp
-        n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
+        n_exec = num_steps - start_step
+        n_sync = n_exec if full_sync else min(cfg.warmup_steps + 1, n_exec)
 
         def sync_body(i, carry):
             x, ss, kv = carry
             return step(x, ss, kv, i, True)
 
-        x, sstate, kv = lax.fori_loop(0, n_sync, sync_body, (x, sstate, kv0))
+        x, sstate, kv = lax.fori_loop(
+            start_step, start_step + n_sync, sync_body, (x, sstate, kv0)
+        )
 
-        if n_sync < num_steps:
+        if start_step + n_sync < num_steps:
             def stale_body(carry, i):
                 x, ss, kv = carry
                 return step(x, ss, kv, i, False), None
 
             (x, _, _), _ = lax.scan(
-                stale_body, (x, sstate, kv), jnp.arange(n_sync, num_steps)
+                stale_body, (x, sstate, kv),
+                jnp.arange(start_step + n_sync, num_steps)
             )
         return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
 
     # ------------------------------------------------------------------
 
-    def _build(self, num_steps: int):
+    def _build(self, num_steps: int, start_step: int = 0,
+               end_step: int = None):
         cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
-        device_loop = partial(self._device_loop, num_steps=num_steps)
+        device_loop = partial(self._device_loop, num_steps=num_steps,
+                              start_step=start_step, end_step=end_step)
         lat_spec = P(DP_AXIS)
         enc_spec = P(None, DP_AXIS)
 
@@ -332,17 +344,24 @@ class MMDiTDenoiseRunner:
                 "per_step_collective_elems": int(per_step)}
 
     def generate(self, latents, enc, pooled, guidance_scale=5.0,
-                 num_inference_steps=20):
+                 num_inference_steps=20, start_step=0, end_step=None):
         """``latents`` [B, H/8, W/8, C] noise already scaled by
-        init_noise_sigma; ``enc`` [n_br, B, Lc, joint_dim]; ``pooled``
-        [n_br, B, pooled_dim].  Returns the denoised latent NHWC."""
+        init_noise_sigma — or, with ``start_step > 0`` (img2img), a clean
+        latent noised to that schedule point via ``scheduler.add_noise``;
+        ``enc`` [n_br, B, Lc, joint_dim]; ``pooled`` [n_br, B, pooled_dim].
+        Returns the denoised latent NHWC."""
+        assert 0 <= start_step < num_inference_steps, (start_step,
+                                                       num_inference_steps)
+        assert end_step is None or start_step < end_step <= num_inference_steps, (
+            start_step, end_step, num_inference_steps)
         self.scheduler.set_timesteps(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
-        if num_inference_steps not in self._compiled:
-            self._compiled[num_inference_steps] = self._build(
-                num_inference_steps
-            )
-        return self._compiled[num_inference_steps](
+        key = (num_inference_steps if start_step == 0 and end_step is None
+               else (num_inference_steps, start_step, end_step))
+        if key not in self._compiled:
+            self._compiled[key] = self._build(num_inference_steps,
+                                              start_step, end_step)
+        return self._compiled[key](
             self.params, latents, enc, jnp.asarray(pooled), gs
         )
 

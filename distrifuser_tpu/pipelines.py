@@ -145,6 +145,53 @@ def _scheduler_from_snapshot(root: str, name: str | BaseScheduler) -> BaseSchedu
     return get_scheduler(name, **kwargs)
 
 
+def _prepare_init_latents(cfg, scheduler, encode_image, vae_config, image,
+                          strength, num_inference_steps, n_prompts,
+                          num_images_per_prompt, seed):
+    """Shared img2img entry for every pipeline family: VAE-encode the init
+    image (with the SD3-family shift re-centering — zero for the legacy
+    families), noise it to the strength-offset schedule point, and return
+    (latents, start_step) for the tail-only denoise.
+
+    Canonical input range: uint8 [0, 255] or float [0, 1] (what
+    output_type="np" produces) — no value sniffing beyond the dtype.
+    Expansion is prompt-major, matching _batched_generate.  At least one
+    denoise step always runs (strength*steps < 1 would otherwise ask for
+    a zero-length schedule)."""
+    assert 0.0 < strength <= 1.0, strength
+    init_timestep = min(max(int(num_inference_steps * strength), 1),
+                        num_inference_steps)
+    start_step = num_inference_steps - init_timestep
+    arr = np.asarray(image)
+    arr = (arr.astype(np.float32) / 255.0 if arr.dtype == np.uint8
+           else arr.astype(np.float32))
+    if arr.ndim == 3:
+        arr = arr[None]
+    if arr.min() < 0.0 or arr.max() > 1.0:
+        raise ValueError(
+            "init image must be uint8 [0,255] or float [0,1] "
+            f"(got range [{arr.min():.3f}, {arr.max():.3f}])"
+        )
+    arr = arr * 2.0 - 1.0  # VAE input range [-1,1]
+    n_img = arr.shape[0]
+    assert n_img in (1, n_prompts), (
+        f"{n_img} init images for {n_prompts} prompts"
+    )
+    init = (
+        encode_image(jnp.asarray(arr)) - vae_config.shift_factor
+    ) * vae_config.scaling_factor
+    assert init.shape[1:3] == (cfg.latent_height, cfg.latent_width), (
+        f"init image encodes to {init.shape[1:3]}, config wants "
+        f"{(cfg.latent_height, cfg.latent_width)}"
+    )
+    if n_img == 1 and n_prompts > 1:
+        init = jnp.tile(init, (n_prompts, 1, 1, 1))
+    init = jnp.repeat(init, num_images_per_prompt, axis=0)
+    noise = jax.random.normal(jax.random.PRNGKey(seed), init.shape,
+                              jnp.float32)
+    return scheduler.add_noise(init, noise, start_step), start_step
+
+
 def _check_scheduler_family(scheduler: BaseScheduler, *, flow: bool,
                             family: str) -> None:
     """Reject scheduler/model-family mismatches LOUDLY at construction.
@@ -437,49 +484,14 @@ class _DistriPipelineBase:
 
         if image is not None:
             # img2img (beyond the reference, which is text2img-only):
-            # VAE-encode the init image, noise it to the strength-offset
-            # schedule point, and denoise only the remaining tail
-            # (diffusers Img2Img timestep convention).
+            # diffusers Img2Img timestep convention via the shared helper
             assert latents is None, "pass either image or latents, not both"
-            assert 0.0 < strength <= 1.0, strength
-            # at least one denoise step always runs (strength*steps < 1
-            # would otherwise ask for a zero-length schedule)
-            init_timestep = min(max(int(num_inference_steps * strength), 1),
-                                num_inference_steps)
-            start_step = num_inference_steps - init_timestep
-            # canonical input range: uint8 [0,255] or float [0,1] (the same
-            # range this pipeline's output_type="np" produces) — no value
-            # sniffing beyond the dtype
-            if np.asarray(image).dtype == np.uint8:
-                arr = np.asarray(image, np.float32) / 255.0
-            else:
-                arr = np.asarray(image, np.float32)
-            if arr.ndim == 3:
-                arr = arr[None]
-            if arr.min() < 0.0 or arr.max() > 1.0:
-                raise ValueError(
-                    "init image must be uint8 [0,255] or float [0,1] "
-                    f"(got range [{arr.min():.3f}, {arr.max():.3f}])"
-                )
-            arr = arr * 2.0 - 1.0  # VAE input range [-1,1]
-            n_img = arr.shape[0]
-            assert n_img in (1, len(prompts)), (
-                f"{n_img} init images for {len(prompts)} prompts"
+            latents, start_step = _prepare_init_latents(
+                cfg, self.scheduler,
+                lambda x: self._encode_image(self.vae_params, x),
+                self.vae_config, image, strength, num_inference_steps,
+                len(prompts), num_images_per_prompt, seed,
             )
-            init = self._encode_image(
-                self.vae_params, jnp.asarray(arr)
-            ) * self.vae_config.scaling_factor
-            assert init.shape[1:3] == (cfg.latent_height, cfg.latent_width), (
-                f"init image encodes to {init.shape[1:3]}, config wants "
-                f"{(cfg.latent_height, cfg.latent_width)}"
-            )
-            if n_img == 1 and len(prompts) > 1:
-                init = jnp.tile(init, (len(prompts), 1, 1, 1))
-            # prompt-major expansion, matching _batched_generate
-            init = jnp.repeat(init, num_images_per_prompt, axis=0)
-            noise = jax.random.normal(jax.random.PRNGKey(seed), init.shape,
-                                      jnp.float32)
-            latents = self.scheduler.add_noise(init, noise, start_step)
 
         # SDXL micro-conditioning pass-through (diffusers kwargs the
         # reference forwards, pipelines.py:47-58); SD 1.x/2.x ignores it
@@ -1107,6 +1119,9 @@ class DistriSD3Pipeline:
         self.runner = MMDiTDenoiseRunner(cfg, mmdit_config, mmdit_params,
                                          scheduler)
         self._decode, self.vae_decode_parallel = _build_decoder(cfg, vae_config)
+        self._encode_image = jax.jit(
+            lambda prm, x: vae_mod.encode(prm, vae_config, x)
+        )
         self._clip_jitted = [
             jax.jit(lambda prm, ids, _cfg=ccfg: clip_mod.clip_text_forward(
                 prm, _cfg, ids))
@@ -1290,6 +1305,8 @@ class DistriSD3Pipeline:
         output_type: str = "pil",
         latents=None,
         num_images_per_prompt: int = 1,
+        image=None,
+        strength: float = 0.8,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -1311,11 +1328,25 @@ class DistriSD3Pipeline:
         )
         self.scheduler.set_timesteps(num_inference_steps)
 
+        start_step = 0
+        if image is not None:
+            # img2img under rectified flow: the flow add_noise interpolates
+            # to the strength-offset sigma — same timestep convention and
+            # shared helper as the UNet pipelines' img2img path
+            assert latents is None, "pass either image or latents, not both"
+            latents, start_step = _prepare_init_latents(
+                cfg, self.scheduler,
+                lambda x: self._encode_image(self.vae_params, x),
+                self.vae_config, image, strength, num_inference_steps,
+                len(prompts), num_images_per_prompt, seed,
+            )
+
         def run_chunk(cp, cn, cl, _n_real):
             enc, pooled = self._encode(cp, cn)
             return self.runner.generate(
                 cl, enc, pooled, guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps,
+                start_step=start_step,
             )
 
         latent = _batched_generate(

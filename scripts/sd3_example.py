@@ -11,6 +11,7 @@ import argparse
 from common import (
     add_distri_args,
     config_from_args,
+    img2img_kwargs,
     is_main_process,
     load_sd3_pipeline,
     save_images,
@@ -32,6 +33,7 @@ def main():
             "produces meaningful samples"
         )
 
+    i2i = img2img_kwargs(args)  # loads --init_image before the model
     distri_config = config_from_args(args)
     pipeline = load_sd3_pipeline(args, distri_config)
     pipeline.set_progress_bar_config(disable=not is_main_process())
@@ -43,6 +45,7 @@ def main():
         seed=args.seed,
         output_type=args.output_type,
         num_images_per_prompt=args.num_images_per_prompt,
+        **i2i,
     )
     save_images(output, args)
 

@@ -141,3 +141,23 @@ def test_scheduler_family_guards(devices8):
             [tc], [icp(jax.random.PRNGKey(2), tc)],
             scheduler="flow-euler",
         )
+
+
+def test_sd3_img2img_strength(devices8):
+    """img2img under rectified flow: low strength stays near the init
+    latent, full strength ignores it (the SD-pipeline contract on the
+    flow interpolant)."""
+    from distrifuser_tpu.models import vae as vae_mod
+
+    pipe, dcfg = build_sd3_pipeline(devices8, 1)
+    rng = np.random.RandomState(8)
+    im = rng.rand(64, 64, 3).astype(np.float32)
+    init = np.asarray((vae_mod.encode(
+        pipe.vae_params, pipe.vae_config, jnp.asarray((im * 2 - 1)[None])
+    ) - pipe.vae_config.shift_factor) * pipe.vae_config.scaling_factor)
+    kw = dict(num_inference_steps=8, output_type="latent", seed=3)
+    d = {}
+    for s in (0.25, 1.0):
+        out = pipe("a cabin", image=im, strength=s, **kw).images[0]
+        d[s] = float(np.abs(out - init[0]).mean())
+    assert d[0.25] < d[1.0], d
