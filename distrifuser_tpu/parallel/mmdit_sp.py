@@ -52,7 +52,7 @@ from ..models import mmdit as mm
 from ..models.mmdit import MMDiTConfig
 from ..ops.linear import linear
 from ..schedulers import BaseScheduler
-from ..utils.config import DP_AXIS, SP_AXIS, DistriConfig
+from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import all_gather_seq
 from .guidance import branch_select, combine_guidance
 
@@ -105,6 +105,8 @@ class MMDiTDenoiseRunner:
                 f"MMDiTConfig.sample_size is {mmdit_config.sample_size}"
             )
         self._compiled: Dict[int, Any] = {}
+        # compiled-loop per-step callback target (_build_fused_callback)
+        self._active_callback = None
 
     # ------------------------------------------------------------------
 
@@ -261,8 +263,8 @@ class MMDiTDenoiseRunner:
         # entry (latents already noised to that schedule point via
         # scheduler.add_noise) — warmup counts from the first step actually
         # executed, the same convention as runner._device_loop
-        num_steps = num_steps if end_step is None else end_step
         cfg, mcfg = self.cfg, self.mcfg
+        num_steps, n_sync = self._exec_window(num_steps, start_step, end_step)
         batch = latents.shape[0]
         step, bloc, compute_dtype = self._make_step(
             params, enc, pooled, gs, batch
@@ -270,10 +272,6 @@ class MMDiTDenoiseRunner:
         x = dit_mod.patchify(mcfg, latents.astype(jnp.float32))
         sstate = self.scheduler.init_state(x.shape)
         kv0 = self._kv0(bloc, compute_dtype)
-
-        full_sync = cfg.mode == "full_sync" or not cfg.is_sp
-        n_exec = num_steps - start_step
-        n_sync = n_exec if full_sync else min(cfg.warmup_steps + 1, n_exec)
 
         def sync_body(i, carry):
             x, ss, kv = carry
@@ -316,6 +314,155 @@ class MMDiTDenoiseRunner:
 
         return jax.jit(loop)
 
+    # ------------------------------------------------------------------
+    # per-step (uncompiled-loop) mode + compiled-loop callbacks
+    # ------------------------------------------------------------------
+
+    def _token_specs(self):
+        """(x_spec, kv_spec, ss_spec, enc_spec) for the stepwise boundary:
+        patchified tokens shard over dp on batch; the stale KV varies per
+        device and stacks on a fresh leading (dp, cfg, sp) axis; scheduler
+        state shards x-shaped leaves over dp, scalars replicate."""
+        lat_spec = P(DP_AXIS)
+        kv_spec = P((DP_AXIS, CFG_AXIS, SP_AXIS))
+        mcfg = self.mcfg
+        ss_shapes = self.scheduler.init_state(
+            (1, mcfg.num_tokens, mcfg.token_dim)
+        )
+        ss_spec = jax.tree.map(
+            lambda l: P(DP_AXIS) if jnp.ndim(l) >= 3 else P(), ss_shapes
+        )
+        return lat_spec, kv_spec, ss_spec, P(None, DP_AXIS)
+
+    def _make_stepper(self, phase_sync: bool):
+        """Un-jitted shard_map'd single step over PATCHIFIED tokens
+        [B, N, token_dim] (global-array signature): the host loop and the
+        compiled-callback loop both drive it."""
+        cfg = self.cfg
+        x_spec, kv_spec, ss_spec, enc_spec = self._token_specs()
+
+        def device_step(params, s, x, kv, sstate, enc, pooled, gs):
+            step, _, _ = self._make_step(params, enc, pooled, gs, x.shape[0])
+            x, sstate, kv_new = step(x, sstate, kv[0], s, phase_sync)
+            return x, sstate, kv_new[None]
+
+        def stepper(params, s, x, kv, sstate, enc, pooled, gs):
+            return shard_map(
+                device_step,
+                mesh=cfg.mesh,
+                in_specs=(P(), P(), x_spec, kv_spec, ss_spec, enc_spec,
+                          enc_spec, P()),
+                out_specs=(x_spec, ss_spec, kv_spec),
+                check_vma=False,
+            )(params, s, x, kv, sstate, enc, pooled, gs)
+
+        return stepper
+
+    def _kv0_global(self, batch):
+        """Global stepwise-layout zeros: per-device _kv0 stacked over every
+        mesh device on a fresh leading axis."""
+        cfg = self.cfg
+        n_total = cfg.mesh.devices.size
+        bloc = (1 if cfg.cfg_split or not cfg.do_classifier_free_guidance
+                else 2) * (batch // cfg.dp_degree)
+        per_dev = self._kv0(bloc, self.params["proj_in"]["kernel"].dtype)
+        return jnp.zeros((n_total,) + per_dev.shape, per_dev.dtype)
+
+    def _exec_window(self, num_steps, start_step, end_step):
+        num_exec_end = num_steps if end_step is None else end_step
+        full_sync = self.cfg.mode == "full_sync" or not self.cfg.is_sp
+        n_exec = num_exec_end - start_step
+        n_sync = n_exec if full_sync else min(self.cfg.warmup_steps + 1,
+                                              n_exec)
+        return num_exec_end, n_sync
+
+    def _generate_stepwise(self, latents, enc, pooled, gs, num_steps,
+                           start_step=0, end_step=None, callback=None):
+        """Python loop over per-step compiled calls (use_cuda_graph=False
+        parity, same contract as DenoiseRunner._generate_stepwise):
+        identical numerics to the fused loop, per-step latency visible
+        from the host, diffusers legacy ``callback(i, t, latents)``."""
+        cfg, mcfg = self.cfg, self.mcfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        num_exec_end, n_sync = self._exec_window(num_steps, start_step,
+                                                 end_step)
+        x = dit_mod.patchify(mcfg, jnp.asarray(latents, jnp.float32))
+        sstate = sched.init_state(x.shape)
+        kv = self._kv0_global(latents.shape[0])
+        pooled = jnp.asarray(pooled)
+        # keyed by num_steps: _make_step bakes the scheduler tables at
+        # trace time, so a different step count MUST get a fresh program
+        # (same convention as DenoiseRunner's ("stepwise", num_steps))
+        fns = self._compiled.setdefault(("stepwise", num_steps), {})
+        for i in range(start_step, num_exec_end):
+            sync = i < start_step + n_sync
+            if sync not in fns:
+                fns[sync] = jax.jit(self._make_stepper(sync),
+                                    donate_argnums=(3,))
+            x, sstate, kv = fns[sync](
+                self.params, jnp.asarray(i), x, kv, sstate, enc, pooled, gs,
+            )
+            if callback is not None:
+                callback(i, sched.timesteps()[i],
+                         dit_mod.unpatchify(mcfg, x, mcfg.out_channels))
+        return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+    def _fire_callback(self, i, t, x):
+        """Host trampoline for the compiled-loop callback (io_callback)."""
+        cb = self._active_callback
+        if cb is not None:
+            cb(int(i), t, x)
+
+    def _build_fused_callback(self, num_steps: int, start_step: int = 0,
+                              end_step: int = None):
+        """Compiled loop that fires per-step host callbacks — the MMDiT
+        analog of DenoiseRunner._build_fused_callback: lax.scan over the
+        shard_map'd stepwise step with ordered io_callback shipping the
+        GLOBAL unpatchified latents after each step (scan for both
+        segments; ordered effects are unsupported in fori bodies)."""
+        from jax.experimental import io_callback
+
+        cfg, mcfg = self.cfg, self.mcfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        num_exec_end, n_sync = self._exec_window(num_steps, start_step,
+                                                 end_step)
+        sync_step = self._make_stepper(True)
+        stale_step = self._make_stepper(False)
+
+        def loop(params, latents, enc, pooled, gs):
+            x = dit_mod.patchify(mcfg, latents.astype(jnp.float32))
+            sstate = sched.init_state(x.shape)
+            kv = self._kv0_global(latents.shape[0])
+            tsteps = sched.timesteps()
+
+            def body_for(step_fn):
+                def body(carry, i):
+                    x, kv, ss = carry
+                    x, ss, kv = step_fn(params, i, x, kv, ss, enc, pooled,
+                                        gs)
+                    io_callback(
+                        self._fire_callback, None, i, tsteps[i],
+                        dit_mod.unpatchify(mcfg, x, mcfg.out_channels),
+                        ordered=True,
+                    )
+                    return (x, kv, ss), None
+                return body
+
+            (x, kv, sstate), _ = lax.scan(
+                body_for(sync_step), (x, kv, sstate),
+                jnp.arange(start_step, start_step + n_sync),
+            )
+            if start_step + n_sync < num_exec_end:
+                (x, kv, sstate), _ = lax.scan(
+                    body_for(stale_step), (x, kv, sstate),
+                    jnp.arange(start_step + n_sync, num_exec_end),
+                )
+            return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+        return jax.jit(loop)
+
     def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
         """Per-device stale-state and per-step collective volumes (elements)
         for the configured joint layout — closed-form, no tracing."""
@@ -344,18 +491,44 @@ class MMDiTDenoiseRunner:
                 "per_step_collective_elems": int(per_step)}
 
     def generate(self, latents, enc, pooled, guidance_scale=5.0,
-                 num_inference_steps=20, start_step=0, end_step=None):
+                 num_inference_steps=20, start_step=0, end_step=None,
+                 callback=None):
         """``latents`` [B, H/8, W/8, C] noise already scaled by
         init_noise_sigma — or, with ``start_step > 0`` (img2img), a clean
         latent noised to that schedule point via ``scheduler.add_noise``;
         ``enc`` [n_br, B, Lc, joint_dim]; ``pooled`` [n_br, B, pooled_dim].
-        Returns the denoised latent NHWC."""
+        ``callback(i, t, latents)`` (diffusers legacy signature) fires
+        after every step in every mode — from the host loop with
+        use_cuda_graph=False, via ordered io_callback inside the compiled
+        loop otherwise.  Returns the denoised latent NHWC."""
         assert 0 <= start_step < num_inference_steps, (start_step,
                                                        num_inference_steps)
         assert end_step is None or start_step < end_step <= num_inference_steps, (
             start_step, end_step, num_inference_steps)
         self.scheduler.set_timesteps(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
+        if not self.cfg.use_compiled_step:
+            return self._generate_stepwise(
+                jnp.asarray(latents), enc, pooled, gs, num_inference_steps,
+                start_step, end_step, callback,
+            )
+        if callback is not None:
+            key = ("fused_cb", num_inference_steps, start_step, end_step)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_fused_callback(
+                    num_inference_steps, start_step, end_step
+                )
+            self._active_callback = callback
+            try:
+                out = self._compiled[key](
+                    self.params, jnp.asarray(latents), enc,
+                    jnp.asarray(pooled), gs,
+                )
+                jax.effects_barrier()  # host callbacks drain before return
+                jax.block_until_ready(out)
+                return out
+            finally:
+                self._active_callback = None
         key = (num_inference_steps if start_step == 0 and end_step is None
                else (num_inference_steps, start_step, end_step))
         if key not in self._compiled:
@@ -366,7 +539,10 @@ class MMDiTDenoiseRunner:
         )
 
     def prepare(self, num_steps: int) -> None:
-        """Pre-build exactly the program generate() will dispatch to."""
+        """Pre-build exactly the program generate() will dispatch to
+        (per-step programs build lazily, like DenoiseRunner.prepare)."""
+        if not self.cfg.use_compiled_step:
+            return
         self.scheduler.set_timesteps(num_steps)
         if num_steps not in self._compiled:
             self._compiled[num_steps] = self._build(num_steps)

@@ -1307,6 +1307,7 @@ class DistriSD3Pipeline:
         num_images_per_prompt: int = 1,
         image=None,
         strength: float = 0.8,
+        callback=None,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -1341,12 +1342,17 @@ class DistriSD3Pipeline:
                 len(prompts), num_images_per_prompt, seed,
             )
 
-        def run_chunk(cp, cn, cl, _n_real):
+        def run_chunk(cp, cn, cl, n_real):
             enc, pooled = self._encode(cp, cn)
+            # diffusers legacy callback(step, timestep, latents); padded
+            # tail rows stripped before the user sees them
+            cb = (None if callback is None
+                  else (lambda i, t, x: callback(i, t, x[:n_real])))
             return self.runner.generate(
                 cl, enc, pooled, guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps,
                 start_step=start_step,
+                callback=cb,
             )
 
         latent = _batched_generate(

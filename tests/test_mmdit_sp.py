@@ -239,8 +239,10 @@ def test_no_sync_mode():
 
 def test_rejected_knobs_and_geometry():
     mcfg, params = make_model()
-    with pytest.raises(ValueError, match="gather"):
-        MMDiTDenoiseRunner(sp_config(4, do_cfg=False, attn_impl="ring"),
+    # head-sharding layouts are undefined for joint attention's two-origin
+    # queries; ring/gather are the supported pair
+    with pytest.raises(ValueError, match="two-origin"):
+        MMDiTDenoiseRunner(sp_config(4, do_cfg=False, attn_impl="ulysses"),
                            mcfg, params, get_scheduler("flow-euler"))
     with pytest.raises(ValueError, match="comm_batch"):
         MMDiTDenoiseRunner(sp_config(4, do_cfg=False, comm_batch=True),
@@ -367,3 +369,83 @@ def test_start_step_matches_offset_dense():
     with pytest.raises(AssertionError):
         runner_d.generate(lat, enc, pooled, num_inference_steps=4,
                           start_step=4)
+
+
+def test_stepwise_matches_fused():
+    """use_cuda_graph=False parity for the MMDiT runner: the host-driven
+    per-step programs equal the fused loop in displaced, ring, and
+    full_sync configs."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=4)
+    for extra in ({}, {"attn_impl": "ring"}, {"mode": "full_sync"}):
+        fused = MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, **extra),
+            mcfg, params, get_scheduler("flow-euler"))
+        stepw = MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False,
+                      **extra),
+            mcfg, params, get_scheduler("flow-euler"))
+        a = np.asarray(fused.generate(lat, enc, pooled, **kw))
+        b = np.asarray(stepw.generate(lat, enc, pooled, **kw))
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=str(extra))
+
+
+def test_callback_all_modes():
+    """The diffusers legacy callback fires with identical count, order,
+    timesteps, and latents from the host loop and from inside the
+    compiled loop (ordered io_callback)."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+
+    def run(runner, **kw):
+        seen = []
+        out = runner.generate(
+            lat, enc, pooled, guidance_scale=1.0, num_inference_steps=4,
+            callback=lambda i, t, x: seen.append(
+                (int(i), float(t), np.array(x, copy=True))),
+            **kw,
+        )
+        return seen, np.asarray(out)
+
+    stepw = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False),
+        mcfg, params, get_scheduler("flow-euler"))
+    fused = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1),
+        mcfg, params, get_scheduler("flow-euler"))
+    s_seen, s_out = run(stepw)
+    f_seen, f_out = run(fused)
+    assert [i for i, _, _ in s_seen] == [0, 1, 2, 3]
+    assert [i for i, _, _ in f_seen] == [i for i, _, _ in s_seen]
+    assert [t for _, t, _ in f_seen] == [t for _, t, _ in s_seen]
+    for (_, _, xa), (_, _, xb) in zip(f_seen, s_seen):
+        np.testing.assert_allclose(xa, xb, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(f_out, s_out, atol=2e-4, rtol=2e-4)
+    # the last callback sees exactly the returned latents
+    np.testing.assert_allclose(f_seen[-1][2], f_out, atol=0)
+    # img2img window: callbacks start at start_step
+    o_seen, _ = run(fused, start_step=2)
+    assert [i for i, _, _ in o_seen] == [2, 3]
+
+
+def test_stepwise_retables_on_step_count_change():
+    """A second stepwise generate with a DIFFERENT step count must not
+    reuse the first call's baked scheduler tables (code-review r5: the
+    stepwise cache is keyed by num_steps)."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    stepw = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False),
+        mcfg, params, get_scheduler("flow-euler"))
+    fused = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1),
+        mcfg, params, get_scheduler("flow-euler"))
+    kw = dict(guidance_scale=1.0)
+    stepw.generate(lat, enc, pooled, num_inference_steps=3, **kw)  # bake 3
+    b = np.asarray(stepw.generate(lat, enc, pooled, num_inference_steps=6,
+                                  **kw))
+    a = np.asarray(fused.generate(lat, enc, pooled, num_inference_steps=6,
+                                  **kw))
+    np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)

@@ -161,3 +161,18 @@ def test_sd3_img2img_strength(devices8):
         out = pipe("a cabin", image=im, strength=s, **kw).images[0]
         d[s] = float(np.abs(out - init[0]).mean())
     assert d[0.25] < d[1.0], d
+
+
+def test_sd3_pipeline_callback(devices8):
+    """Pipeline-level callback (default compiled mode): fires per step with
+    padded tail rows stripped."""
+    pipe, dcfg = build_sd3_pipeline(devices8, 2)
+    seen = []
+    out = pipe("a fox", num_inference_steps=3, output_type="latent", seed=1,
+               callback=lambda i, t, x: seen.append((i, float(t), x.shape)))
+    assert [i for i, _, _ in seen] == [0, 1, 2]
+    ts = [t for _, t, _ in seen]
+    assert ts == sorted(ts, reverse=True)
+    assert all(s == (1, dcfg.latent_height, dcfg.latent_width, 4)
+               for _, _, s in seen)
+    assert np.isfinite(out.images[0]).all()
