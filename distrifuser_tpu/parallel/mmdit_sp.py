@@ -376,6 +376,25 @@ class MMDiTDenoiseRunner:
                                               n_exec)
         return num_exec_end, n_sync
 
+    def _ensure_stepper(self, num_steps: int, sync: bool):
+        """Jitted per-step program, cached by (num_steps, phase): _make_step
+        bakes the scheduler tables at trace time, so a different step count
+        MUST get a fresh program (same convention as DenoiseRunner's
+        ("stepwise", num_steps))."""
+        fns = self._compiled.setdefault(("stepwise", num_steps), {})
+        if sync not in fns:
+            fns[sync] = jax.jit(self._make_stepper(sync), donate_argnums=(3,))
+        return fns[sync]
+
+    def _ensure_stale_scan(self, num_steps: int):
+        """Hybrid mode's fused stale-only program for the default execution
+        window (mirrors DenoiseRunner._ensure_stale_scan)."""
+        n_sync = min(self.cfg.warmup_steps + 1, num_steps)
+        skey = ("stale_scan", num_steps, n_sync)
+        if skey not in self._compiled:
+            self._compiled[skey] = self._build_stale_scan(num_steps, n_sync)
+        return self._compiled[skey], n_sync
+
     def _generate_stepwise(self, latents, enc, pooled, gs, num_steps,
                            start_step=0, end_step=None, callback=None):
         """Python loop over per-step compiled calls (use_cuda_graph=False
@@ -391,22 +410,73 @@ class MMDiTDenoiseRunner:
         sstate = sched.init_state(x.shape)
         kv = self._kv0_global(latents.shape[0])
         pooled = jnp.asarray(pooled)
-        # keyed by num_steps: _make_step bakes the scheduler tables at
-        # trace time, so a different step count MUST get a fresh program
-        # (same convention as DenoiseRunner's ("stepwise", num_steps))
-        fns = self._compiled.setdefault(("stepwise", num_steps), {})
         for i in range(start_step, num_exec_end):
             sync = i < start_step + n_sync
-            if sync not in fns:
-                fns[sync] = jax.jit(self._make_stepper(sync),
-                                    donate_argnums=(3,))
-            x, sstate, kv = fns[sync](
+            x, sstate, kv = self._ensure_stepper(num_steps, sync)(
                 self.params, jnp.asarray(i), x, kv, sstate, enc, pooled, gs,
             )
             if callback is not None:
                 callback(i, sched.timesteps()[i],
                          dit_mod.unpatchify(mcfg, x, mcfg.out_channels))
         return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+    def _build_stale_scan(self, num_steps: int, n_start: int):
+        """Fused stale steady-state ONLY (cfg.hybrid_loop; the MMDiT analog
+        of DenoiseRunner._build_stale_scan): the sync warmup runs through
+        the per-step programs, their KV state enters here across the
+        shard_map boundary in the stepwise layout, and this ONE-body
+        program scans the remaining stale steps — roughly half the fully
+        fused program's (remote) compile at identical numerics."""
+        cfg = self.cfg
+        self.scheduler.set_timesteps(num_steps)
+        x_spec, kv_spec, ss_spec, enc_spec = self._token_specs()
+
+        def device_scan(params, x, kv, sstate, enc, pooled, gs):
+            step, _, _ = self._make_step(params, enc, pooled, gs, x.shape[0])
+
+            def body(carry, i):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, False), None
+
+            (x, _, _), _ = lax.scan(
+                body, (x, sstate, kv[0]), jnp.arange(n_start, num_steps)
+            )
+            return x
+
+        def loop(params, x, kv, sstate, enc, pooled, gs):
+            return shard_map(
+                device_scan,
+                mesh=cfg.mesh,
+                in_specs=(P(), x_spec, kv_spec, ss_spec, enc_spec, enc_spec,
+                          P()),
+                out_specs=x_spec,
+                check_vma=False,
+            )(params, x, kv, sstate, enc, pooled, gs)
+
+        # x and the incoming state die at this call; let XLA reuse the HBM
+        return jax.jit(loop, donate_argnums=(1, 2))
+
+    def _hybrid_dispatch(self, num_steps: int) -> bool:
+        cfg = self.cfg
+        return (cfg.hybrid_loop and cfg.is_sp and cfg.mode != "full_sync"
+                and min(cfg.warmup_steps + 1, num_steps) < num_steps)
+
+    def _generate_hybrid(self, latents, enc, pooled, gs, num_steps):
+        """Sync warmup via per-step programs + one fused stale-only scan."""
+        cfg, mcfg = self.cfg, self.mcfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        stale_scan, n_sync = self._ensure_stale_scan(num_steps)
+        x = dit_mod.patchify(mcfg, jnp.asarray(latents, jnp.float32))
+        sstate = sched.init_state(x.shape)
+        kv = self._kv0_global(latents.shape[0])
+        pooled = jnp.asarray(pooled)
+        for i in range(n_sync):
+            x, sstate, kv = self._ensure_stepper(num_steps, True)(
+                self.params, jnp.asarray(i), x, kv, sstate, enc, pooled, gs,
+            )
+        out = stale_scan(self.params, x, kv, sstate, enc, pooled, gs)
+        return dit_mod.unpatchify(mcfg, out, mcfg.out_channels)
 
     def _fire_callback(self, i, t, x):
         """Host trampoline for the compiled-loop callback (io_callback)."""
@@ -529,6 +599,11 @@ class MMDiTDenoiseRunner:
                 return out
             finally:
                 self._active_callback = None
+        if (self._hybrid_dispatch(num_inference_steps)
+                and start_step == 0 and end_step is None):
+            return self._generate_hybrid(
+                jnp.asarray(latents), enc, pooled, gs, num_inference_steps
+            )
         key = (num_inference_steps if start_step == 0 and end_step is None
                else (num_inference_steps, start_step, end_step))
         if key not in self._compiled:
@@ -540,9 +615,13 @@ class MMDiTDenoiseRunner:
 
     def prepare(self, num_steps: int) -> None:
         """Pre-build exactly the program generate() will dispatch to
-        (per-step programs build lazily, like DenoiseRunner.prepare)."""
+        (per-step programs build lazily, like DenoiseRunner.prepare;
+        hybrid mode pre-builds the big stale-scan program)."""
         if not self.cfg.use_compiled_step:
             return
         self.scheduler.set_timesteps(num_steps)
+        if self._hybrid_dispatch(num_steps):
+            self._ensure_stale_scan(num_steps)
+            return
         if num_steps not in self._compiled:
             self._compiled[num_steps] = self._build(num_steps)

@@ -449,3 +449,68 @@ def test_stepwise_retables_on_step_count_change():
     a = np.asarray(fused.generate(lat, enc, pooled, num_inference_steps=6,
                                   **kw))
     np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+
+def test_hybrid_matches_fused():
+    """hybrid_loop (per-step sync warmup + fused stale-only scan) equals
+    the fully fused loop for both KV layouts — the compile-time-resilient
+    execution of the same program, completing the knob across all four
+    runners."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=5)
+    for extra in ({}, {"attn_impl": "ring"}):
+        fused = MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, **extra),
+            mcfg, params, get_scheduler("flow-euler"))
+        hybrid = MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, hybrid_loop=True,
+                      **extra),
+            mcfg, params, get_scheduler("flow-euler"))
+        hybrid.prepare(5)  # the pre-built program is what dispatches
+        a = np.asarray(fused.generate(lat, enc, pooled, **kw))
+        b = np.asarray(hybrid.generate(lat, enc, pooled, **kw))
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=str(extra))
+    # all-sync short runs fall back to the fused path inside hybrid
+    hybrid2 = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=3, hybrid_loop=True),
+        mcfg, params, get_scheduler("flow-euler"))
+    fused2 = MMDiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=3),
+        mcfg, params, get_scheduler("flow-euler"))
+    a2 = np.asarray(fused2.generate(lat, enc, pooled, guidance_scale=1.0,
+                                    num_inference_steps=2))
+    b2 = np.asarray(hybrid2.generate(lat, enc, pooled, guidance_scale=1.0,
+                                     num_inference_steps=2))
+    np.testing.assert_allclose(a2, b2, atol=2e-4, rtol=2e-4)
+
+
+def test_stepwise_cfg_modes_match_fused():
+    """The stepwise boundary adds CFG-dependent machinery the fused path
+    never had (_kv0_global branch doubling, CFG_AXIS in the kv spec) —
+    pin folded-CFG and cfg_split stepwise against their fused twins
+    (code-review r5)."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=4.0, num_inference_steps=3)
+    configs = [
+        # folded CFG: both branches ride the batch dim (bloc doubling)
+        dict(devices=jax.devices()[:2], height=256, width=256,
+             do_classifier_free_guidance=True, split_batch=False,
+             warmup_steps=1),
+        # cfg_split: one branch per device group (CFG_AXIS in kv_spec)
+        dict(devices=jax.devices()[:8], height=256, width=256,
+             do_classifier_free_guidance=True, split_batch=True,
+             warmup_steps=1),
+    ]
+    for ckw in configs:
+        fused = MMDiTDenoiseRunner(DistriConfig(**ckw), mcfg, params,
+                                   get_scheduler("flow-euler"))
+        stepw = MMDiTDenoiseRunner(
+            DistriConfig(use_cuda_graph=False, **ckw), mcfg, params,
+            get_scheduler("flow-euler"))
+        a = np.asarray(fused.generate(lat, enc, pooled, **kw))
+        b = np.asarray(stepw.generate(lat, enc, pooled, **kw))
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=str(ckw["split_batch"]))
