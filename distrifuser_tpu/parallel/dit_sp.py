@@ -123,6 +123,8 @@ class DiTDenoiseRunner:
                 f"DiTConfig.sample_size is {dit_config.sample_size}"
             )
         self._compiled: Dict[int, Any] = {}
+        # compiled-loop per-step callback target (_build_fused_callback)
+        self._active_callback = None
 
     # ------------------------------------------------------------------
 
@@ -435,19 +437,7 @@ class DiTDenoiseRunner:
         cfg, dcfg = self.cfg, self.dcfg
         self.scheduler.set_timesteps(num_steps)
         n_sync = min(cfg.warmup_steps + 1, num_steps)
-        lat_spec = P(DP_AXIS)
-        enc_spec = P(None, DP_AXIS)
-        seq = (self.seq_axes if isinstance(self.seq_axes, tuple)
-               else (self.seq_axes,))
-        kv_spec = P((DP_AXIS, CFG_AXIS) + seq)  # usp mesh has sp_u/sp_r
-        # scheduler-state leaves: x-shaped (batch-led, ndim>=3) shard over
-        # dp; scalars (DPM's lambda_prev/have_prev) replicate
-        ss_shapes = self.scheduler.init_state(
-            (1, dcfg.num_tokens, dcfg.token_dim)
-        )
-        ss_spec = jax.tree.map(
-            lambda l: P(DP_AXIS) if jnp.ndim(l) >= 3 else P(), ss_shapes
-        )
+        lat_spec, kv_spec, ss_spec, enc_spec = self._token_specs()
 
         def device_sync(params, latents, enc, cap_mask, gs):
             batch = latents.shape[0]
@@ -496,6 +486,146 @@ class DiTDenoiseRunner:
         )(p, x, ss, kv, e, m, g), donate_argnums=(1, 2, 3))
         return sync, stale
 
+    # ------------------------------------------------------------------
+    # per-step (uncompiled-loop) mode + compiled-loop callbacks
+    # ------------------------------------------------------------------
+
+    def _token_specs(self):
+        """(x_spec, kv_spec, ss_spec, enc_spec) for the stepwise boundary —
+        the same layout _build_hybrid documents: tokens/scheduler state
+        replicated within a dp group, the per-device stale KV stacked on a
+        fresh leading (dp, cfg, sp...) axis."""
+        seq = (self.seq_axes if isinstance(self.seq_axes, tuple)
+               else (self.seq_axes,))
+        kv_spec = P((DP_AXIS, CFG_AXIS) + seq)
+        ss_shapes = self.scheduler.init_state(
+            (1, self.dcfg.num_tokens, self.dcfg.token_dim)
+        )
+        ss_spec = jax.tree.map(
+            lambda l: P(DP_AXIS) if jnp.ndim(l) >= 3 else P(), ss_shapes
+        )
+        return P(DP_AXIS), kv_spec, ss_spec, P(None, DP_AXIS)
+
+    def _make_stepper(self, phase_sync: bool):
+        """Un-jitted shard_map'd single step over PATCHIFIED tokens
+        [B, N, token_dim] (global-array signature)."""
+        x_spec, kv_spec, ss_spec, enc_spec = self._token_specs()
+
+        def device_step(params, s, x, kv, sstate, enc, cap_mask, gs):
+            step, _, _ = self._make_step(params, enc, cap_mask, gs,
+                                         x.shape[0])
+            x, sstate, kv_new = step(x, sstate, kv[0], s, phase_sync)
+            return x, sstate, kv_new[None]
+
+        def stepper(params, s, x, kv, sstate, enc, cap_mask, gs):
+            return shard_map(
+                device_step,
+                mesh=self.mesh,
+                in_specs=(P(), P(), x_spec, kv_spec, ss_spec, enc_spec,
+                          enc_spec, P()),
+                out_specs=(x_spec, ss_spec, kv_spec),
+                check_vma=False,
+            )(params, s, x, kv, sstate, enc, cap_mask, gs)
+
+        return stepper
+
+    def _ensure_stepper(self, num_steps: int, sync: bool):
+        """Jitted per-step program cached by (num_steps, phase) — the
+        scheduler tables bake at trace time (same convention as the UNet
+        and MMDiT runners)."""
+        fns = self._compiled.setdefault(("stepwise", num_steps), {})
+        if sync not in fns:
+            fns[sync] = jax.jit(self._make_stepper(sync), donate_argnums=(3,))
+        return fns[sync]
+
+    def _kv0_global(self, batch):
+        """Global stepwise-layout zeros: per-device _kv0 stacked over every
+        mesh device on a fresh leading axis."""
+        cfg = self.cfg
+        n_total = self.mesh.devices.size
+        bloc = (1 if cfg.cfg_split or not cfg.do_classifier_free_guidance
+                else 2) * (batch // cfg.dp_degree)
+        per_dev = self._kv0(bloc, self.params["proj_in"]["kernel"].dtype)
+        return jnp.zeros((n_total,) + per_dev.shape, per_dev.dtype)
+
+    def _exec_phases(self, num_steps: int):
+        full_sync = self.cfg.mode == "full_sync" or not self.cfg.is_sp
+        return (num_steps if full_sync
+                else min(self.cfg.warmup_steps + 1, num_steps))
+
+    def _generate_stepwise(self, latents, enc, cap_mask, gs, num_steps,
+                           callback=None):
+        """Python loop over per-step compiled calls (use_cuda_graph=False
+        parity): same numerics as the fused loop, per-step latency visible
+        from the host, diffusers legacy ``callback(i, t, latents)``."""
+        cfg, dcfg = self.cfg, self.dcfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        n_sync = self._exec_phases(num_steps)
+        x = dit_mod.patchify(dcfg, jnp.asarray(latents, jnp.float32))
+        sstate = sched.init_state(x.shape)
+        kv = self._kv0_global(latents.shape[0])
+        for i in range(num_steps):
+            x, sstate, kv = self._ensure_stepper(num_steps, i < n_sync)(
+                self.params, jnp.asarray(i), x, kv, sstate, enc, cap_mask,
+                gs,
+            )
+            if callback is not None:
+                callback(i, sched.timesteps()[i],
+                         dit_mod.unpatchify(dcfg, x, dcfg.in_channels))
+        return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+    def _fire_callback(self, i, t, x):
+        """Host trampoline for the compiled-loop callback (io_callback)."""
+        cb = self._active_callback
+        if cb is not None:
+            cb(int(i), t, x)
+
+    def _build_fused_callback(self, num_steps: int):
+        """Compiled loop that fires per-step host callbacks: lax.scan over
+        the shard_map'd stepwise step with ordered io_callback shipping the
+        GLOBAL unpatchified latents after each step (scan for both
+        segments; ordered effects are unsupported in fori bodies)."""
+        from jax.experimental import io_callback
+
+        cfg, dcfg = self.cfg, self.dcfg
+        sched = self.scheduler
+        sched.set_timesteps(num_steps)
+        n_sync = self._exec_phases(num_steps)
+        sync_step = self._make_stepper(True)
+        stale_step = self._make_stepper(False)
+
+        def loop(params, latents, enc, cap_mask, gs):
+            x = dit_mod.patchify(dcfg, latents.astype(jnp.float32))
+            sstate = sched.init_state(x.shape)
+            kv = self._kv0_global(latents.shape[0])
+            tsteps = sched.timesteps()
+
+            def body_for(step_fn):
+                def body(carry, i):
+                    x, kv, ss = carry
+                    x, ss, kv = step_fn(params, i, x, kv, ss, enc, cap_mask,
+                                        gs)
+                    io_callback(
+                        self._fire_callback, None, i, tsteps[i],
+                        dit_mod.unpatchify(dcfg, x, dcfg.in_channels),
+                        ordered=True,
+                    )
+                    return (x, kv, ss), None
+                return body
+
+            (x, kv, sstate), _ = lax.scan(
+                body_for(sync_step), (x, kv, sstate), jnp.arange(n_sync)
+            )
+            if n_sync < num_steps:
+                (x, kv, sstate), _ = lax.scan(
+                    body_for(stale_step), (x, kv, sstate),
+                    jnp.arange(n_sync, num_steps),
+                )
+            return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+        return jax.jit(loop)
+
     def comm_report(self, batch_size: int = 1) -> Dict[str, Any]:
         """Per-device stale-state and per-step collective volumes (elements)
         for the configured attention layout — the DiT analog of
@@ -540,15 +670,39 @@ class DiTDenoiseRunner:
                 "per_step_collective_elems": int(per_step)}
 
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
-                 cap_mask=None):
+                 cap_mask=None, callback=None):
         """Same contract as PipeFusionRunner.generate.  ``cap_mask``
         [n_br, B, Lt] (1 = real caption token) masks padded text tokens out
-        of cross-attention (PixArt semantics); None attends to all."""
+        of cross-attention (PixArt semantics); None attends to all.
+        ``callback(i, t, latents)`` (diffusers legacy signature) fires
+        after every step in every mode — from the host loop with
+        use_cuda_graph=False, via ordered io_callback inside the compiled
+        loop otherwise."""
         self.scheduler.set_timesteps(num_inference_steps)
         gs = jnp.asarray(guidance_scale, jnp.float32)
         if cap_mask is None:
             cap_mask = jnp.ones(enc.shape[:3], jnp.float32)
         cap_mask = jnp.asarray(cap_mask, jnp.float32)
+        if not self.cfg.use_compiled_step:
+            return self._generate_stepwise(
+                latents, enc, cap_mask, gs, num_inference_steps, callback,
+            )
+        if callback is not None:
+            key = ("fused_cb", num_inference_steps)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_fused_callback(
+                    num_inference_steps
+                )
+            self._active_callback = callback
+            try:
+                out = self._compiled[key](
+                    self.params, jnp.asarray(latents), enc, cap_mask, gs
+                )
+                jax.effects_barrier()  # host callbacks drain before return
+                jax.block_until_ready(out)
+                return out
+            finally:
+                self._active_callback = None
         if self._hybrid_dispatch(num_inference_steps):
             sync, stale = self._ensure_hybrid(num_inference_steps)
             x, sstate, kv = sync(self.params, latents, enc, cap_mask, gs)
@@ -571,7 +725,10 @@ class DiTDenoiseRunner:
         return self._compiled[key]
 
     def prepare(self, num_steps: int) -> None:
-        """Pre-build exactly the program(s) generate() will dispatch to."""
+        """Pre-build exactly the program(s) generate() will dispatch to
+        (per-step programs build lazily, like the other runners)."""
+        if not self.cfg.use_compiled_step:
+            return
         self.scheduler.set_timesteps(num_steps)
         if self._hybrid_dispatch(num_steps):
             self._ensure_hybrid(num_steps)

@@ -453,8 +453,9 @@ class MMDiTDenoiseRunner:
                 check_vma=False,
             )(params, x, kv, sstate, enc, pooled, gs)
 
-        # x and the incoming state die at this call; let XLA reuse the HBM
-        return jax.jit(loop, donate_argnums=(1, 2))
+        # x and the incoming state (KV AND scheduler state — its x-shaped
+        # leaves are latent-sized) die at this call; let XLA reuse the HBM
+        return jax.jit(loop, donate_argnums=(1, 2, 3))
 
     def _hybrid_dispatch(self, num_steps: int) -> bool:
         cfg = self.cfg

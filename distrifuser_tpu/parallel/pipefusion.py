@@ -549,12 +549,20 @@ class PipeFusionRunner:
         return warm, steady
 
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
-                 cap_mask=None):
+                 cap_mask=None, callback=None):
         """latents [B, H/8, W/8, C] fp32, enc [2, B, Lt, caption_dim]
         (uncond, cond branch-major, like DenoiseRunner).  ``cap_mask``
         [n_br, B, Lt] (1 = real token) masks padded caption tokens out of
         cross-attention; None attends to all.  Returns the final latent,
         full on every device."""
+        if callback is not None:
+            raise ValueError(
+                "per-step callbacks are not available under PipeFusion: a "
+                "denoising step is smeared across the pipeline's token "
+                "ticks inside the scan, so there is no per-step boundary "
+                "to fire from — use parallelism='patch' "
+                "(DiTDenoiseRunner fires callbacks in every mode)"
+            )
         # Re-pin the scheduler tables every call: a cached program can
         # re-trace later and must not read tables left by a different step
         # count (see DenoiseRunner.generate).

@@ -988,6 +988,7 @@ class DistriPixArtPipeline:
         output_type: str = "pil",
         latents=None,
         num_images_per_prompt: int = 1,
+        callback=None,
         **kwargs,
     ) -> PipelineOutput:
         cfg = self.distri_config
@@ -1009,11 +1010,16 @@ class DistriPixArtPipeline:
         )
         self.scheduler.set_timesteps(num_inference_steps)
 
-        def run_chunk(cp, cn, cl, _n_real):
+        def run_chunk(cp, cn, cl, n_real):
             emb, mask = self._encode(cp, cn)
+            # diffusers legacy callback(step, timestep, latents); padded
+            # tail rows stripped before the user sees them
+            cb = (None if callback is None
+                  else (lambda i, t, x: callback(i, t, x[:n_real])))
             return self.runner.generate(
                 cl, emb, guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps, cap_mask=mask,
+                callback=cb,
             )
 
         latent = _batched_generate(

@@ -394,3 +394,72 @@ def test_hybrid_all_sync_short_run():
                          get_scheduler("ddim"))
     out = r.generate(lat, enc, guidance_scale=4.0, num_inference_steps=2)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_stepwise_matches_fused():
+    """use_cuda_graph=False parity for the DiT runner: host-driven per-step
+    programs equal the fused loop across the attention layouts (the
+    stateless-ulysses placeholder KV crosses the boundary too)."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=4)
+    for extra in ({}, {"attn_impl": "ring"}, {"attn_impl": "ulysses"}):
+        fused = DiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, **extra),
+            dcfg, params, get_scheduler("ddim"))
+        stepw = DiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False,
+                      **extra),
+            dcfg, params, get_scheduler("ddim"))
+        a = np.asarray(fused.generate(lat, enc, **kw))
+        b = np.asarray(stepw.generate(lat, enc, **kw))
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
+                                   err_msg=str(extra))
+
+
+def test_callback_all_modes():
+    """The diffusers legacy callback fires with identical count, order,
+    timesteps, and latents from the host loop and from inside the
+    compiled loop (ordered io_callback)."""
+    dcfg, params = make_model()
+    lat, enc = make_inputs(dcfg)
+
+    def run(runner):
+        seen = []
+        out = runner.generate(
+            lat, enc, guidance_scale=1.0, num_inference_steps=4,
+            callback=lambda i, t, x: seen.append(
+                (int(i), float(t), np.array(x, copy=True))),
+        )
+        return seen, np.asarray(out)
+
+    stepw = DiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False),
+        dcfg, params, get_scheduler("ddim"))
+    fused = DiTDenoiseRunner(
+        sp_config(4, do_cfg=False, warmup_steps=1),
+        dcfg, params, get_scheduler("ddim"))
+    s_seen, s_out = run(stepw)
+    f_seen, f_out = run(fused)
+    assert [i for i, _, _ in s_seen] == [0, 1, 2, 3]
+    assert [i for i, _, _ in f_seen] == [i for i, _, _ in s_seen]
+    assert [t for _, t, _ in f_seen] == [t for _, t, _ in s_seen]
+    for (_, _, xa), (_, _, xb) in zip(f_seen, s_seen):
+        np.testing.assert_allclose(xa, xb, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(f_out, s_out, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(f_seen[-1][2], f_out, atol=0)
+
+
+def test_pipefusion_rejects_callbacks():
+    from test_pipefusion import make_inputs as pf_inputs
+    from test_pipefusion import make_model as pf_model
+    from distrifuser_tpu.parallel.pipefusion import PipeFusionRunner
+
+    dcfg, params = pf_model()
+    lat, enc = pf_inputs(dcfg)
+    runner = PipeFusionRunner(
+        DistriConfig(devices=jax.devices()[:4], height=128, width=128),
+        dcfg, params, get_scheduler("ddim"))
+    with pytest.raises(ValueError, match="token"):
+        runner.generate(lat, enc, num_inference_steps=2,
+                        callback=lambda i, t, x: None)

@@ -379,3 +379,24 @@ def test_pos_embed_matches_diffusers_channel_order():
         np.asarray(dit_mod.pos_embed_table(cfg_s)),
         _diffusers_2d_sincos(8, 4, interpolation_scale=2.0, base_size=2),
         rtol=1e-6, atol=1e-6)
+
+
+def test_pixart_pipeline_callback():
+    """Pipeline-level per-step callback on the displaced-patch DiT runner
+    (compiled mode); PipeFusion rejects callbacks loudly before any work."""
+    pipe, cfg = _tiny_pixart_stack(4)
+    seen = []
+    out = pipe(prompt="a fox", num_inference_steps=3, output_type="latent",
+               seed=2, callback=lambda i, t, x: seen.append((i, float(t),
+                                                             x.shape)))
+    assert [i for i, _, _ in seen] == [0, 1, 2]
+    ts = [t for _, t, _ in seen]
+    assert ts == sorted(ts, reverse=True)
+    assert all(s == (1, cfg.latent_height, cfg.latent_width, 4)
+               for _, _, s in seen)
+    assert np.isfinite(np.asarray(out.images[0])).all()
+
+    pipe_pf, _ = _tiny_pixart_stack(4, "pipefusion")
+    with pytest.raises(ValueError, match="token"):
+        pipe_pf(prompt="a fox", num_inference_steps=2, output_type="latent",
+                callback=lambda i, t, x: None)
