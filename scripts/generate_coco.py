@@ -18,7 +18,20 @@ import os
 
 import jax
 
-from common import add_distri_args, config_from_args, is_main_process, load_sdxl_pipeline
+from common import (
+    add_distri_args,
+    config_from_args,
+    is_main_process,
+    load_sd3_pipeline,
+    load_sd_pipeline,
+    load_sdxl_pipeline,
+)
+
+LOADERS = {
+    "sdxl": load_sdxl_pipeline,   # the reference's (only) protocol target
+    "sd": load_sd_pipeline,
+    "sd3": load_sd3_pipeline,
+}
 
 
 def load_captions(args):
@@ -38,7 +51,23 @@ def load_captions(args):
         )
 
 
+# family-native defaults, matching the example scripts (sd_example's
+# 512px / gs 7.5, sd3_example's flow-euler / gs 7.0 / 28 steps) — an
+# unconfigured sweep must evaluate each family at ITS protocol point,
+# not SDXL's; explicit flags still override
+FAMILY_DEFAULTS = {
+    "sd": {"image_size": [512, 512], "guidance_scale": 7.5},
+    "sd3": {"scheduler": "flow-euler", "guidance_scale": 7.0,
+            "num_inference_steps": 28},
+}
+
+
 def main():
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--model_family", type=str, default="sdxl",
+                     choices=sorted(LOADERS))
+    family = pre.parse_known_args()[0].model_family
+
     parser = argparse.ArgumentParser()
     add_distri_args(parser)
     parser.add_argument("--caption_file", type=str, default=None)
@@ -46,18 +75,29 @@ def main():
     parser.add_argument("--split", type=int, nargs=2, default=None,
                         metavar=("K", "N"), help="process chunk k of n")
     parser.add_argument("--results_dir", type=str, default="results/coco")
+    parser.add_argument("--model_family", type=str, default="sdxl",
+                        choices=sorted(LOADERS),
+                        help="pipeline family to evaluate (the reference "
+                             "protocol is sdxl; sd/sd3 extend it to the "
+                             "rest of the zoo at their native defaults)")
+    parser.set_defaults(**FAMILY_DEFAULTS.get(family, {}))
     args = parser.parse_args()
     if args.init_image is not None or args.num_images_per_prompt != 1:
         parser.error("the COCO protocol is one text2img image per caption; "
                      "--init_image/--num_images_per_prompt do not apply")
+    if args.model_family == "sd3" and args.scheduler != "flow-euler":
+        parser.error("SD3 is a rectified-flow model: only "
+                     "--scheduler flow-euler applies")
 
     distri_config = config_from_args(args)
-    pipeline = load_sdxl_pipeline(args, distri_config)
+    pipeline = LOADERS[args.model_family](args, distri_config)
     pipeline.set_progress_bar_config(disable=not is_main_process())
 
-    # auto-named output dir (generate_coco.py:96-103)
+    # auto-named output dir (generate_coco.py:96-103); non-reference
+    # families get their own namespace so sweeps never mix
+    family = "" if args.model_family == "sdxl" else f"{args.model_family}/"
     folder = (
-        f"{args.scheduler}-{args.num_inference_steps}"
+        f"{family}{args.scheduler}-{args.num_inference_steps}"
         f"/devices{distri_config.world_size}-warmup{args.warmup_steps}"
         f"-{args.sync_mode}-{args.parallelism}"
     )
