@@ -147,6 +147,9 @@ def main():
             (16384, 640, 10), (4096, 1280, 20),
             (57600, 640, 10),
             (4096, 1152, 16),  # PixArt-XL 1024px self-attn (head_dim 72)
+            (4096, 1536, 24),  # SD3-medium 1024px image tokens (head_dim
+                               # 64; the joint seq adds ~154 ctx tokens and
+                               # routes XLA — this probes the aligned core)
         ]
         for (L, C, H) in shapes:
             if left() < 300:

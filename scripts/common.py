@@ -223,6 +223,28 @@ def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Dis
     raise SystemExit("pass --model_path <local HF snapshot> or --random_weights")
 
 
+# Per-family protocol defaults and validation, shared by the example
+# scripts and generate_coco so the policy lives in exactly one place:
+# sd runs its native 512px / gs 7.5 / stale_gn point (the reference's
+# sd_example), sd3 its published flow-euler / gs 7.0 / 28-step point.
+FAMILY_DEFAULTS = {
+    "sdxl": {},
+    "sd": {"image_size": [512, 512], "guidance_scale": 7.5,
+           "sync_mode": "stale_gn"},
+    "sd3": {"scheduler": "flow-euler", "guidance_scale": 7.0,
+            "num_inference_steps": 28},
+}
+
+
+def check_family_scheduler(family: str, scheduler: str, error) -> None:
+    """Reject scheduler/family crosses at the CLI, before any model load
+    (the pipeline constructors guard too — this just fails earlier with a
+    flag-level message).  ``error`` is parser.error or SystemExit-like."""
+    if family == "sd3" and scheduler != "flow-euler":
+        error("SD3 is a rectified-flow model: only --scheduler flow-euler "
+              "applies")
+
+
 def _random_sd3_pipeline(distri_config: DistriConfig, scheduler,
                          tiny: bool = False) -> DistriSD3Pipeline:
     import dataclasses

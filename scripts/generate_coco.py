@@ -19,7 +19,9 @@ import os
 import jax
 
 from common import (
+    FAMILY_DEFAULTS,
     add_distri_args,
+    check_family_scheduler,
     config_from_args,
     is_main_process,
     load_sd3_pipeline,
@@ -51,43 +53,33 @@ def load_captions(args):
         )
 
 
-# family-native defaults, matching the example scripts (sd_example's
-# 512px / gs 7.5, sd3_example's flow-euler / gs 7.0 / 28 steps) — an
-# unconfigured sweep must evaluate each family at ITS protocol point,
-# not SDXL's; explicit flags still override
-FAMILY_DEFAULTS = {
-    "sd": {"image_size": [512, 512], "guidance_scale": 7.5},
-    "sd3": {"scheduler": "flow-euler", "guidance_scale": 7.0,
-            "num_inference_steps": 28},
-}
-
-
 def main():
-    pre = argparse.ArgumentParser(add_help=False)
+    # two-pass parse: the family decides which defaults (common.py
+    # FAMILY_DEFAULTS — the example scripts' native protocol points) the
+    # main parser carries; ``parents`` keeps --model_family declared once,
+    # and allow_abbrev=False keeps abbreviations of OTHER flags (e.g.
+    # --model for --model_path) from being captured by the pre-parser
+    pre = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
     pre.add_argument("--model_family", type=str, default="sdxl",
-                     choices=sorted(LOADERS))
+                     choices=sorted(LOADERS),
+                     help="pipeline family to evaluate (the reference "
+                          "protocol is sdxl; sd/sd3 extend it to the rest "
+                          "of the zoo at their native defaults)")
     family = pre.parse_known_args()[0].model_family
 
-    parser = argparse.ArgumentParser()
+    parser = argparse.ArgumentParser(parents=[pre])
     add_distri_args(parser)
     parser.add_argument("--caption_file", type=str, default=None)
     parser.add_argument("--num_images", type=int, default=5000)
     parser.add_argument("--split", type=int, nargs=2, default=None,
                         metavar=("K", "N"), help="process chunk k of n")
     parser.add_argument("--results_dir", type=str, default="results/coco")
-    parser.add_argument("--model_family", type=str, default="sdxl",
-                        choices=sorted(LOADERS),
-                        help="pipeline family to evaluate (the reference "
-                             "protocol is sdxl; sd/sd3 extend it to the "
-                             "rest of the zoo at their native defaults)")
-    parser.set_defaults(**FAMILY_DEFAULTS.get(family, {}))
+    parser.set_defaults(**FAMILY_DEFAULTS[family])
     args = parser.parse_args()
     if args.init_image is not None or args.num_images_per_prompt != 1:
         parser.error("the COCO protocol is one text2img image per caption; "
                      "--init_image/--num_images_per_prompt do not apply")
-    if args.model_family == "sd3" and args.scheduler != "flow-euler":
-        parser.error("SD3 is a rectified-flow model: only "
-                     "--scheduler flow-euler applies")
+    check_family_scheduler(args.model_family, args.scheduler, parser.error)
 
     distri_config = config_from_args(args)
     pipeline = LOADERS[args.model_family](args, distri_config)

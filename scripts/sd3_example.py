@@ -9,7 +9,9 @@ sdxl_example.py so the whole zoo drives identically.
 import argparse
 
 from common import (
+    FAMILY_DEFAULTS,
     add_distri_args,
+    check_family_scheduler,
     config_from_args,
     img2img_kwargs,
     is_main_process,
@@ -18,20 +20,19 @@ from common import (
 )
 
 
+def _err(msg):
+    raise SystemExit(msg)
+
+
 def main():
     parser = argparse.ArgumentParser()
     add_distri_args(parser)
     # rectified-flow sampling defaults (the published SD3 configuration)
-    parser.set_defaults(scheduler="flow-euler", guidance_scale=7.0,
-                        num_inference_steps=28,
+    parser.set_defaults(**FAMILY_DEFAULTS["sd3"],
                         prompt="a photo of an astronaut riding a horse "
                                "on mars")
     args = parser.parse_args()
-    if args.scheduler != "flow-euler":
-        raise SystemExit(
-            "SD3 is a rectified-flow model: only --scheduler flow-euler "
-            "produces meaningful samples"
-        )
+    check_family_scheduler("sd3", args.scheduler, _err)
 
     i2i = img2img_kwargs(args)  # loads --init_image before the model
     distri_config = config_from_args(args)

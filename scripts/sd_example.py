@@ -3,6 +3,7 @@ which uses mode="stale_gn" — sd_example.py:6)."""
 import argparse
 
 from common import (
+    FAMILY_DEFAULTS,
     add_distri_args,
     config_from_args,
     img2img_kwargs,
@@ -15,7 +16,7 @@ from common import (
 def main():
     parser = argparse.ArgumentParser()
     add_distri_args(parser)
-    parser.set_defaults(sync_mode="stale_gn", image_size=[512, 512], guidance_scale=7.5)
+    parser.set_defaults(**FAMILY_DEFAULTS["sd"])
     args = parser.parse_args()
 
     i2i = img2img_kwargs(args)  # loads --init_image before the model
