@@ -25,8 +25,10 @@ Deliberate simplifications, documented for checkpoint converters:
   are computed and DISCARDED, so numerics match — the stacked-scan layout
   needs uniform leaves, and the converter zero-fills the unused tail
   weights (models/weights.py convert_mmdit_state_dict).
-* No q/k RMSNorm (SD3.0-2B semantics; SD3.5 adds qk-norm — a converter
-  for those checkpoints must reject loudly rather than silently skip).
+* q/k RMSNorm is config-gated (``qk_norm``): off for SD3.0-2B, per-head
+  RMS with learned weights for the SD3.5 family (diffusers
+  qk_norm="rms_norm"); SD3.5-medium's dual_attention_layers remain
+  unsupported and rejected loudly.
 """
 
 from __future__ import annotations
@@ -72,6 +74,9 @@ class MMDiTConfig:
     # to the actual token grid (SD3 PatchEmbed semantics) so one checkpoint
     # serves multiple resolutions
     pos_embed_max_size: int = 192
+    # SD3.5 family: RMS-normalize per-head q/k in both streams before the
+    # joint attention (diffusers qk_norm="rms_norm"); SD3.0 leaves it off
+    qk_norm: bool = False
 
     @property
     def tokens_per_side(self) -> int:
@@ -113,10 +118,11 @@ def mmdit_config_from_json(source) -> MMDiTConfig:
     if not isinstance(source, dict):
         with open(source) as f:
             cfg = json.load(f)
-    if cfg.get("qk_norm"):
+    if cfg.get("qk_norm") not in (None, "", False, "rms_norm"):
         raise ValueError(
-            "qk_norm checkpoints (SD3.5 family) are not supported by this "
-            "MMDiT implementation; refusing to load silently-wrong weights"
+            f"qk_norm={cfg.get('qk_norm')!r}: only the SD3.5 family's "
+            "'rms_norm' is implemented; refusing to load silently-wrong "
+            "weights"
         )
     if cfg.get("dual_attention_layers"):
         raise ValueError(
@@ -135,6 +141,7 @@ def mmdit_config_from_json(source) -> MMDiTConfig:
         joint_attention_dim=cfg.get("joint_attention_dim", 4096),
         pooled_projection_dim=cfg.get("pooled_projection_dim", 2048),
         pos_embed_max_size=cfg.get("pos_embed_max_size", 192),
+        qk_norm=cfg.get("qk_norm") == "rms_norm",
     )
 
 
@@ -163,7 +170,7 @@ def tiny_mmdit_config(depth: int = 4) -> MMDiTConfig:
 def _init_block(key, cfg: MMDiTConfig, dtype):
     h = cfg.hidden_size
     keys = jax.random.split(key, 10)
-    return {
+    block = {
         # per-stream adaLN: 6 modulation vectors each (shift/scale/gate for
         # attention and MLP), from silu(conditioning vec)
         "x_mod": _init_linear(keys[0], h, 6 * h, dtype),
@@ -177,6 +184,11 @@ def _init_block(key, cfg: MMDiTConfig, dtype):
         "c_fc1": _init_linear(keys[8], h, cfg.mlp_ratio * h, dtype),
         "c_fc2": _init_linear(keys[9], cfg.mlp_ratio * h, h, dtype),
     }
+    if cfg.qk_norm:
+        d = h // cfg.num_heads
+        for name in ("x_qnorm", "x_knorm", "c_qnorm", "c_knorm"):
+            block[name] = jnp.ones((d,), dtype)  # RMSNorm weight init
+    return block
 
 
 def init_mmdit_params(key, cfg: MMDiTConfig, dtype=jnp.float32) -> Dict[str, Any]:
@@ -258,6 +270,16 @@ def _mods(mod_p, vec, n):
     return [c[:, None, :] for c in jnp.split(m, n, axis=-1)]
 
 
+def _rms_heads(x, w, heads: int):
+    """Per-head RMSNorm over head_dim (SD3.5 qk_norm, fp32 moments):
+    [B, L, C] with weight [C/heads] -> [B, L, C]."""
+    b, l, c = x.shape
+    d = c // heads
+    xh = x.reshape(b, l, heads, d).astype(jnp.float32)
+    y = xh * lax.rsqrt(jnp.mean(xh * xh, axis=-1, keepdims=True) + 1e-6)
+    return (y * w.astype(jnp.float32)).astype(x.dtype).reshape(b, l, c)
+
+
 def mmdit_block(
     bp: Dict[str, Any],
     cfg: MMDiTConfig,
@@ -296,6 +318,11 @@ def mmdit_block(
     cn = _ln(ctx) * (1.0 + csc1) + cs1
     xq, xk, xv = jnp.split(linear(bp["x_qkv"], xn), 3, axis=-1)
     cq, ck, cv = jnp.split(linear(bp["c_qkv"], cn), 3, axis=-1)
+    if "x_qnorm" in bp:  # SD3.5 qk_norm (cfg.qk_norm param layout)
+        xq = _rms_heads(xq, bp["x_qnorm"], cfg.num_heads)
+        xk = _rms_heads(xk, bp["x_knorm"], cfg.num_heads)
+        cq = _rms_heads(cq, bp["c_qnorm"], cfg.num_heads)
+        ck = _rms_heads(ck, bp["c_knorm"], cfg.num_heads)
 
     if attn_core is not None:
         att = attn_core(cq, xq, (ck, cv), (xk, xv))

@@ -467,7 +467,7 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
             c_fc1 = lin(f"{b}.ff_context.net.0.proj")
             c_fc2 = lin(f"{b}.ff_context.net.2")
 
-        blocks.append({
+        block = {
             "x_mod": lin(f"{b}.norm1.linear"),
             "c_mod": c_mod,
             "x_qkv": fused3(f"{b}.attn.to_q", f"{b}.attn.to_k",
@@ -479,7 +479,21 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
             "x_fc2": lin(f"{b}.ff.net.2"),
             "c_fc1": c_fc1,
             "c_fc2": c_fc2,
-        })
+        }
+        if f"{b}.attn.norm_q.weight" in sd:
+            # SD3.5 per-head q/k RMSNorm (qk_norm="rms_norm"); the final
+            # block has no context queries, so its absent norm_added_q
+            # weight is filled with ones (that norm's output is part of
+            # the discarded context-query rows)
+            block["x_qnorm"] = get(f"{b}.attn.norm_q.weight")
+            block["x_knorm"] = get(f"{b}.attn.norm_k.weight")
+            block["c_knorm"] = get(f"{b}.attn.norm_added_k.weight")
+            block["c_qnorm"] = (
+                get(f"{b}.attn.norm_added_q.weight")
+                if f"{b}.attn.norm_added_q.weight" in sd
+                else np.ones_like(block["x_qnorm"])
+            )
+        blocks.append(block)
 
     pw = get("pos_embed.proj.weight")  # conv [hidden, C, ps, ps]
     hidden = pw.shape[0]

@@ -130,3 +130,43 @@ def test_converted_forward_runs():
     out = mm.mmdit_forward(tree, CFG, x, jnp.asarray(400.0), enc, pooled)
     assert out.shape == x.shape[:3] + (CFG.out_channels,)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_qk_norm_keys_convert(tmp_path):
+    """SD3.5-layout snapshots (attn.norm_q/_k + norm_added_q/_k) convert
+    onto the qk_norm param layout; the final block's absent context
+    q-norm is filled with ones (its output rows are discarded)."""
+    import dataclasses
+
+    sd = synth_sd()
+    h = CFG.hidden_size
+    d = h // CFG.num_heads
+    rng = np.random.RandomState(9)
+    for i in range(CFG.depth):
+        b = f"transformer_blocks.{i}"
+        sd[f"{b}.attn.norm_q.weight"] = rng.rand(d).astype(np.float32)
+        sd[f"{b}.attn.norm_k.weight"] = rng.rand(d).astype(np.float32)
+        sd[f"{b}.attn.norm_added_k.weight"] = rng.rand(d).astype(np.float32)
+        if i != CFG.depth - 1:  # context_pre_only final block: no added_q
+            sd[f"{b}.attn.norm_added_q.weight"] = rng.rand(d).astype(
+                np.float32)
+    tree = convert_mmdit_state_dict(sd)
+    qcfg = dataclasses.replace(CFG, qk_norm=True)
+    ref = mm.init_mmdit_params(jax.random.PRNGKey(0), qcfg)
+    assert (jax.tree.map(lambda l: tuple(np.shape(l)), tree)
+            == jax.tree.map(lambda l: l.shape, ref))
+    last = jax.tree.map(lambda l: np.asarray(l)[-1], tree["blocks"])
+    np.testing.assert_array_equal(last["c_qnorm"], 1.0)
+    np.testing.assert_array_equal(
+        last["x_qnorm"],
+        sd[f"transformer_blocks.{CFG.depth - 1}.attn.norm_q.weight"])
+    # converted qk-norm params run end-to-end
+    out = mm.mmdit_forward(
+        tree, qcfg,
+        jnp.zeros((1, qcfg.sample_size, qcfg.sample_size,
+                   qcfg.in_channels)),
+        jnp.asarray(300.0),
+        jnp.zeros((1, 5, qcfg.joint_attention_dim)),
+        jnp.zeros((1, qcfg.pooled_projection_dim)),
+    )
+    assert np.isfinite(np.asarray(out)).all()
