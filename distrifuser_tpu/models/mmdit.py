@@ -27,8 +27,16 @@ Deliberate simplifications, documented for checkpoint converters:
   weights (models/weights.py convert_mmdit_state_dict).
 * q/k RMSNorm is config-gated (``qk_norm``): off for SD3.0-2B, per-head
   RMS with learned weights for the SD3.5 family (diffusers
-  qk_norm="rms_norm"); SD3.5-medium's dual_attention_layers remain
-  unsupported and rejected loudly.
+  qk_norm="rms_norm").
+* SD3.5-medium's dual_attention_layers (an EXTRA image-stream-only
+  self-attention per early block, diffusers use_dual_attention) is
+  supported for the published contiguous-prefix layout: blocks
+  [0, dual_attention_blocks) carry the second attention.  The stacked-scan
+  layout splits into TWO scans (dual prefix, plain suffix) so each body
+  compiles once with uniform leaves; the dual extras live in a separate
+  ``blocks_dual`` stacked pytree (``x_mod2`` = the LAST 3 chunks of
+  diffusers' 9-chunk AdaLayerNormZeroX, fused ``x2_qkv``, ``x2_out``,
+  and qk-norm weights).  Non-prefix dual layouts are rejected loudly.
 """
 
 from __future__ import annotations
@@ -77,6 +85,10 @@ class MMDiTConfig:
     # SD3.5 family: RMS-normalize per-head q/k in both streams before the
     # joint attention (diffusers qk_norm="rms_norm"); SD3.0 leaves it off
     qk_norm: bool = False
+    # SD3.5-medium: blocks [0, dual_attention_blocks) run a SECOND
+    # image-stream-only self-attention (diffusers dual_attention_layers,
+    # a contiguous prefix in every published checkpoint)
+    dual_attention_blocks: int = 0
 
     @property
     def tokens_per_side(self) -> int:
@@ -104,6 +116,11 @@ class MMDiTConfig:
                 f"token grid {self.tokens_per_side} exceeds "
                 f"pos_embed_max_size {self.pos_embed_max_size}"
             )
+        if not 0 <= self.dual_attention_blocks <= self.depth:
+            raise ValueError(
+                f"dual_attention_blocks={self.dual_attention_blocks} must "
+                f"lie in [0, depth={self.depth}]"
+            )
 
 
 def sd3_config(sample_size: int = 128) -> MMDiTConfig:
@@ -124,13 +141,17 @@ def mmdit_config_from_json(source) -> MMDiTConfig:
             "'rms_norm' is implemented; refusing to load silently-wrong "
             "weights"
         )
-    if cfg.get("dual_attention_layers"):
+    dual = tuple(cfg.get("dual_attention_layers") or ())
+    if dual != tuple(range(len(dual))):
         raise ValueError(
-            "dual_attention_layers (SD3.5-medium) is not supported"
+            f"dual_attention_layers={dual}: only the published "
+            "contiguous-prefix layout (0, 1, ..., k-1; SD3.5-medium uses "
+            "0-12) is implemented — refusing an unknown block layout"
         )
     head_dim = cfg.get("attention_head_dim", 64)
     heads = cfg.get("num_attention_heads", 24)
     return MMDiTConfig(
+        dual_attention_blocks=len(dual),
         sample_size=cfg.get("sample_size", 128),
         patch_size=cfg.get("patch_size", 2),
         in_channels=cfg.get("in_channels", 16),
@@ -191,6 +212,24 @@ def _init_block(key, cfg: MMDiTConfig, dtype):
     return block
 
 
+def _init_dual_block(key, cfg: MMDiTConfig, dtype):
+    """Extra leaves for one dual-attention block (SD3.5-medium): the
+    second image-stream self-attention and its 3 modulation vectors (the
+    last 3 chunks of diffusers' 9-chunk AdaLayerNormZeroX)."""
+    h = cfg.hidden_size
+    keys = jax.random.split(key, 3)
+    block = {
+        "x_mod2": _init_linear(keys[0], h, 3 * h, dtype),
+        "x2_qkv": _init_linear(keys[1], h, 3 * h, dtype),
+        "x2_out": _init_linear(keys[2], h, h, dtype),
+    }
+    if cfg.qk_norm:
+        d = h // cfg.num_heads
+        block["x2_qnorm"] = jnp.ones((d,), dtype)
+        block["x2_knorm"] = jnp.ones((d,), dtype)
+    return block
+
+
 def init_mmdit_params(key, cfg: MMDiTConfig, dtype=jnp.float32) -> Dict[str, Any]:
     """Random-init parameter pytree; ``blocks`` leaves carry a leading
     ``[depth]`` axis for lax.scan / stage sharding."""
@@ -199,7 +238,14 @@ def init_mmdit_params(key, cfg: MMDiTConfig, dtype=jnp.float32) -> Dict[str, Any
     blocks = jax.vmap(lambda k: _init_block(k, cfg, dtype))(
         jax.random.split(keys[7], cfg.depth)
     )
+    extra = {}
+    if cfg.dual_attention_blocks:
+        extra["blocks_dual"] = jax.vmap(
+            lambda k: _init_dual_block(k, cfg, dtype)
+        )(jax.random.split(jax.random.fold_in(keys[7], 1),
+                           cfg.dual_attention_blocks))
     return {
+        **extra,
         "proj_in": _init_linear(keys[0], cfg.token_dim, h, dtype),
         "ctx_in": _init_linear(keys[1], cfg.joint_attention_dim, h, dtype),
         "t_fc1": _init_linear(keys[2], cfg.frequency_embedding_size, h, dtype),
@@ -288,6 +334,9 @@ def mmdit_block(
     vec: jnp.ndarray,             # [B, hidden] conditioning
     kv_assemble=None,
     attn_core=None,
+    dual_p: Optional[Dict[str, Any]] = None,
+    kv2_assemble=None,
+    attn2_core=None,
 ):
     """One joint-attention block.
 
@@ -308,13 +357,23 @@ def mmdit_block(
     uses this (parallel/mmdit_sp.py attn_impl="ring").  Mutually exclusive
     with ``kv_assemble``.
 
-    Returns ``(x_out, ctx_out, (xk, xv))`` with the fresh local image KV.
+    ``dual_p`` (SD3.5-medium dual attention) adds a SECOND image-only
+    self-attention: its input is the same pre-attention LayerNorm of ``x``
+    modulated by ``x_mod2``'s (shift, scale, gate) — diffusers
+    AdaLayerNormZeroX's last 3 chunks — and its gated output is added
+    AFTER the joint-attention residual.  ``kv2_assemble``/``attn2_core``
+    are its displaced-patch hooks, same contracts as above but image-only
+    (attn2_core receives ``(q2, (k2, v2)) -> [B, Lx, hidden]``).
+
+    Returns ``(x_out, ctx_out, (xk, xv))`` with the fresh local image KV —
+    plus a trailing ``(k2, v2)`` element when ``dual_p`` is given.
     """
     assert kv_assemble is None or attn_core is None
     xs1, xsc1, xg1, xs2, xsc2, xg2 = _mods(bp["x_mod"], vec, 6)
     cs1, csc1, cg1, cs2, csc2, cg2 = _mods(bp["c_mod"], vec, 6)
 
-    xn = _ln(x) * (1.0 + xsc1) + xs1
+    xln = _ln(x)
+    xn = xln * (1.0 + xsc1) + xs1
     cn = _ln(ctx) * (1.0 + csc1) + cs1
     xq, xk, xv = jnp.split(linear(bp["x_qkv"], xn), 3, axis=-1)
     cq, ck, cv = jnp.split(linear(bp["c_qkv"], cn), 3, axis=-1)
@@ -341,6 +400,26 @@ def mmdit_block(
     x = x + xg1 * linear(bp["x_out"], xatt)
     ctx = ctx + cg1 * linear(bp["c_out"], catt)
 
+    kv2 = None
+    if dual_p is not None:
+        assert kv2_assemble is None or attn2_core is None
+        d_s, d_sc, d_g = _mods(dual_p["x_mod2"], vec, 3)
+        xn2a = xln * (1.0 + d_sc) + d_s
+        q2, k2, v2 = jnp.split(linear(dual_p["x2_qkv"], xn2a), 3, axis=-1)
+        if "x2_qnorm" in dual_p:
+            q2 = _rms_heads(q2, dual_p["x2_qnorm"], cfg.num_heads)
+            k2 = _rms_heads(k2, dual_p["x2_knorm"], cfg.num_heads)
+        if attn2_core is not None:
+            att2 = attn2_core(q2, (k2, v2))
+        else:
+            fk2, fv2 = (kv2_assemble(k2, v2) if kv2_assemble is not None
+                        else (k2, v2))
+            att2 = sdpa(q2, fk2, fv2, heads=cfg.num_heads)
+        # diffusers residual order: joint-attention output first (above),
+        # then the gated dual output, then the MLP
+        x = x + d_g * linear(dual_p["x2_out"], att2)
+        kv2 = (k2, v2)
+
     xn2 = _ln(x) * (1.0 + xsc2) + xs2
     x = x + xg2 * linear(
         bp["x_fc2"], jax.nn.gelu(linear(bp["x_fc1"], xn2), approximate=True)
@@ -349,6 +428,8 @@ def mmdit_block(
     ctx = ctx + cg2 * linear(
         bp["c_fc2"], jax.nn.gelu(linear(bp["c_fc1"], cn2), approximate=True)
     )
+    if dual_p is not None:
+        return x, ctx, (xk, xv), kv2
     return x, ctx, (xk, xv)
 
 
@@ -387,6 +468,21 @@ def mmdit_forward(
         hx, hc, _ = mmdit_block(bp, cfg, hx, hc, vec)
         return (hx, hc), None
 
-    (h, _), _ = lax.scan(body, (h, ctx), params["blocks"])
+    k = cfg.dual_attention_blocks
+    if k:
+        def body_dual(carry, xs):
+            bp, dp = xs
+            hx, hc = carry
+            hx, hc, _, _ = mmdit_block(bp, cfg, hx, hc, vec, dual_p=dp)
+            return (hx, hc), None
+
+        prefix = jax.tree.map(lambda l: l[:k], params["blocks"])
+        (h, ctx), _ = lax.scan(
+            body_dual, (h, ctx), (prefix, params["blocks_dual"])
+        )
+        rest = jax.tree.map(lambda l: l[k:], params["blocks"])
+    else:
+        rest = params["blocks"]
+    (h, _), _ = lax.scan(body, (h, ctx), rest)
     out = final_layer(params, cfg, h, vec)
     return unpatchify(cfg, out.astype(jnp.float32), cfg.out_channels)

@@ -385,7 +385,14 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
       ``c_out``/``c_fc*``/the gate+MLP modulation chunks/the q third of
       ``c_qkv`` — all of which feed only the DISCARDED final context
       stream (gates are zero, so the context residual passes through
-      bit-exactly).
+      bit-exactly);
+    * SD3.5-medium dual attention (``attn2`` present): the block's
+      ``norm1.linear`` is AdaLayerNormZeroX (9 chunks) — the first 6
+      chunks are the standard layout and map to ``x_mod``, the last 3
+      (shift_msa2, scale_msa2, gate_msa2) to ``blocks_dual.x_mod2``;
+      ``attn2.to_{q,k,v}`` fuse into ``x2_qkv``; dual blocks must form a
+      contiguous prefix (the published layout) since the stacked-scan
+      model splits at ``dual_attention_blocks``.
     """
     get = lambda k: np.asarray(sd[k])
 
@@ -417,11 +424,20 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
     n_blocks = 1 + max(
         int(k.split(".")[1]) for k in sd if k.startswith("transformer_blocks.")
     )
+    dual_idx = [i for i in range(n_blocks)
+                if f"transformer_blocks.{i}.attn2.to_q.weight" in sd]
+    if dual_idx != list(range(len(dual_idx))):
+        raise ValueError(
+            f"dual-attention blocks at {dual_idx}: only the published "
+            "contiguous-prefix layout is implemented"
+        )
     blocks = []
+    blocks_dual = []
     for i in range(n_blocks):
         b = f"transformer_blocks.{i}"
         hidden = get(f"{b}.attn.to_q.weight").shape[0]
         pre_only = f"{b}.attn.to_add_out.weight" not in sd
+        is_dual = i < len(dual_idx)
 
         if pre_only:
             # context stream of the last block: K/V only.  Zero the query
@@ -467,8 +483,27 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
             c_fc1 = lin(f"{b}.ff_context.net.0.proj")
             c_fc2 = lin(f"{b}.ff_context.net.2")
 
+        x_mod = lin(f"{b}.norm1.linear")
+        if is_dual:
+            # AdaLayerNormZeroX: 9 chunks; the first 6 are the standard
+            # (shift, scale, gate) x (attn, mlp) layout, the last 3 are
+            # the dual attention's (shift_msa2, scale_msa2, gate_msa2)
+            x_mod2 = {"kernel": x_mod["kernel"][:, 6 * hidden:],
+                      "bias": x_mod["bias"][6 * hidden:]}
+            x_mod = {"kernel": x_mod["kernel"][:, :6 * hidden],
+                     "bias": x_mod["bias"][:6 * hidden]}
+            dual_block = {
+                "x_mod2": x_mod2,
+                "x2_qkv": fused3(f"{b}.attn2.to_q", f"{b}.attn2.to_k",
+                                 f"{b}.attn2.to_v"),
+                "x2_out": lin(f"{b}.attn2.to_out.0"),
+            }
+            if f"{b}.attn2.norm_q.weight" in sd:
+                dual_block["x2_qnorm"] = get(f"{b}.attn2.norm_q.weight")
+                dual_block["x2_knorm"] = get(f"{b}.attn2.norm_k.weight")
+            blocks_dual.append(dual_block)
         block = {
-            "x_mod": lin(f"{b}.norm1.linear"),
+            "x_mod": x_mod,
             "c_mod": c_mod,
             "x_qkv": fused3(f"{b}.attn.to_q", f"{b}.attn.to_k",
                             f"{b}.attn.to_v"),
@@ -512,6 +547,8 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
         "final_out": lin("proj_out"),
         "blocks": _stack_layers(blocks),
     }
+    if blocks_dual:
+        tree["blocks_dual"] = _stack_layers(blocks_dual)
     return _cast(tree, dtype)
 
 

@@ -115,7 +115,11 @@ class MMDiTDenoiseRunner:
         """One MMDiT evaluation on this device's token rows.
 
         Returns (full guided-input velocity [Bl, N, D_out], new kv_state).
-        ``kv_state``: gathered [depth, 2, Bl, N, hidden] stale image K/V.
+        ``kv_state``: gathered [depth, 2, Bl, N, hidden] stale image K/V —
+        or, with dual-attention blocks (SD3.5-medium), a dict
+        ``{"j": [depth, ...] joint-image KV, "d": [k_dual, ...] attn2 KV}``
+        (attn2 is image-only self-attention over the same sharded rows, so
+        its displaced state has the same per-block layout).
         ``ctx0``: [Bl, Lc, hidden] projected context entering block 0 —
         recomputed per step is unnecessary (it is timestep-independent),
         but the stream EVOLVES through the blocks, so it restarts from
@@ -141,10 +145,11 @@ class MMDiTDenoiseRunner:
 
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
 
-        def block_body_gather(carry, xs):
-            hx, hc = carry
-            bp, kv_blk = xs  # kv_blk [2, Bl, N, hid] stale gathered image KV
-            assembled = {}
+        def _gather_assemble(kv_blk, box):
+            """Displaced-KV assembly closure for one attention's image KV:
+            sync -> all-gather fresh (exact); stale -> carried gathered KV
+            with this device's slot overwritten fresh (reference
+            pp/attn.py:135-140 semantics)."""
 
             def assemble(k_fresh, v_fresh):
                 if phase_sync:
@@ -158,34 +163,51 @@ class MMDiTDenoiseRunner:
                             kv_blk[1], v_fresh, (0, offset, 0)
                         ),
                     )
-                assembled["kv"] = kv
+                box["kv"] = kv
                 return kv
 
-            hx, hc, (k, v) = mm.mmdit_block(
-                bp, mcfg, hx, hc, vec, kv_assemble=assemble
-            )
+            return assemble
+
+        def _gather_refresh(box, kv_blk, k, v):
             # refresh for the NEXT step: deferred consumption lets XLA
             # overlap the gather with the remaining blocks' compute
             if phase_sync:
-                fresh = jnp.stack(list(assembled["kv"]))
-            elif no_refresh:
-                fresh = kv_blk
-            else:
-                fresh = jnp.stack([all_gather_seq(k), all_gather_seq(v)])
-            return (hx, hc), fresh
+                return jnp.stack(list(box["kv"]))
+            if no_refresh:
+                return kv_blk
+            return jnp.stack([all_gather_seq(k), all_gather_seq(v)])
 
-        def block_body_ring(carry, xs):
-            from ..ops.ring_attention import ring_pass
-
+        def block_body_gather(carry, xs):
             hx, hc = carry
-            bp, kv_blk = xs  # kv_blk [Bl, chunk, 2*hid] own stale chunk
-            fresh_box = {}
+            bp, kv_blk = xs  # kv_blk [2, Bl, N, hid] stale gathered image KV
+            box = {}
+            hx, hc, (k, v) = mm.mmdit_block(
+                bp, mcfg, hx, hc, vec, kv_assemble=_gather_assemble(kv_blk, box)
+            )
+            return (hx, hc), _gather_refresh(box, kv_blk, k, v)
 
+        def dual_body_gather(carry, xs):
+            hx, hc = carry
+            bp, dp, kv_blk, kv2_blk = xs
+            box, box2 = {}, {}
+            hx, hc, (k, v), (k2, v2) = mm.mmdit_block(
+                bp, mcfg, hx, hc, vec,
+                kv_assemble=_gather_assemble(kv_blk, box),
+                dual_p=dp, kv2_assemble=_gather_assemble(kv2_blk, box2),
+            )
+            return (hx, hc), (
+                _gather_refresh(box, kv_blk, k, v),
+                _gather_refresh(box2, kv2_blk, k2, v2),
+            )
+
+        from ..ops.ring_attention import ring_pass
+
+        def _ring_joint_core(kv_blk, box):
             def core(cq, xq, ckv, xkv):
                 ck, cv = ckv
                 xk, xv = xkv
                 kv_own = jnp.concatenate([xk, xv], axis=-1)
-                fresh_box["kv"] = kv_own
+                box["kv"] = kv_own
                 static = jnp.concatenate([ck, cv], axis=-1)
                 # sync phase rotates fresh peer chunks (exact); stale phase
                 # rotates each peer's previous-step chunk from the carry.
@@ -199,20 +221,72 @@ class MMDiTDenoiseRunner:
                 out = out.astype(xq.dtype).transpose(0, 2, 1, 3)
                 return out.reshape(b_, lq_, mcfg.hidden_size)
 
-            hx, hc, _ = mm.mmdit_block(bp, mcfg, hx, hc, vec, attn_core=core)
+            return core
+
+        def _ring_dual_core(kv2_blk, box2):
+            def core2(q2, xkv2):
+                k2, v2 = xkv2
+                kv_own = jnp.concatenate([k2, v2], axis=-1)
+                box2["kv"] = kv_own
+                rotating = kv_own if phase_sync else kv2_blk
+                out = ring_pass(q2, kv_own, rotating, n, SP_AXIS,
+                                heads=mcfg.num_heads)
+                b_, lq_ = q2.shape[0], q2.shape[1]
+                out = out.astype(q2.dtype).transpose(0, 2, 1, 3)
+                return out.reshape(b_, lq_, mcfg.hidden_size)
+
+            return core2
+
+        def _ring_refresh(box, kv_blk):
             # next step's stale state is this step's own fresh chunk — no
             # refresh collective at all (ring_attention.py semantics)
             if phase_sync or not no_refresh:
-                fresh = fresh_box["kv"]
-            else:
-                fresh = kv_blk
-            return (hx, hc), fresh
+                return box["kv"]
+            return kv_blk
 
-        block_body = (block_body_ring if cfg.attn_impl == "ring"
-                      else block_body_gather)
-        (h, _), kv_new = lax.scan(
-            block_body, (h, ctx0), (params["blocks"], kv_state)
-        )
+        def block_body_ring(carry, xs):
+            hx, hc = carry
+            bp, kv_blk = xs  # kv_blk [Bl, chunk, 2*hid] own stale chunk
+            box = {}
+            hx, hc, _ = mm.mmdit_block(
+                bp, mcfg, hx, hc, vec, attn_core=_ring_joint_core(kv_blk, box)
+            )
+            return (hx, hc), _ring_refresh(box, kv_blk)
+
+        def dual_body_ring(carry, xs):
+            hx, hc = carry
+            bp, dp, kv_blk, kv2_blk = xs
+            box, box2 = {}, {}
+            hx, hc, _, _ = mm.mmdit_block(
+                bp, mcfg, hx, hc, vec,
+                attn_core=_ring_joint_core(kv_blk, box),
+                dual_p=dp, attn2_core=_ring_dual_core(kv2_blk, box2),
+            )
+            return (hx, hc), (
+                _ring_refresh(box, kv_blk), _ring_refresh(box2, kv2_blk)
+            )
+
+        ring = cfg.attn_impl == "ring"
+        block_body = block_body_ring if ring else block_body_gather
+        k_dual = mcfg.dual_attention_blocks
+        if k_dual:
+            dual_body = dual_body_ring if ring else dual_body_gather
+            kv_j, kv_d = kv_state["j"], kv_state["d"]
+            bp_pre = jax.tree.map(lambda l: l[:k_dual], params["blocks"])
+            (h, hc), (kvj_pre, kvd_new) = lax.scan(
+                dual_body, (h, ctx0),
+                (bp_pre, params["blocks_dual"], kv_j[:k_dual], kv_d),
+            )
+            bp_suf = jax.tree.map(lambda l: l[k_dual:], params["blocks"])
+            (h, _), kvj_suf = lax.scan(
+                block_body, (h, hc), (bp_suf, kv_j[k_dual:])
+            )
+            kv_new = {"j": jnp.concatenate([kvj_pre, kvj_suf], axis=0),
+                      "d": kvd_new}
+        else:
+            (h, _), kv_new = lax.scan(
+                block_body, (h, ctx0), (params["blocks"], kv_state)
+            )
         out_rows = mm.final_layer(params, mcfg, h, vec)
         out_full = all_gather_seq(out_rows)
         return out_full, kv_new
@@ -245,17 +319,27 @@ class MMDiTDenoiseRunner:
         return step, my_enc.shape[0], compute_dtype
 
     def _kv0(self, bloc, compute_dtype):
+        """Per-device zero stale-KV state: a bare [depth, ...] array, or —
+        with dual-attention blocks — ``{"j": [depth, ...], "d": [k, ...]}``
+        (every consumer treats the state as a pytree)."""
         mcfg = self.mcfg
         if self.cfg.attn_impl == "ring":
             chunk = mcfg.num_tokens // self.cfg.n_device_per_batch
-            return jnp.zeros(
-                (mcfg.depth, bloc, chunk, 2 * mcfg.hidden_size),
-                compute_dtype,
-            )
-        return jnp.zeros(
-            (mcfg.depth, 2, bloc, mcfg.num_tokens, mcfg.hidden_size),
-            compute_dtype,
-        )
+
+            def mk(d):
+                return jnp.zeros(
+                    (d, bloc, chunk, 2 * mcfg.hidden_size), compute_dtype
+                )
+        else:
+            def mk(d):
+                return jnp.zeros(
+                    (d, 2, bloc, mcfg.num_tokens, mcfg.hidden_size),
+                    compute_dtype,
+                )
+
+        if mcfg.dual_attention_blocks:
+            return {"j": mk(mcfg.depth), "d": mk(mcfg.dual_attention_blocks)}
+        return mk(mcfg.depth)
 
     def _device_loop(self, params, latents, enc, pooled, gs, num_steps,
                      start_step=0, end_step=None):
@@ -343,8 +427,9 @@ class MMDiTDenoiseRunner:
 
         def device_step(params, s, x, kv, sstate, enc, pooled, gs):
             step, _, _ = self._make_step(params, enc, pooled, gs, x.shape[0])
-            x, sstate, kv_new = step(x, sstate, kv[0], s, phase_sync)
-            return x, sstate, kv_new[None]
+            kv_local = jax.tree.map(lambda l: l[0], kv)
+            x, sstate, kv_new = step(x, sstate, kv_local, s, phase_sync)
+            return x, sstate, jax.tree.map(lambda l: l[None], kv_new)
 
         def stepper(params, s, x, kv, sstate, enc, pooled, gs):
             return shard_map(
@@ -366,7 +451,9 @@ class MMDiTDenoiseRunner:
         bloc = (1 if cfg.cfg_split or not cfg.do_classifier_free_guidance
                 else 2) * (batch // cfg.dp_degree)
         per_dev = self._kv0(bloc, self.params["proj_in"]["kernel"].dtype)
-        return jnp.zeros((n_total,) + per_dev.shape, per_dev.dtype)
+        return jax.tree.map(
+            lambda l: jnp.zeros((n_total,) + l.shape, l.dtype), per_dev
+        )
 
     def _exec_window(self, num_steps, start_step, end_step):
         num_exec_end = num_steps if end_step is None else end_step
@@ -439,7 +526,8 @@ class MMDiTDenoiseRunner:
                 return step(x, ss, kv, i, False), None
 
             (x, _, _), _ = lax.scan(
-                body, (x, sstate, kv[0]), jnp.arange(n_start, num_steps)
+                body, (x, sstate, jax.tree.map(lambda l: l[0], kv)),
+                jnp.arange(n_start, num_steps)
             )
             return x
 
@@ -548,16 +636,19 @@ class MMDiTDenoiseRunner:
         )
         b = batch_size * n_br_local
         n_tok, hid, depth = mcfg.num_tokens, mcfg.hidden_size, mcfg.depth
+        # dual-attention blocks (SD3.5-medium) carry and exchange a second
+        # image KV each, so they count double
+        n_attn = depth + mcfg.dual_attention_blocks
         chunk = n_tok // n
         out_gather = b * n_tok * mcfg.patch_size**2 * mcfg.out_channels
         if layout == "ring":
-            state = depth * b * chunk * 2 * hid
+            state = n_attn * b * chunk * 2 * hid
             # (n-1) ppermute hops of the local 2C chunk per block, in-step;
             # no refresh collective (next state = own fresh chunk)
-            per_step = depth * (n - 1) * b * chunk * 2 * hid + out_gather
+            per_step = n_attn * (n - 1) * b * chunk * 2 * hid + out_gather
         else:
-            state = depth * 2 * b * n_tok * hid
-            per_step = depth * 2 * b * n_tok * hid + out_gather
+            state = n_attn * 2 * b * n_tok * hid
+            per_step = n_attn * 2 * b * n_tok * hid + out_gather
         return {"layout": layout, "kv_state_elems": int(state),
                 "per_step_collective_elems": int(per_step)}
 

@@ -106,8 +106,10 @@ def test_mmdit_config_rejections():
     # anything else is not
     with pytest.raises(ValueError, match="qk_norm"):
         mm.mmdit_config_from_json({"qk_norm": "rms_norm_across_heads"})
-    with pytest.raises(ValueError, match="dual_attention"):
-        mm.mmdit_config_from_json({"dual_attention_layers": [0, 1]})
+    # contiguous-prefix dual layouts are SUPPORTED (test_mmdit_dual);
+    # anything else is not
+    with pytest.raises(ValueError, match="contiguous-prefix"):
+        mm.mmdit_config_from_json({"dual_attention_layers": [0, 2]})
     with pytest.raises(ValueError, match="pos_embed_max_size"):
         mm.MMDiTConfig(sample_size=512, patch_size=2, pos_embed_max_size=64)
     cfg = mm.mmdit_config_from_json(

@@ -1,0 +1,406 @@
+"""SD3.5-medium dual attention (diffusers dual_attention_layers).
+
+The reference predates SD3 entirely; this pins the extension's own
+contracts.  Dual blocks run a SECOND image-stream-only self-attention:
+its input is the same pre-attention LayerNorm of x modulated by the last
+3 chunks of a 9-chunk AdaLayerNormZeroX, and its gated output lands
+AFTER the joint-attention residual, BEFORE the MLP.
+
+Oracles, strongest first:
+
+* a LITERAL per-block reimplementation of the diffusers semantics (no
+  scan, no mmdit_block) pins chunk order + residual order;
+* gate-off equivalence: zeroed x_mod2 must reproduce the plain config
+  bit-exactly (the dual path cannot disturb the base model);
+* the displaced-patch runner against the sequential per-patch oracle
+  with a second per-block KV cache for attn2;
+* ring == gather, stepwise == fused, hybrid == fused (the dict-valued
+  KV state threads every execution mode).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu.models import dit as dit_mod
+from distrifuser_tpu.models import mmdit as mm
+from distrifuser_tpu.ops.attention import sdpa
+from distrifuser_tpu.ops.linear import linear
+from distrifuser_tpu.parallel.mmdit_sp import MMDiTDenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.utils.config import DistriConfig
+
+K_DUAL = 2
+
+
+def make_model(qk_norm=False):
+    mcfg = dataclasses.replace(
+        mm.tiny_mmdit_config(), dual_attention_blocks=K_DUAL, qk_norm=qk_norm
+    )
+    params = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+    # break the ones-init symmetry so the qk-norm weights actually act
+    if qk_norm:
+        rng = np.random.RandomState(3)
+
+        def jitter(leaf):
+            if leaf.ndim == 2:  # stacked per-depth [depth, head_dim]
+                return leaf * jnp.asarray(
+                    rng.rand(*leaf.shape) + 0.5, leaf.dtype
+                )
+            return leaf
+
+        for name in ("x2_qnorm", "x2_knorm"):
+            params["blocks_dual"][name] = jitter(params["blocks_dual"][name])
+    return mcfg, params
+
+
+def make_inputs(mcfg, batch=1, lc=5):
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (batch, mcfg.sample_size, mcfg.sample_size, mcfg.in_channels)
+    )
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, batch, lc, mcfg.joint_attention_dim)
+    )
+    pooled = jax.random.normal(
+        jax.random.fold_in(k, 2), (2, batch, mcfg.pooled_projection_dim)
+    )
+    return lat, enc, pooled
+
+
+# ---------------------------------------------------------------------------
+# literal diffusers-semantics oracle (independent of mmdit_block)
+# ---------------------------------------------------------------------------
+
+
+def _literal_forward(params, cfg, x, t, enc, pooled):
+    """Straight-line reimplementation of the dual-attention MMDiT forward
+    following the published diffusers JointTransformerBlock semantics."""
+    silu = jax.nn.silu
+
+    def lin(p, h):
+        out = h @ p["kernel"]
+        return out + p["bias"] if "bias" in p else out
+
+    def ln(h):
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        return (h - mu) / jnp.sqrt(var + 1e-6)
+
+    def rms(h, w):
+        b, l, c = h.shape
+        d = c // cfg.num_heads
+        hh = h.reshape(b, l, cfg.num_heads, d)
+        y = hh / jnp.sqrt((hh * hh).mean(-1, keepdims=True) + 1e-6)
+        return (y * w).reshape(b, l, c)
+
+    def attention(q, k, v):
+        b, lq, c = q.shape
+        d = c // cfg.num_heads
+        qh = q.reshape(b, lq, cfg.num_heads, d).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, k.shape[1], cfg.num_heads, d).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, v.shape[1], cfg.num_heads, d).transpose(0, 2, 1, 3)
+        w = jax.nn.softmax(qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d), -1)
+        return (w @ vh).transpose(0, 2, 1, 3).reshape(b, lq, c)
+
+    tokens = dit_mod.patchify(cfg, x)
+    h = lin(params["proj_in"], tokens) + mm.pos_embed_cropped(cfg)[None]
+    ctx = lin(params["ctx_in"], enc)
+    vec = mm.cond_vec(params, cfg, t, pooled)
+
+    for i in range(cfg.depth):
+        bp = jax.tree.map(lambda l: l[i], params["blocks"])
+        dual = i < cfg.dual_attention_blocks
+        xm = lin(bp["x_mod"], silu(vec))
+        xs1, xsc1, xg1, xs2, xsc2, xg2 = [
+            c[:, None, :] for c in jnp.split(xm, 6, -1)
+        ]
+        cm = lin(bp["c_mod"], silu(vec))
+        cs1, csc1, cg1, cs2, csc2, cg2 = [
+            c[:, None, :] for c in jnp.split(cm, 6, -1)
+        ]
+        xln = ln(h)
+        xn = xln * (1 + xsc1) + xs1
+        cn = ln(ctx) * (1 + csc1) + cs1
+        xq, xk, xv = jnp.split(lin(bp["x_qkv"], xn), 3, -1)
+        cq, ck, cv = jnp.split(lin(bp["c_qkv"], cn), 3, -1)
+        if cfg.qk_norm:
+            xq, xk = rms(xq, bp["x_qnorm"]), rms(xk, bp["x_knorm"])
+            cq, ck = rms(cq, bp["c_qnorm"]), rms(ck, bp["c_knorm"])
+        att = attention(
+            jnp.concatenate([cq, xq], 1),
+            jnp.concatenate([ck, xk], 1),
+            jnp.concatenate([cv, xv], 1),
+        )
+        lc = ctx.shape[1]
+        # diffusers residual order: joint attention output first...
+        h = h + xg1 * lin(bp["x_out"], att[:, lc:])
+        ctx = ctx + cg1 * lin(bp["c_out"], att[:, :lc])
+        if dual:
+            dp = jax.tree.map(lambda l: l[i], params["blocks_dual"])
+            dm = lin(dp["x_mod2"], silu(vec))
+            d_s, d_sc, d_g = [c[:, None, :] for c in jnp.split(dm, 3, -1)]
+            # ...then attn2 on the SAME pre-attention LayerNorm of x,
+            # modulated by the LAST 3 chunks of AdaLayerNormZeroX...
+            xn2 = xln * (1 + d_sc) + d_s
+            q2, k2, v2 = jnp.split(lin(dp["x2_qkv"], xn2), 3, -1)
+            if cfg.qk_norm:
+                q2, k2 = rms(q2, dp["x2_qnorm"]), rms(k2, dp["x2_knorm"])
+            h = h + d_g * lin(dp["x2_out"], attention(q2, k2, v2))
+        # ...then the MLP on the UPDATED x
+        xn2m = ln(h) * (1 + xsc2) + xs2
+        h = h + xg2 * lin(
+            bp["x_fc2"], jax.nn.gelu(lin(bp["x_fc1"], xn2m), approximate=True)
+        )
+        cn2m = ln(ctx) * (1 + csc2) + cs2
+        ctx = ctx + cg2 * lin(
+            bp["c_fc2"], jax.nn.gelu(lin(bp["c_fc1"], cn2m), approximate=True)
+        )
+
+    shift, scale = [
+        c[:, None, :]
+        for c in jnp.split(lin(params["final_mod"], silu(vec)), 2, -1)
+    ]
+    out = lin(params["final_out"], ln(h) * (1 + scale) + shift)
+    return dit_mod.unpatchify(cfg, out, cfg.out_channels)
+
+
+@pytest.mark.parametrize("qk_norm", [False, True])
+def test_dense_matches_literal_oracle(qk_norm):
+    mcfg, params = make_model(qk_norm=qk_norm)
+    lat, enc, pooled = make_inputs(mcfg)
+    got = mm.mmdit_forward(params, mcfg, lat, jnp.asarray(400.0), enc[0],
+                           pooled[0])
+    ref = _literal_forward(params, mcfg, lat, jnp.asarray(400.0), enc[0],
+                           pooled[0])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gate_off_equals_plain():
+    """Zeroed dual modulation (gate2 == 0) reproduces the plain config
+    bit-exactly on the shared weights — the dual path cannot perturb the
+    base model."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    p_zero = dict(params)
+    p_zero["blocks_dual"] = jax.tree.map(jnp.zeros_like,
+                                         params["blocks_dual"])
+    plain_cfg = dataclasses.replace(mcfg, dual_attention_blocks=0)
+    p_plain = {k: v for k, v in params.items() if k != "blocks_dual"}
+    out_z = mm.mmdit_forward(p_zero, mcfg, lat, jnp.asarray(500.0), enc[0],
+                             pooled[0])
+    out_p = mm.mmdit_forward(p_plain, plain_cfg, lat, jnp.asarray(500.0),
+                             enc[0], pooled[0])
+    np.testing.assert_array_equal(np.asarray(out_z), np.asarray(out_p))
+    # and the un-zeroed dual weights actually engage
+    out_d = mm.mmdit_forward(params, mcfg, lat, jnp.asarray(500.0), enc[0],
+                             pooled[0])
+    assert np.abs(np.asarray(out_d) - np.asarray(out_p)).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# displaced-patch oracle with a second KV cache for attn2
+# ---------------------------------------------------------------------------
+
+
+def oracle_displaced_dual(params, mcfg, sched, lat, enc, pooled, gs,
+                          num_steps, warmup_steps, n, do_cfg=True):
+    sched.set_timesteps(num_steps)
+    ts = sched.timesteps()
+    x = dit_mod.patchify(mcfg, lat.astype(jnp.float32))
+    batch, n_tok, _ = x.shape
+    chunk = n_tok // n
+    n_sync = min(warmup_steps + 1, num_steps)
+    hid = mcfg.hidden_size
+    k_dual = mcfg.dual_attention_blocks
+    pos = mm.pos_embed_cropped(mcfg, jnp.float32)
+    branches = (0, 1) if do_cfg else (0,)
+
+    ctx0 = {br: linear(params["ctx_in"], enc[br]) for br in branches}
+    zkv = lambda: (jnp.zeros((batch, n_tok, hid)),
+                   jnp.zeros((batch, n_tok, hid)))
+    cache = {br: [zkv() for _ in range(mcfg.depth)] for br in branches}
+    cache2 = {br: [zkv() for _ in range(k_dual)] for br in branches}
+    sstate = sched.init_state(x.shape)
+
+    def run_stack(br, tokens, s, sync, offset):
+        vec = mm.cond_vec(params, mcfg, ts[s], pooled[br])
+        pos_rows = jax.lax.dynamic_slice_in_dim(pos, offset,
+                                                tokens.shape[1], 0)
+        h = linear(params["proj_in"], tokens) + pos_rows[None]
+        ctx = ctx0[br]
+        fresh, fresh2 = [], []
+
+        def mk_assemble(store, l):
+            def assemble(k, v):
+                if sync:
+                    return k, v
+                ck, cv = store[br][l]
+                return (
+                    jax.lax.dynamic_update_slice(ck, k, (0, offset, 0)),
+                    jax.lax.dynamic_update_slice(cv, v, (0, offset, 0)),
+                )
+            return assemble
+
+        for l in range(mcfg.depth):
+            bp = jax.tree.map(lambda a: a[l], params["blocks"])
+            if l < k_dual:
+                dp = jax.tree.map(lambda a: a[l], params["blocks_dual"])
+                h, ctx, (k, v), (k2, v2) = mm.mmdit_block(
+                    bp, mcfg, h, ctx, vec,
+                    kv_assemble=mk_assemble(cache, l),
+                    dual_p=dp, kv2_assemble=mk_assemble(cache2, l),
+                )
+                fresh2.append((k2, v2))
+            else:
+                h, ctx, (k, v) = mm.mmdit_block(
+                    bp, mcfg, h, ctx, vec, kv_assemble=mk_assemble(cache, l)
+                )
+            fresh.append((k, v))
+        return mm.final_layer(params, mcfg, h, vec), fresh, fresh2
+
+    def combine(out):
+        if not do_cfg:
+            return out[0]
+        return out[0] + gs * (out[1] - out[0])
+
+    for s in range(num_steps):
+        x_in = sched.scale_model_input(x, s)
+        if s < n_sync:
+            out = {}
+            for br in branches:
+                out[br], fr, fr2 = run_stack(br, x_in, s, True, 0)
+                cache[br], cache2[br] = fr, fr2
+        else:
+            out = {br: [] for br in branches}
+            f_all = {br: [[] for _ in range(mcfg.depth)] for br in branches}
+            f2_all = {br: [[] for _ in range(k_dual)] for br in branches}
+            for p in range(n):
+                rows = x_in[:, p * chunk:(p + 1) * chunk]
+                for br in branches:
+                    e, fr, fr2 = run_stack(br, rows, s, False, p * chunk)
+                    out[br].append(e)
+                    for l in range(mcfg.depth):
+                        f_all[br][l].append(fr[l])
+                    for l in range(k_dual):
+                        f2_all[br][l].append(fr2[l])
+            out = {br: jnp.concatenate(v, axis=1) for br, v in out.items()}
+
+            def cat(parts):
+                return (jnp.concatenate([kv[0] for kv in parts], axis=1),
+                        jnp.concatenate([kv[1] for kv in parts], axis=1))
+
+            for br in branches:
+                cache[br] = [cat(f_all[br][l]) for l in range(mcfg.depth)]
+                cache2[br] = [cat(f2_all[br][l]) for l in range(k_dual)]
+        x, sstate = sched.step(x, combine(out).astype(jnp.float32), s,
+                               sstate)
+
+    return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
+
+
+def sp_config(n_dev, do_cfg, **kw):
+    return DistriConfig(
+        devices=jax.devices()[:n_dev], height=256, width=256,
+        do_classifier_free_guidance=do_cfg, split_batch=do_cfg, **kw,
+    )
+
+
+def test_full_sync_matches_dense():
+    from tests.test_mmdit_sp import dense_loop
+
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, mode="full_sync")
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=3)
+    ref = dense_loop(params, mcfg, get_scheduler("flow-euler"), lat, enc,
+                     pooled, 1.0, 3, do_cfg=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_displaced_matches_oracle():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1)
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    out = runner.generate(lat, enc, pooled, guidance_scale=1.0,
+                          num_inference_steps=6)
+    ref = oracle_displaced_dual(
+        params, mcfg, get_scheduler("flow-euler"), lat, enc, pooled, 1.0, 6,
+        warmup_steps=1, n=4, do_cfg=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_matches_gather():
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    outs = {}
+    for impl in ("gather", "ring"):
+        cfg = sp_config(4, do_cfg=False, warmup_steps=1, attn_impl=impl)
+        runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                    get_scheduler("flow-euler"))
+        outs[impl] = np.asarray(runner.generate(
+            lat, enc, pooled, guidance_scale=1.0, num_inference_steps=5
+        ))
+    np.testing.assert_allclose(outs["ring"], outs["gather"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_stepwise_and_hybrid_match_fused():
+    """The dict-valued KV state (joint + attn2) crosses the shard_map
+    boundary in the stepwise layout and the hybrid handoff."""
+    mcfg, params = make_model()
+    lat, enc, pooled = make_inputs(mcfg)
+    kw = dict(guidance_scale=1.0, num_inference_steps=5)
+    fused = np.asarray(
+        MMDiTDenoiseRunner(sp_config(4, do_cfg=False, warmup_steps=1),
+                           mcfg, params, get_scheduler("flow-euler"))
+        .generate(lat, enc, pooled, **kw))
+    stepwise = np.asarray(
+        MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, use_cuda_graph=False),
+            mcfg, params, get_scheduler("flow-euler"))
+        .generate(lat, enc, pooled, **kw))
+    np.testing.assert_allclose(stepwise, fused, rtol=2e-4, atol=2e-4)
+    hybrid = np.asarray(
+        MMDiTDenoiseRunner(
+            sp_config(4, do_cfg=False, warmup_steps=1, hybrid_loop=True),
+            mcfg, params, get_scheduler("flow-euler"))
+        .generate(lat, enc, pooled, **kw))
+    np.testing.assert_allclose(hybrid, fused, rtol=2e-4, atol=2e-4)
+
+
+def test_comm_report_counts_dual():
+    mcfg, params = make_model()
+    cfg = sp_config(4, do_cfg=False, warmup_steps=1)
+    runner = MMDiTDenoiseRunner(cfg, mcfg, params,
+                                get_scheduler("flow-euler"))
+    rep = runner.comm_report()
+    n_attn = mcfg.depth + mcfg.dual_attention_blocks
+    assert rep["kv_state_elems"] == (
+        n_attn * 2 * mcfg.num_tokens * mcfg.hidden_size
+    )
+
+
+def test_config_from_json_prefix():
+    cfg = mm.mmdit_config_from_json(
+        {"num_layers": 4, "num_attention_heads": 4, "attention_head_dim": 8,
+         "sample_size": 32, "dual_attention_layers": [0, 1],
+         "qk_norm": "rms_norm"}
+    )
+    assert cfg.dual_attention_blocks == 2 and cfg.qk_norm
+    with pytest.raises(ValueError, match="contiguous-prefix"):
+        mm.mmdit_config_from_json({"dual_attention_layers": [1, 2]})
+    with pytest.raises(ValueError, match="dual_attention_blocks"):
+        dataclasses.replace(mm.tiny_mmdit_config(), dual_attention_blocks=9)

@@ -18,11 +18,12 @@ from distrifuser_tpu.models.weights import convert_mmdit_state_dict
 CFG = mm.tiny_mmdit_config(depth=2)
 
 
-def synth_sd(seed=0):
+def synth_sd(seed=0, n_dual=0, cfg=None):
+    cfg = cfg or CFG
     rng = np.random.RandomState(seed)
-    h = CFG.hidden_size
-    mlp = CFG.mlp_ratio * h
-    ps, c = CFG.patch_size, CFG.in_channels
+    h = cfg.hidden_size
+    mlp = cfg.mlp_ratio * h
+    ps, c = cfg.patch_size, cfg.in_channels
     sd = {}
 
     def lin(key, o, i):
@@ -32,19 +33,20 @@ def synth_sd(seed=0):
     sd["pos_embed.proj.weight"] = rng.randn(h, c, ps, ps).astype(np.float32) * 0.05
     sd["pos_embed.proj.bias"] = rng.randn(h).astype(np.float32) * 0.05
     sd["pos_embed.pos_embed"] = np.zeros((1, 64 * 64, h), np.float32)  # ignored
-    lin("context_embedder", h, CFG.joint_attention_dim)
+    lin("context_embedder", h, cfg.joint_attention_dim)
     lin("time_text_embed.timestep_embedder.linear_1", h,
-        CFG.frequency_embedding_size)
+        cfg.frequency_embedding_size)
     lin("time_text_embed.timestep_embedder.linear_2", h, h)
     lin("time_text_embed.text_embedder.linear_1", h,
-        CFG.pooled_projection_dim)
+        cfg.pooled_projection_dim)
     lin("time_text_embed.text_embedder.linear_2", h, h)
     lin("norm_out.linear", 2 * h, h)
-    lin("proj_out", ps * ps * CFG.out_channels, h)
-    for i in range(CFG.depth):
+    lin("proj_out", ps * ps * cfg.out_channels, h)
+    for i in range(cfg.depth):
         b = f"transformer_blocks.{i}"
-        last = i == CFG.depth - 1
-        lin(f"{b}.norm1.linear", 6 * h, h)
+        last = i == cfg.depth - 1
+        dual = i < n_dual
+        lin(f"{b}.norm1.linear", (9 if dual else 6) * h, h)
         lin(f"{b}.norm1_context.linear", (2 if last else 6) * h, h)
         for n in ("to_q", "to_k", "to_v"):
             lin(f"{b}.attn.{n}", h, h)
@@ -53,6 +55,10 @@ def synth_sd(seed=0):
         lin(f"{b}.attn.to_out.0", h, h)
         lin(f"{b}.ff.net.0.proj", mlp, h)
         lin(f"{b}.ff.net.2", h, mlp)
+        if dual:
+            for n in ("to_q", "to_k", "to_v"):
+                lin(f"{b}.attn2.{n}", h, h)
+            lin(f"{b}.attn2.to_out.0", h, h)
         if not last:
             lin(f"{b}.attn.add_q_proj", h, h)
             lin(f"{b}.attn.to_add_out", h, h)
@@ -130,6 +136,64 @@ def test_converted_forward_runs():
     out = mm.mmdit_forward(tree, CFG, x, jnp.asarray(400.0), enc, pooled)
     assert out.shape == x.shape[:3] + (CFG.out_channels,)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dual_attention_convert():
+    """SD3.5-medium layout: attn2 + 9-chunk AdaLayerNormZeroX on the dual
+    prefix converts onto the blocks_dual layout; x_mod keeps the FIRST 6
+    chunks and x_mod2 gets the LAST 3; non-prefix layouts are rejected."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, depth=3, dual_attention_blocks=2)
+    sd = synth_sd(n_dual=2, cfg=cfg)
+    tree = convert_mmdit_state_dict(sd)
+    ref = mm.init_mmdit_params(jax.random.PRNGKey(0), cfg)
+    assert (jax.tree.map(lambda l: tuple(np.shape(l)), tree)
+            == jax.tree.map(lambda l: l.shape, ref))
+    h = cfg.hidden_size
+    w9 = sd["transformer_blocks.0.norm1.linear.weight"]
+    b9 = sd["transformer_blocks.0.norm1.linear.bias"]
+    blk0 = jax.tree.map(lambda l: np.asarray(l)[0], tree["blocks"])
+    d0 = jax.tree.map(lambda l: np.asarray(l)[0], tree["blocks_dual"])
+    np.testing.assert_array_equal(blk0["x_mod"]["kernel"], w9[:6 * h].T)
+    np.testing.assert_array_equal(d0["x_mod2"]["kernel"], w9[6 * h:].T)
+    np.testing.assert_array_equal(d0["x_mod2"]["bias"], b9[6 * h:])
+    np.testing.assert_array_equal(
+        d0["x2_qkv"]["kernel"][:, :h],
+        sd["transformer_blocks.0.attn2.to_q.weight"].T)
+    np.testing.assert_array_equal(
+        d0["x2_out"]["kernel"],
+        sd["transformer_blocks.0.attn2.to_out.0.weight"].T)
+    # converted tree runs end-to-end
+    out = mm.mmdit_forward(
+        tree, cfg,
+        jnp.zeros((1, cfg.sample_size, cfg.sample_size, cfg.in_channels)),
+        jnp.asarray(300.0),
+        jnp.zeros((1, 5, cfg.joint_attention_dim)),
+        jnp.zeros((1, cfg.pooled_projection_dim)),
+    )
+    assert np.isfinite(np.asarray(out)).all()
+    # a non-prefix dual layout (attn2 on block 1 only) is rejected
+    bad = {k: v for k, v in sd.items()
+           if not (k.startswith("transformer_blocks.0.attn2")
+                   or k.startswith("transformer_blocks.0.norm1.linear"))}
+    bad["transformer_blocks.0.norm1.linear.weight"] = (
+        np.zeros((6 * h, h), np.float32))
+    bad["transformer_blocks.0.norm1.linear.bias"] = (
+        np.zeros((6 * h,), np.float32))
+    for n in ("to_q", "to_k", "to_v"):
+        bad[f"transformer_blocks.1.attn2.{n}.weight"] = (
+            np.zeros((h, h), np.float32))
+        bad[f"transformer_blocks.1.attn2.{n}.bias"] = (
+            np.zeros((h,), np.float32))
+    bad["transformer_blocks.1.attn2.to_out.0.weight"] = (
+        np.zeros((h, h), np.float32))
+    bad["transformer_blocks.1.attn2.to_out.0.bias"] = (
+        np.zeros((h,), np.float32))
+    import pytest
+
+    with pytest.raises(ValueError, match="contiguous-prefix"):
+        convert_mmdit_state_dict(bad)
 
 
 def test_qk_norm_keys_convert(tmp_path):
