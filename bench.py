@@ -259,8 +259,15 @@ def main():
                 lat, enc, guidance_scale=5.0, num_inference_steps=args.steps,
                 added_cond=added,
             )
-            jax.block_until_ready(out)
-            return out
+            # device_get, NOT block_until_ready: on the tunneled axon backend
+            # block_until_ready can return before compute finishes for
+            # programs carrying explicit-tile Pallas calls (campaign r5
+            # measured 0.02 ms "latencies" and a 64 ms 50-step generation
+            # that way).  A forced host transfer of the final latents is a
+            # data dependency on the whole step chain and cannot be escaped;
+            # it adds only the latents' ~0.3 MB transfer (~10 ms) to a
+            # multi-second measurement.
+            return jax.device_get(out)
 
         return run
 
@@ -296,6 +303,19 @@ def main():
             run()
         return run
 
+    def _analytic_step_flops(px: int) -> float:
+        """Analytic FLOPs for one CFG-folded SDXL denoise step.
+
+        13.12 TFLOP is the scan-corrected cost_analysis number at 1024^2
+        (BENCH_NOTES round-4 roofline) — exact at 1024.  Elsewhere it is a
+        LOWER bound (the floor check needs that direction): quadratic
+        scaling above 1024 under-counts attention's quartic term; below
+        1024 quadratic would OVER-count it, so scale quartically there —
+        under everything, over nothing.
+        """
+        ratio = px / 1024
+        return 13.12e12 * (ratio ** 2 if ratio >= 1.0 else ratio ** 4)
+
     _flops_cache = {}
 
     def _print_mfu(gen_seconds: float) -> None:
@@ -323,7 +343,15 @@ def main():
                     p, ucfg, s, jnp.asarray([500.0] * (2 * b)), e,
                     added_cond=added2))
                 cost = fn.lower(params, sample, e2).cost_analysis()
-                _flops_cache["fwd"] = float(cost.get("flops", 0.0))
+                flops = float(cost.get("flops", 0.0)) if cost else 0.0
+                if flops <= 0:
+                    # axon's TPU lowering returns cost_analysis()=None
+                    # (observed jax 0.9.0, campaign r5); fall back to the
+                    # analytic count so the MFU line still lands
+                    flops = _analytic_step_flops(size)
+                    print("mfu: cost_analysis unavailable, using analytic "
+                          "step FLOPs", file=sys.stderr, flush=True)
+                _flops_cache["fwd"] = flops
             total = _flops_cache["fwd"] * args.steps
             if total <= 0:
                 return
@@ -338,6 +366,16 @@ def main():
             print(f"mfu line skipped: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
+    # Physical floor for one generation: per-step FLOPs (lower bound, see
+    # _analytic_step_flops) at 100% of bf16 peak.  A measurement below
+    # this is a broken measurement (async escape), never a fast chip —
+    # refuse to record it.
+    def _plausibility_floor_s() -> float:
+        if preset != "sdxl":
+            return 0.0
+        return (_analytic_step_flops(size) * args.steps
+                / (args.peak_tflops * 1e12))
+
     def measure(mode: str) -> dict:
         run = warmup_with_flash_fallback(mode)
         times = []
@@ -346,6 +384,12 @@ def main():
             run()
             times.append(time.perf_counter() - t0)
         val = statistics.median(times)
+        floor = _plausibility_floor_s()
+        if on_tpu and val < floor:
+            raise RuntimeError(
+                f"implausible {mode} measurement {val:.4f}s < roofline floor "
+                f"{floor:.2f}s (100% bf16 peak) — async-dispatch escape, "
+                "not recording")
         # baseline scaled to the actual step count (it is per-50-step-gen)
         vs = (
             (A100_SDXL_1024_50STEP_S * args.steps / 50) / val
@@ -376,14 +420,20 @@ def main():
             # separate hybrid rung here — hybrid pays off multi-chip, where
             # the scripts' --hybrid_loop flag (DistriConfig.hybrid_loop)
             # selects it; bench.py's --mode only covers auto/fused/stepwise.
-            _BEST.update(measure("stepwise"))
-            print(f"stepwise result recorded: {_BEST} "
-                  f"({remaining():.0f}s budget left)", file=sys.stderr,
-                  flush=True)
+            try:
+                _BEST.update(measure("stepwise"))
+                print(f"stepwise result recorded: {_BEST} "
+                      f"({remaining():.0f}s budget left)", file=sys.stderr,
+                      flush=True)
+            except Exception as e:
+                # keep going: the fused attempt below may still land a
+                # plausible number
+                print(f"stepwise attempt failed ({type(e).__name__}: {e})",
+                      file=sys.stderr, flush=True)
             if remaining() > args.fused_min_budget_s:
                 try:
                     r = measure("fused")
-                    if 0 < r["value"] < _BEST["value"]:
+                    if 0 < r["value"] < _BEST.get("value", float("inf")):
                         # plain update (same four keys): no instant where the
                         # watchdog could observe an empty _BEST
                         _BEST.update(r)
@@ -394,6 +444,8 @@ def main():
             else:
                 print("skipping fused attempt: insufficient budget",
                       file=sys.stderr, flush=True)
+            if not _BEST:
+                raise RuntimeError("no mode produced a plausible measurement")
             # one MFU line for whichever mode won, before the final emit
             _print_mfu(_BEST["value"])
             _emit(_BEST)

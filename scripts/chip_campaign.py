@@ -124,16 +124,35 @@ def main():
     emit("init", ok=True, platform=dev.platform,
          init_s=round(time.time() - t0, 1))
 
+    import numpy as np
+
     def left():
         return args.deadline_s - (time.time() - START)
 
-    def timed(fn, iters):
-        fn().block_until_ready()
-        t = time.perf_counter()
-        for _ in range(iters):
-            out = fn()
-        out.block_until_ready()
-        return (time.perf_counter() - t) / iters
+    def timed(step, x0, iters, reps=3):
+        """Seconds per application of ``step`` (an x -> same-shape-x map).
+
+        Chains ``iters`` applications inside ONE jit via fori_loop and
+        reduces the final value to a SCALAR, then np.asarray's it: the
+        scalar data-depends on every iteration (fori_loop carries cannot be
+        dead-code-eliminated), so compute is forced, while the host
+        transfer is 4 bytes — nothing to subtract.  The first campaign_r5
+        run timed independent dispatches with block_until_ready and
+        recorded 0.02 ms "latencies" at L=16384 — on the tunneled axon
+        backend block_until_ready can return before compute finishes for
+        explicit-tile Pallas programs.  (A whole-tensor transfer with a
+        baseline subtraction was tried first, but jax.Array caches its
+        host copy, so a "ready buffer" baseline reads ~0 and the 10-40 MB
+        tunnel transfer silently lands in the kernel time.)"""
+        chain = jax.jit(lambda x: jnp.sum(jax.lax.fori_loop(
+            0, iters, lambda i, y: step(y), x)).astype(jnp.float32))
+        np.asarray(chain(x0))  # compile + settle
+        vals = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(chain(x0))
+            vals.append(time.perf_counter() - t0)
+        return statistics.median(vals) / iters
 
     # ---------------- attn: impl comparison at SDXL shapes ----------------
     if "attn" in phases and left() > 600:
@@ -161,21 +180,22 @@ def main():
             k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
             v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
 
-            def xla_path():
+            # each impl as an x -> x map (out has q's shape) so timed() can
+            # chain iterations by data dependency
+            def xla_path(x):
                 return _sdpa_xla(
-                    q.reshape(2, L, H, d), k.reshape(2, L, H, d),
+                    x.reshape(2, L, H, d), k.reshape(2, L, H, d),
                     v.reshape(2, L, H, d), 1.0 / d**0.5,
                 ).reshape(2, L, C)
 
             res = {}
             for name, fn in [
-                ("xla", jax.jit(xla_path)),
-                ("inrepo", jax.jit(lambda: flash_sdpa(q, k, v, heads=H))),
-                ("upstream", jax.jit(
-                    lambda: upstream_flash_sdpa(q, k, v, heads=H))),
+                ("xla", xla_path),
+                ("inrepo", lambda x: flash_sdpa(x, k, v, heads=H)),
+                ("upstream", lambda x: upstream_flash_sdpa(x, k, v, heads=H)),
             ]:
                 try:
-                    res[name] = round(timed(fn, 20) * 1e3, 3)
+                    res[name] = round(timed(fn, q, 20) * 1e3, 3)
                 except Exception as e:
                     res[name] = f"failed:{type(e).__name__}"
             emit("attn", L=L, heads=H, head_dim=d, ms=res)
@@ -209,9 +229,9 @@ def main():
                         continue
                     try:
                         res[f"{bq}x{bk}"] = round(timed(
-                            jax.jit(lambda bq=bq, bk=bk, kern=kernel: kern(
-                                q, k, v, heads=H, block_q=bq, block_k=bk)),
-                            10,
+                            lambda x, bq=bq, bk=bk, kern=kernel: kern(
+                                x, k, v, heads=H, block_q=bq, block_k=bk),
+                            q, 10,
                         ) * 1e3, 3)
                     except Exception as e:
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
@@ -253,8 +273,10 @@ def main():
             out = runner.generate(lat, enc, guidance_scale=5.0,
                                   num_inference_steps=args.steps,
                                   added_cond=added)
-            jax.block_until_ready(out)
-            return out
+            # forced host transfer: data-depends on the full step chain, so
+            # the axon async-dispatch escape (see timed()) cannot shortcut
+            # the measurement; the latents are ~0.3 MB, negligible here
+            return jax.device_get(out)
 
         tc0 = time.time()
         run()  # warmup/compile
@@ -296,6 +318,14 @@ def main():
             bench_unet(size, stepwise, label, flash, impl, dt)
         except Exception as e:
             emit(label, ok=False, error=f"{type(e).__name__}: {str(e)[:200]}")
+        finally:
+            # drop every live executable + its device scratch between
+            # phases: the first r5 campaign kept b1024_step's ~50 per-step
+            # programs alive and every later phase OOMed (HBM holds one
+            # 2.6B-param model + one program set, not two)
+            import gc
+            jax.clear_caches()
+            gc.collect()
 
     # ---------------- trace: profiler capture ------------------------------
     if "trace" in phases and left() > 300:

@@ -1,0 +1,85 @@
+"""One-off probe: is the upstream flash kernel CORRECT at tuned tiles?
+
+Campaign r5 measured physically-impossible timings (0.02 ms at L=16384,
+block_q >= 512) from the upstream kernel, and the first post-table bench
+collapsed to an impossible 64 ms / 50 steps with the tuned (256, 1024)
+route active.  Hypothesis: at some BlockSizes the upstream kernel silently
+produces garbage (fast) instead of failing.  This probe, per shape+tile:
+
+  * computes the kernel output and a chunked-XLA reference;
+  * reports max|diff| and whether the output is finite;
+  * times the kernel with a forced device->host transfer (np.asarray), which
+    cannot be fooled by async-dispatch escapes.
+
+Appends nothing to the campaign log — human-readable stderr/stdout only.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def ref_sdpa(q, k, v, heads):
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    d = c // heads
+    qh = q.reshape(b, lq, heads, d).astype(jnp.float32)
+    kh = k.reshape(b, lk, heads, d).astype(jnp.float32)
+    vh = v.reshape(b, lk, heads, d).astype(jnp.float32)
+    # chunk queries so L=16384 fits without the O(L^2) buffer all at once
+    outs = []
+    step = 2048
+    for s in range(0, lq, step):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh[:, s:s + step], kh) / d**0.5
+        w = jax.nn.softmax(logits, axis=-1)
+        outs.append(jnp.einsum("bhqk,bkhd->bqhd", w, vh))
+    return jnp.concatenate(outs, axis=1).reshape(b, lq, c)
+
+
+def main():
+    from distrifuser_tpu.ops.flash_attention import upstream_flash_sdpa
+
+    cases = [
+        (4096, 640, 10, None, None),
+        (4096, 640, 10, 256, 1024),   # the tuned route bench.py just used
+        (4096, 640, 10, 512, 1024),
+        (16384, 640, 10, 256, 2048),  # tuned 16k route
+        (16384, 640, 10, 512, 512),   # one of the 0.02 ms readings
+    ]
+    for (L, C, H, bq, bk) in cases:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (2, L, C), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
+
+        kw = {}
+        if bq is not None:
+            kw = {"block_q": bq, "block_k": bk}
+        fn = jax.jit(lambda q, k, v: upstream_flash_sdpa(q, k, v, heads=H, **kw))
+        try:
+            out = np.asarray(fn(q, k, v))
+        except Exception as e:
+            print(f"L={L} tiles={bq}x{bk}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+            continue
+        ref = np.asarray(ref_sdpa(q, k, v, H), dtype=np.float32)
+        diff = float(np.max(np.abs(out.astype(np.float32) - ref)))
+        finite = bool(np.isfinite(out.astype(np.float32)).all())
+        # timed with forced host transfer
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(fn(q, k, v))
+            times.append(time.perf_counter() - t0)
+        ms = sorted(times)[len(times) // 2] * 1e3
+        verdict = "OK" if diff < 0.05 and finite else "GARBAGE"
+        print(f"L={L} tiles={bq}x{bk}: max|diff|={diff:.4f} finite={finite} "
+              f"median_ms={ms:.3f} -> {verdict}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

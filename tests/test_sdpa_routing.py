@@ -98,7 +98,8 @@ def test_unmeasured_falls_to_analytic_default(monkeypatch):
 
 
 def test_lookup_requires_matching_head_dim():
-    assert sdpa_routing.lookup(4096, 64) is None  # shipped table is empty
+    # shipped table contents change with every campaign re-bake; pin only
+    # the lookup semantics against a controlled table
     table = {(64, 12): Route("upstream")}
     old = sdpa_routing.MEASURED_ROUTES
     sdpa_routing.MEASURED_ROUTES = table
@@ -181,8 +182,10 @@ def test_updater_round_trip(tmp_path):
          "ms": {"xla": 2.0, "inrepo": 1.5, "upstream": 1.0}},
         {"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
          "ms": {"xla": 9.0, "inrepo": 8.0, "upstream": "failed:XlaError"}},
+        # 7.5 ms sits just above the L=16384 roofline floor (~6.98 ms at
+        # 100% bf16 peak) — the sanity guard must keep it
         {"phase": "tune", "L": 16384, "heads": 10, "head_dim": 64,
-         "ms": {"128x128": 8.0, "256x512": 6.5}},
+         "ms": {"128x128": 8.0, "256x512": 7.5}},
         {"phase": "b1024", "size": 1024, "s": 7.0},  # ignored: no ms dict
     ]
     log.write_text("non-json noise\n"
@@ -200,6 +203,36 @@ def test_updater_round_trip(tmp_path):
     exec(block.replace(upd.BEGIN, "").replace(upd.END, ""), ns)
     assert ns["MEASURED_ROUTES"][(64, 14)] == Route("inrepo", 256, 512)
     assert ns["MEASURED_PROVENANCE"] == "unit-test"
+
+
+def test_updater_drops_subroofline_timings(tmp_path):
+    """Campaign r5 regression: upstream-flash tune entries of ~0.02 ms at
+    L=16384 (350x above bf16 peak — the kernel degenerates at those tiles
+    instead of failing) must not reach the table; the sane sub-peak tiles
+    of the same sweep still win."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
+         "ms": {"xla": "failed:JaxRuntimeError", "inrepo": 184.9,
+                "upstream": 161.8}},
+        {"phase": "tune", "L": 16384, "heads": 10, "head_dim": 64,
+         "ms": {"512x1024": 25.9}},
+        {"phase": "tune_upstream", "L": 16384, "heads": 10, "head_dim": 64,
+         "ms": {"256x2048": 23.2, "512x512": 0.022, "1024x512": 0.019}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    impl, bq, bk, _comment = routes[(64, 14)]
+    assert (impl, bq, bk) == ("upstream", 256, 2048)  # not the 0.02ms tiles
+    # an attn record that is ENTIRELY sub-floor contributes nothing
+    attn2 = [{"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
+              "ms": {"xla": 0.01, "upstream": 0.02}}]
+    assert upd.build_routes(attn2, []) == {}
 
 
 def test_sdpa_still_computes_on_cpu(monkeypatch):
