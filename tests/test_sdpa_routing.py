@@ -36,6 +36,9 @@ def _clean_flash_env(monkeypatch):
     for var in ("DISTRIFUSER_TPU_FLASH", "DISTRIFUSER_TPU_FLASH_IMPL",
                 "DISTRIFUSER_TPU_FLASH_BQ", "DISTRIFUSER_TPU_FLASH_BK"):
         monkeypatch.delenv(var, raising=False)
+    # the shipped model-validated override would shadow every monkeypatched
+    # MEASURED_ROUTES below; tests that exercise overrides set their own
+    monkeypatch.setattr(sdpa_routing, "MODEL_VALIDATED_OVERRIDES", {})
 
 
 def _route(monkeypatch, platform="tpu", lq=4096, lk=4096, c=640, heads=10):
@@ -233,6 +236,92 @@ def test_updater_drops_subroofline_timings(tmp_path):
     attn2 = [{"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
               "ms": {"xla": 0.01, "upstream": 0.02}}]
     assert upd.build_routes(attn2, []) == {}
+
+
+def test_updater_tiles_require_matching_head_count(tmp_path):
+    """Campaign r5 regression: an h=10 tuned sweep must not fold into an
+    h=24 attn record at the same (L, head_dim) — mixed-head comparison
+    flipped the route to a kernel that loses at both head counts.  A
+    heads-less record (pre-r5 logs) still matches any sweep (wildcard)."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        # h=10 record first, h=24 record last (owns the route slot)
+        {"phase": "attn", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 7.1, "inrepo": 13.8, "upstream": 12.2}},
+        {"phase": "attn", "L": 4096, "heads": 24, "head_dim": 64,
+         "ms": {"xla": 12.2, "inrepo": 29.4, "upstream": 26.3}},
+        {"phase": "tune", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"512x1024": 8.2}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    # the h=10 sweep (8.2ms) must NOT beat the h=24 record's xla (12.2ms)
+    assert routes[(64, 12)][:3] == ("xla", None, None)
+
+    # wildcard: heads-less attn record accepts the sweep
+    lines2 = [
+        {"phase": "attn", "L": 4096, "head_dim": 64,
+         "ms": {"xla": 12.2, "inrepo": 13.8}},
+        {"phase": "tune", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"512x1024": 8.2}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines2) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][:3] == ("inrepo", 512, 1024)
+
+
+def test_model_validated_override_wins_and_scopes():
+    """MODEL_VALIDATED_OVERRIDES outranks MEASURED_ROUTES at its bucket but
+    obeys the same bucket-distance discipline elsewhere."""
+    old_m = sdpa_routing.MEASURED_ROUTES
+    old_o = sdpa_routing.MODEL_VALIDATED_OVERRIDES
+    sdpa_routing.MEASURED_ROUTES = {(64, 12): Route("xla")}
+    sdpa_routing.MODEL_VALIDATED_OVERRIDES = {
+        (64, 12): Route("upstream", 256, 1024)}
+    try:
+        assert sdpa_routing.lookup(4096, 64) == Route("upstream", 256, 1024)
+        # far buckets fall through the override to the measured table rules
+        assert sdpa_routing.lookup(2**20, 64) is None
+        # other head_dims see neither
+        assert sdpa_routing.lookup(4096, 160) is None
+        # a STRICTLY CLOSER measured entry beats the override: the override
+        # is model-validated at ITS bucket only, not at lengths a nearer
+        # measurement covers (L=1536 is 0.58 buckets from the (64,10) XLA
+        # entry, 1.42 from the (64,12) override)
+        sdpa_routing.MEASURED_ROUTES = {(64, 10): Route("xla"),
+                                        (64, 12): Route("xla")}
+        assert sdpa_routing.lookup(1536, 64) == Route("xla")
+        assert sdpa_routing.lookup(4096, 64) == Route("upstream", 256, 1024)
+    finally:
+        sdpa_routing.MEASURED_ROUTES = old_m
+        sdpa_routing.MODEL_VALIDATED_OVERRIDES = old_o
+
+
+def test_updater_skips_tiles_slower_than_default(tmp_path):
+    """A tuned sweep whose best time LOSES to the winner's default-tile
+    time must not pin its tiles onto the route (the comment would claim a
+    time those tiles never achieved)."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"xla": 9.0, "upstream": 7.0}},
+        {"phase": "tune_upstream", "L": 4096, "heads": 10, "head_dim": 64,
+         "ms": {"512x1024": 8.5}},  # tuned WORSE than default-tile 7.0
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    routes = upd.build_routes(attn, tune)
+    assert routes[(64, 12)][:3] == ("upstream", None, None)
 
 
 def test_sdpa_still_computes_on_cpu(monkeypatch):
