@@ -129,8 +129,8 @@ def main():
     def left():
         return args.deadline_s - (time.time() - START)
 
-    def timed(step, x0, iters, reps=3):
-        """Seconds per application of ``step`` (an x -> same-shape-x map).
+    def timed(step, x0, *extras, iters=20, reps=3):
+        """Seconds per application of ``step(x, *extras) -> same-shape-x``.
 
         Chains ``iters`` applications inside ONE jit via fori_loop and
         reduces the final value to a SCALAR, then np.asarray's it: the
@@ -143,14 +143,21 @@ def main():
         explicit-tile Pallas programs.  (A whole-tensor transfer with a
         baseline subtraction was tried first, but jax.Array caches its
         host copy, so a "ready buffer" baseline reads ~0 and the 10-40 MB
-        tunnel transfer silently lands in the kernel time.)"""
-        chain = jax.jit(lambda x: jnp.sum(jax.lax.fori_loop(
-            0, iters, lambda i, y: step(y), x)).astype(jnp.float32))
-        np.asarray(chain(x0))  # compile + settle
+        tunnel transfer silently lands in the kernel time.)
+
+        ``extras`` (the K/V tensors) MUST be jit arguments, not closures:
+        closed-over arrays are baked into the HLO as literal constants, and
+        at L=57600 the ~300 MB serialized program exceeds the remote-compile
+        service's request limit (HTTP 413) — that, not a kernel limitation,
+        is why every attn impl "failed" at 57600 in the first two campaigns.
+        """
+        chain = jax.jit(lambda x, *es: jnp.sum(jax.lax.fori_loop(
+            0, iters, lambda i, y: step(y, *es), x)).astype(jnp.float32))
+        np.asarray(chain(x0, *extras))  # compile + settle
         vals = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            np.asarray(chain(x0))
+            np.asarray(chain(x0, *extras))
             vals.append(time.perf_counter() - t0)
         return statistics.median(vals) / iters
 
@@ -180,22 +187,25 @@ def main():
             k = jax.random.normal(ks[1], (2, L, C), jnp.bfloat16)
             v = jax.random.normal(ks[2], (2, L, C), jnp.bfloat16)
 
-            # each impl as an x -> x map (out has q's shape) so timed() can
-            # chain iterations by data dependency
-            def xla_path(x):
+            # each impl as an (x, k, v) -> x-shaped map so timed() can chain
+            # iterations by data dependency; k/v ride as jit args (never
+            # closures — see timed() on the HTTP 413 constant-bloat trap)
+            def xla_path(x, kk, vv):
                 return _sdpa_xla(
-                    x.reshape(2, L, H, d), k.reshape(2, L, H, d),
-                    v.reshape(2, L, H, d), 1.0 / d**0.5,
+                    x.reshape(2, L, H, d), kk.reshape(2, L, H, d),
+                    vv.reshape(2, L, H, d), 1.0 / d**0.5,
                 ).reshape(2, L, C)
 
             res = {}
             for name, fn in [
                 ("xla", xla_path),
-                ("inrepo", lambda x: flash_sdpa(x, k, v, heads=H)),
-                ("upstream", lambda x: upstream_flash_sdpa(x, k, v, heads=H)),
+                ("inrepo",
+                 lambda x, kk, vv: flash_sdpa(x, kk, vv, heads=H)),
+                ("upstream",
+                 lambda x, kk, vv: upstream_flash_sdpa(x, kk, vv, heads=H)),
             ]:
                 try:
-                    res[name] = round(timed(fn, q, 20) * 1e3, 3)
+                    res[name] = round(timed(fn, q, k, v) * 1e3, 3)
                 except Exception as e:
                     res[name] = f"failed:{type(e).__name__}"
             emit("attn", L=L, heads=H, head_dim=d, ms=res)
@@ -212,9 +222,12 @@ def main():
               for bk in (128, 256, 512, 1024)]),
             ("tune_upstream", upstream_flash_sdpa,
              [(bq, bk) for bq in (256, 512, 1024)
-              for bk in (512, 1024, 2048)]),
+              for bk in (256, 512, 1024, 2048)]),
         ]
-        for (L, C, H) in [(4096, 640, 10), (16384, 640, 10)]:
+        # 57600 = 2^8 * 225: only tiles <= 256 divide it, so the grids'
+        # small corner is what makes the 3840px level-1 shape sweepable
+        for (L, C, H) in [(4096, 640, 10), (16384, 640, 10),
+                          (57600, 640, 10)]:
             if left() < 300:
                 emit("tune", L=L, skipped="deadline")
                 continue
@@ -229,9 +242,9 @@ def main():
                         continue
                     try:
                         res[f"{bq}x{bk}"] = round(timed(
-                            lambda x, bq=bq, bk=bk, kern=kernel: kern(
-                                x, k, v, heads=H, block_q=bq, block_k=bk),
-                            q, 10,
+                            lambda x, kk, vv, bq=bq, bk=bk, kern=kernel: kern(
+                                x, kk, vv, heads=H, block_q=bq, block_k=bk),
+                            q, k, v, iters=10,
                         ) * 1e3, 3)
                     except Exception as e:
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
@@ -308,6 +321,10 @@ def main():
         ("b2048", 2048, False, None, "gather", None),
         ("b2048_ring", 2048, False, None, "ring", None),
         ("b1024_fp32", 1024, False, None, "gather", jnp.float32),
+        # opt-in (not in the default phase list): the reference's showcase
+        # resolution, single-chip — viable since the (64,16) flash route
+        # (256x256 tiles, the only power-of-2 divisor class of 57600)
+        ("b3840", 3840, False, None, "gather", None),
     ]:
         if label not in phases:
             continue
