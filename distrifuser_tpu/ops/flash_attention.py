@@ -32,7 +32,8 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+                  kv_len=None):
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -49,6 +50,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale):
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [Bq, Bk] fp32
+    if kv_len is not None:
+        # alignment-padding support: KV columns at or beyond the real
+        # length are masked out of the softmax, so padding K/V up to a
+        # block multiple is numerically exact (pad q rows are the caller's
+        # to slice off).  One iota+compare+select per tile — negligible
+        # against the dot.
+        bk = s.shape[1]
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
 
     m_prev = m_scr[:, :1]  # [Bq, 1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -103,13 +113,17 @@ def upstream_flash_sdpa(q, k, v, *, heads: int, block_q: int = None,
     return o.transpose(0, 2, 1, 3).reshape(b, lq, c)
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "block_q", "block_k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("heads", "block_q", "block_k",
+                                             "interpret", "kv_len"))
 def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
-               block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
+               block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
+               kv_len: int = None):
     """Drop-in for ops.attention.sdpa: [B, L, C] inputs, H heads.
 
     Requires Lq % block_q == 0 and Lk % block_k == 0 (attention.py checks
-    before routing here).
+    before routing here).  ``kv_len`` (static): treat only the first
+    ``kv_len`` KV positions as real — the alignment-padding mask for
+    unaligned sequences (SD3's 4250-token joint stream padded to 4352).
     """
     b, lq, c = q.shape
     lk = k.shape[1]
@@ -125,7 +139,7 @@ def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
 
     grid = (b * heads, lq // block_q, lk // block_k)
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale),
+        functools.partial(_flash_kernel, scale=scale, kv_len=kv_len),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
@@ -152,3 +166,37 @@ def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
     )(qh, kh, vh)
 
     return out.reshape(b, heads, lq, d).transpose(0, 2, 1, 3).reshape(b, lq, c)
+
+
+def padded_flash_sdpa(q, k, v, *, heads: int, align: int = 128,
+                      interpret: bool = False):
+    """Flash attention for UNALIGNED sequence lengths via pad-and-mask.
+
+    Long sequences whose length is not a lane multiple (SD3's 4096+154
+    joint stream) otherwise fall back to XLA's chunked softmax, which the
+    r5 trace showed running at ~11% MFU — the padded kernel keeps the MXU
+    on aligned tiles while a static kv_len mask keeps the numerics exact:
+    pad KV columns get -inf logits (zero softmax weight), pad query rows
+    compute garbage and are sliced off.
+    """
+    # lazy import avoids a cycle: attention.py only imports this module
+    # inside function bodies
+    from .attention import _largest_dividing_tile
+
+    b, lq, c = q.shape
+    lk = k.shape[1]
+    lq_pad = -(-lq // align) * align
+    lk_pad = -(-lk // align) * align
+    qp = jnp.pad(q, ((0, 0), (0, lq_pad - lq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, lk_pad - lk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, lk_pad - lk), (0, 0)))
+
+    # padded lengths are 128-multiples, so the shared helper never returns
+    # None here (the 128 lane minimum always divides)
+    out = flash_sdpa(
+        qp, kp, vp, heads=heads,
+        block_q=_largest_dividing_tile(256, lq_pad),
+        block_k=_largest_dividing_tile(256, lk_pad),
+        interpret=interpret, kv_len=None if lk_pad == lk else lk,
+    )
+    return out[:, :lq]

@@ -105,6 +105,18 @@ def intersection(a, b):
     return total
 
 
+def _device_lanes(events):
+    """Complete events grouped into (pid, tid) lanes, host/python lanes
+    dropped (a lane must contain at least one XLA-looking op)."""
+    lanes = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        lanes.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    return {key: evs for key, evs in lanes.items()
+            if any(_XLA_OP.search(e.get("name", "")) for e in evs)}
+
+
 def analyze(events):
     """Per-device (per-pid) overlap: a TPU trace carries one pid per
     device with separate compute/async lanes; a collective is hidden where
@@ -112,18 +124,10 @@ def analyze(events):
     has a single pid, so the analysis degrades to global — fine for the
     scheduling-level question (did XLA execute the async-start/done pairs
     concurrently with compute at all)."""
-    lanes = {}
-    for ev in events:
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        lanes.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
-
     per_pid = {}  # pid -> {"coll": [...], "comp": [...]}
     coll_names = {}
     n_coll = 0
-    for (pid, _tid), evs in lanes.items():
-        if not any(_XLA_OP.search(e.get("name", "")) for e in evs):
-            continue  # host/python lane
+    for (pid, _tid), evs in _device_lanes(events).items():
         slot = per_pid.setdefault(pid, {"coll": [], "comp": []})
         for e in evs:
             iv = (e["ts"], e["ts"] + e["dur"])
@@ -154,17 +158,56 @@ def analyze(events):
     }
 
 
+def top_ops(events, n):
+    """Total device-lane time by op name — where does the step actually go?
+
+    XLA fusion names keep their `fusion.N` identity, so a single hot fused
+    region is visible as itself rather than smeared into one 'fusion'
+    bucket."""
+    totals = {}
+    counts = {}
+    grand = 0.0
+    for evs in _device_lanes(events).values():
+        for e in evs:
+            name = e.get("name", "")
+            totals[name] = totals.get(name, 0.0) + e["dur"]
+            counts[name] = counts.get(name, 0) + 1
+            grand += e["dur"]
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:n]
+    return [{"name": k, "total_us": round(v, 1), "calls": counts[k],
+             "share": round(v / grand, 4) if grand else 0.0}
+            for k, v in ranked], grand
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("trace", help="trace dir or perfetto json(.gz)")
     ap.add_argument("--json", action="store_true", help="JSON line only")
+    ap.add_argument("--top", type=int, default=0,
+                    help="also print the N ops with the largest total "
+                         "device time")
     args = ap.parse_args()
 
     path = find_perfetto(args.trace)
     if path is None:
         print(f"no perfetto json(.gz) under {args.trace}", file=sys.stderr)
         return 2
-    rep = analyze(load_events(path))
+    events = load_events(path)
+    rep = analyze(events)
+    if args.top:
+        ranked, grand = top_ops(events, args.top)
+        if args.json:
+            # ONE object on one line (the documented --json contract):
+            # top_ops rides inside the overlap report
+            rep = {**rep, "top_ops": ranked,
+                   "device_total_us": round(grand, 1)}
+        else:
+            print(f"top {len(ranked)} ops by total device time "
+                  f"(of {grand / 1e3:.1f} ms):")
+            for r in ranked:
+                print(f"  {r['share'] * 100:5.1f}%  {r['total_us'] / 1e3:8.2f} ms"
+                      f"  x{r['calls']:<5} {r['name'][:80]}")
+            print()
     if args.json:
         print(json.dumps(rep))
         return 0

@@ -85,17 +85,25 @@ def test_chunked_sdpa_matches_direct(monkeypatch):
 
     attn_mod = importlib.import_module("distrifuser_tpu.ops.attention")
 
-    b, l, heads, d = 1, 512, 2, 16
+    # l=500 does NOT divide the chunk counts below, so both branches must
+    # actually pad queries to uniform chunks and slice the pad rows off
+    b, l, heads, d = 1, 500, 2, 16
     c = heads * d
     keys = jax.random.split(jax.random.PRNGKey(7), 3)
     q = jax.random.normal(keys[0], (b, l, c))
     k = jax.random.normal(keys[1], (b, l, c))
     v = jax.random.normal(keys[2], (b, l, c))
     direct = sdpa(q, k, v, heads=heads)
-    # force chunking by shrinking the threshold
+    # force chunking by shrinking the threshold: 1<<16 -> 8 chunks, the
+    # UNROLLED branch (n_chunks <= 16); 500 % 8 != 0 -> pad to 504
     monkeypatch.setattr(attn_mod, "_CHUNK_LOGITS_ELEMS", 1 << 16)
     chunked = sdpa(q, k, v, heads=heads)
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(direct), atol=1e-5)
+    # 1<<13 -> 64 chunks, the ROLLED lax.map branch (compile-size bound);
+    # 500 % 64 != 0 -> pad to 512
+    monkeypatch.setattr(attn_mod, "_CHUNK_LOGITS_ELEMS", 1 << 13)
+    rolled = sdpa(q, k, v, heads=heads)
+    np.testing.assert_allclose(np.asarray(rolled), np.asarray(direct), atol=1e-5)
 
 
 def test_flash_bf16_inputs():
@@ -113,3 +121,41 @@ def test_flash_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want), atol=0.03
     )
+
+
+def test_padded_flash_matches_reference():
+    """Pad-and-mask flash for unaligned lengths (SD3's joint stream): the
+    kv_len mask must make alignment padding numerically invisible."""
+    from distrifuser_tpu.ops.flash_attention import padded_flash_sdpa
+
+    b, heads, d = 2, 2, 16
+    c = heads * d
+    # 330 = unaligned; pads to 384 with 54 masked KV columns
+    lq = lk = 330
+    keys = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(keys[0], (b, lq, c))
+    k = jax.random.normal(keys[1], (b, lk, c))
+    v = jax.random.normal(keys[2], (b, lk, c))
+
+    import importlib
+    attn_mod = importlib.import_module("distrifuser_tpu.ops.attention")
+    ref = attn_mod._sdpa_xla(
+        q.reshape(b, lq, heads, d), k.reshape(b, lk, heads, d),
+        v.reshape(b, lk, heads, d), 1.0 / d**0.5,
+    ).reshape(b, lq, c)
+
+    out = padded_flash_sdpa(q, k, v, heads=heads, interpret=True)
+    assert out.shape == (b, lq, c)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    # aligned input degenerates to the plain kernel (no mask, no slice)
+    q128 = q[:, :256]
+    out128 = padded_flash_sdpa(q128, k[:, :256], v[:, :256], heads=heads,
+                               interpret=True)
+    ref128 = attn_mod._sdpa_xla(
+        q128.reshape(b, 256, heads, d), k[:, :256].reshape(b, 256, heads, d),
+        v[:, :256].reshape(b, 256, heads, d), 1.0 / d**0.5,
+    ).reshape(b, 256, c)
+    np.testing.assert_allclose(np.asarray(out128), np.asarray(ref128),
+                               atol=2e-5, rtol=2e-5)
