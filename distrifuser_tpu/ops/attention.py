@@ -233,8 +233,8 @@ def sdpa(q, k, v, *, heads: int):
     # unaligned-but-long sequences (SD3's 4096+154 joint stream): flash via
     # pad-and-mask instead of the chunked XLA softmax the alignment gate
     # would otherwise force — the r5 trace showed that path at ~11% MFU;
-    # padded flash cut SD3-medium 20.2 -> 13.5 s.  Operator pins
-    # (FLASH=0 / IMPL=xla) still win.  d is bounded to the swept range:
+    # padded flash cut SD3-medium 20.2 -> 8.3 s (segment-masked upstream
+    # kernel; BENCH_NOTES).  Operator pins (FLASH=0 / IMPL=xla) still win.  d is bounded to the swept range:
     # the except below only catches TRACE-time failures — a Mosaic
     # backend-compile failure on an exotic head dim would surface when the
     # enclosing jitted step compiles, past any fallback — so unswept d
