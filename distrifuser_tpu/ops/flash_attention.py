@@ -28,6 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.8 renamed TPUCompilerParams -> CompilerParams; accept both so the
+# kernel builds on the 0.4.x line too (see utils/compat.py)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
@@ -162,7 +167,7 @@ def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
         # the online-softmax state.  Without this, Mosaic treats every grid
         # dim as sequential ("arbitrary"), which blocks its cross-iteration
         # pipelining — the prime suspect in the round-2 2x slowdown vs XLA.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -42,7 +42,8 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..models import dit as dit_mod
@@ -688,6 +689,14 @@ class DiTDenoiseRunner:
                 latents, enc, cap_mask, gs, num_inference_steps, callback,
             )
         if callback is not None:
+            from ..utils.compat import SUPPORTS_FUSED_CALLBACK
+
+            if not SUPPORTS_FUSED_CALLBACK:
+                # this jaxlib aborts compiling the ordered-io_callback
+                # program (utils/compat.py) — host-driven loop instead
+                return self._generate_stepwise(
+                    latents, enc, cap_mask, gs, num_inference_steps, callback,
+                )
             key = ("fused_cb", num_inference_steps)
             if key not in self._compiled:
                 self._compiled[key] = self._build_fused_callback(

@@ -52,7 +52,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..models import dit as dit_mod

@@ -33,7 +33,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..models.unet import (
@@ -115,7 +116,8 @@ class DenoiseRunner:
                 "'ring' here"
             )
         _check_geometry(distri_config, unet_config)
-        self._compiled: Dict[int, Any] = {}
+        self._compiled: Dict[Any, Any] = {}
+        self._builds = 0  # fused-loop builds (cache_info observability)
         # fused-mode per-step callback target (_build_fused_callback): the
         # compiled program's io_callback reads this indirection so one
         # program serves any callback object
@@ -395,6 +397,32 @@ class DenoiseRunner:
             self._compiled[skey] = self._build_stale_scan(num_steps, n_sync)
         return self._compiled[skey]
 
+    def compiled_handle(self, num_steps: int, start_step: int = 0,
+                        end_step: Optional[int] = None):
+        """The jitted fused-loop callable for this signature, built (and
+        cached) on first use — the handle generate() dispatches to.
+
+        Public so callers that manage their own executable lifecycle (the
+        serve layer's compiled-executable cache, warmup prefetchers) can pin
+        or pre-build programs without a throwaway generate() call, and so a
+        cached handle is observably the SAME object across calls instead of
+        an implementation detail."""
+        key = (num_steps if start_step == 0 and end_step is None
+               else (num_steps, start_step, end_step))
+        if key not in self._compiled:
+            self._builds += 1
+            self._compiled[key] = self._build(num_steps, start_step, end_step)
+        return self._compiled[key]
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Compiled-program cache observability: which signatures are
+        resident and how many builds have happened (a retrace on the request
+        path shows up as builds growing after warmup)."""
+        return {
+            "entries": sorted(str(k) for k in self._compiled),
+            "builds": self._builds,
+        }
+
     def prepare(self, num_steps: int) -> None:
         """Pre-build exactly the program(s) generate() will dispatch to
         (pipelines.prepare delegates here).  Per-step programs build
@@ -406,8 +434,9 @@ class DenoiseRunner:
             if n_sync < num_steps:
                 self._ensure_stale_scan(num_steps, n_sync)
             return
-        if num_steps not in self._compiled:
-            self._compiled[num_steps] = self._build(num_steps)
+        # scheduler tables must match the trace (see generate()'s re-pin)
+        self.scheduler.set_timesteps(num_steps)
+        self.compiled_handle(num_steps)
 
     def _generate_hybrid(self, latents, enc, added, gs, num_steps):
         """Sync warmup via per-step programs + one fused stale-only scan."""
@@ -728,9 +757,7 @@ class DenoiseRunner:
         lat, enc, added, gs = self._abstract_inputs(batch_size, text_len)
         # seed the jit cache: a following generate() with the same step count
         # reuses this program instead of re-compiling (jit caches by shape)
-        fn = self._compiled.setdefault(
-            num_inference_steps, self._build(num_inference_steps)
-        )
+        fn = self.compiled_handle(num_inference_steps)
         return fn.lower(self.params, lat, enc, added, gs).compile().as_text()
 
     def generate(
@@ -782,6 +809,16 @@ class DenoiseRunner:
         assert end_step is None or start_step < end_step <= num_inference_steps, (
             start_step, end_step, num_inference_steps)
         if callback is not None and self.cfg.use_compiled_step:
+            from ..utils.compat import SUPPORTS_FUSED_CALLBACK
+
+            if not SUPPORTS_FUSED_CALLBACK:
+                # this jaxlib aborts compiling the ordered-io_callback
+                # program (utils/compat.py) — host-driven loop instead
+                return self._generate_stepwise(
+                    jnp.asarray(latents), prompt_embeds, added,
+                    jnp.asarray(guidance_scale, jnp.float32),
+                    num_inference_steps, start_step, end_step, callback,
+                )
             # fused/hybrid modes: the callback rides io_callback inside a
             # dedicated compiled loop (_build_fused_callback) — same step
             # numerics, one dispatch, per-step host sync only in THIS
@@ -832,12 +869,7 @@ class DenoiseRunner:
         # trace reads the mutable scheduler — which a generate() with a
         # different step count may have re-tabled in between.
         self.scheduler.set_timesteps(num_inference_steps)
-        key = (num_inference_steps if start_step == 0 and end_step is None
-               else (num_inference_steps, start_step, end_step))
-        if key not in self._compiled:
-            self._compiled[key] = self._build(num_inference_steps, start_step,
-                                              end_step)
-        fn = self._compiled[key]
+        fn = self.compiled_handle(num_inference_steps, start_step, end_step)
         return fn(
             self.params,
             jnp.asarray(latents),

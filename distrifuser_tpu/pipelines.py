@@ -267,7 +267,7 @@ def _build_decoder(cfg: DistriConfig, vae_config: vae_mod.VAEConfig):
         # Sequence-parallel decode over the same sp axis as the denoiser
         # (beyond the reference, which decodes replicated on every rank):
         # exact, n x faster, 1/n activation footprint.
-        from jax import shard_map
+        from .utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from .parallel.collectives import gather_rows
@@ -293,6 +293,49 @@ def _build_decoder(cfg: DistriConfig, vae_config: vae_mod.VAEConfig):
     return jax.jit(
         lambda p, l: vae_mod.decode(p, vae_config, l, tile=tile)
     ), False
+
+
+def _normalize_prompts(prompt, negative_prompt):
+    """(prompts, negs) lists from the str-or-list call surface — one code
+    path for every pipeline family's __call__ and the serve batcher."""
+    prompts = [prompt] if isinstance(prompt, str) else list(prompt)
+    negs = (
+        [negative_prompt] * len(prompts)
+        if isinstance(negative_prompt, str)
+        else list(negative_prompt)
+    )
+    assert len(negs) == len(prompts), (
+        f"{len(prompts)} prompts but {len(negs)} negative prompts"
+    )
+    return prompts, negs
+
+
+def _wrap_chunk_callback(callback, n_real):
+    """diffusers legacy signature callback(step, timestep, latents) with the
+    padded tail rows stripped before the user sees them.  With more images
+    than batch_size the callback fires per chunk (step indices restart per
+    chunk)."""
+    if callback is None:
+        return None
+    return lambda i, t, x: callback(i, t, x[:n_real])
+
+
+def _pad_rows(arr, pad):
+    """Pad a batch-major array to the compiled batch width by repeating its
+    last row ``pad`` times (callers drop the padded outputs)."""
+    if not pad:
+        return arr
+    return jnp.concatenate([arr, jnp.repeat(arr[-1:], pad, axis=0)])
+
+
+def _pad_chunks(total: int, bs: int):
+    """(start, stop, pad) triples covering [0, total) in fixed ``bs``-sized
+    chunks — the ONE chunking convention shared by the denoise and decode
+    paths (and, through generate_batch, the serve batcher): tail chunk
+    padded, padded rows dropped by the caller."""
+    for i in range(0, total, bs):
+        n = min(bs, total - i)
+        yield i, i + n, bs - n
 
 
 def _batched_generate(cfg, scheduler, prompts, negs, num_images_per_prompt,
@@ -322,14 +365,13 @@ def _batched_generate(cfg, scheduler, prompts, negs, num_images_per_prompt,
         latents = jnp.asarray(latents, jnp.float32)
         assert latents.shape == lat_shape, (latents.shape, lat_shape)
     outs = []
-    for i in range(0, total, bs):
-        cp, cn = prompts[i:i + bs], negs[i:i + bs]
-        cl = latents[i:i + bs]
-        pad = bs - len(cp)
+    for i, stop, pad in _pad_chunks(total, bs):
+        cp, cn = prompts[i:stop], negs[i:stop]
+        cl = latents[i:stop]
         if pad:
             cp = cp + [cp[-1]] * pad
             cn = cn + [cn[-1]] * pad
-            cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
+            cl = _pad_rows(cl, pad)
         out = run_chunk(cp, cn, cl, bs - pad)
         outs.append(out[:bs - pad] if pad else out)
     return jnp.concatenate(outs, axis=0)
@@ -341,19 +383,79 @@ def _decode_chunked(decode, vae_params, latent, bs, scaling, shift=0.0):
     parallel decode's shard_map needs its dp-divisible batch — an arbitrary
     total from _batched_generate must not reach it directly.  ``shift`` is
     the SD3-family latent re-centering (VAEConfig.shift_factor)."""
-    total = latent.shape[0]
     outs = []
-    for i in range(0, total, bs):
-        cl = latent[i:i + bs]
-        pad = bs - cl.shape[0]
-        if pad:
-            cl = jnp.concatenate([cl, jnp.repeat(cl[-1:], pad, axis=0)])
+    for i, stop, pad in _pad_chunks(latent.shape[0], bs):
+        cl = _pad_rows(latent[i:stop], pad)
         img = decode(vae_params, cl / scaling + shift)
         outs.append(img[:bs - pad] if pad else img)
     return jnp.concatenate(outs, axis=0)
 
 
-class _DistriPipelineBase:
+class _GenerationMixin:
+    """Machinery shared by EVERY pipeline family (UNet, DiT, MMDiT): the
+    output packaging tail of __call__ and the serve layer's pre-bucketed
+    batched entry.  Requires ``distri_config``, ``vae_config``,
+    ``vae_params``, and ``_decode`` on the instance."""
+
+    def _finalize(self, latent, output_type, tokenizers,
+                  shift: float = 0.0) -> "PipelineOutput":
+        """latent -> PipelineOutput for 'latent' | 'np' | 'pil'.  ``shift``
+        is the SD3-family VAE re-centering (zero for legacy families)."""
+        if output_type == "latent":
+            # one entry per image, matching the 'np'/'pil' contract
+            return _mk_output(list(np.asarray(latent)), tokenizers)
+        image = _decode_chunked(
+            self._decode, self.vae_params, latent,
+            self.distri_config.batch_size, self.vae_config.scaling_factor,
+            shift,
+        )
+        image = np.asarray(image, np.float32)
+        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
+        if output_type == "np":
+            return _mk_output(list(image), tokenizers)
+        from PIL import Image
+
+        return _mk_output(
+            [Image.fromarray((im * 255).round().astype(np.uint8))
+             for im in image],
+            tokenizers,
+        )
+
+    def generate_batch(self, prompts, negative_prompts=None,
+                       **kwargs) -> "PipelineOutput":
+        """Pre-bucketed batched entry (the serve micro-batcher's call path,
+        distrifuser_tpu/serve): EXACTLY ``distri_config.batch_size`` prompts
+        — the batch the compiled program was built for — so the call is one
+        chunk with zero padding and can never retrace on batch shape.
+        Delegates to __call__, so the one-shot and serving paths share one
+        code path; ``kwargs`` are the __call__ surface (num_inference_steps,
+        guidance_scale, seed, latents, output_type, ...)."""
+        prompts = list(prompts)
+        bs = self.distri_config.batch_size
+        if len(prompts) != bs:
+            raise ValueError(
+                f"generate_batch is the pre-bucketed entry: expected exactly "
+                f"batch_size={bs} prompts, got {len(prompts)} (pad upstream "
+                "— serve.executors.PipelineExecutor does — or call the "
+                "pipeline directly for arbitrary counts)"
+            )
+        if negative_prompts is None or isinstance(negative_prompts, str):
+            negs = negative_prompts or ""  # __call__ broadcasts a str
+        else:
+            negs = list(negative_prompts)
+            if len(negs) != bs:
+                raise ValueError(
+                    f"{len(negs)} negative prompts for {bs} prompts"
+                )
+        if kwargs.get("num_images_per_prompt", 1) != 1:
+            raise ValueError(
+                "generate_batch batches across requests; "
+                "num_images_per_prompt must stay 1"
+            )
+        return self(prompt=prompts, negative_prompt=negs, **kwargs)
+
+
+class _DistriPipelineBase(_GenerationMixin):
     """Shared machinery; subclasses define the text-encoding recipe."""
 
     def __init__(
@@ -442,15 +544,7 @@ class _DistriPipelineBase:
             )
         if not cfg.do_classifier_free_guidance:
             guidance_scale = 1.0
-        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
-        negs = (
-            [negative_prompt] * len(prompts)
-            if isinstance(negative_prompt, str)
-            else list(negative_prompt)
-        )
-        assert len(negs) == len(prompts), (
-            f"{len(prompts)} prompts but {len(negs)} negative prompts"
-        )
+        prompts, negs = _normalize_prompts(prompt, negative_prompt)
         self.scheduler.set_timesteps(num_inference_steps)
 
         # base+refiner split (diffusers denoising_end / denoising_start
@@ -508,12 +602,7 @@ class _DistriPipelineBase:
 
         def run_chunk(cp, cn, cl, n_real):
             embeds, added = self._encode(cp, cn, micro_cond)
-            # diffusers legacy signature callback(step, timestep, latents);
-            # padded tail rows are stripped before the user sees them.
-            # With more images than batch_size the callback fires per chunk
-            # (step indices restart per chunk).
-            cb = (None if callback is None
-                  else (lambda i, t, x: callback(i, t, x[:n_real])))
+            cb = _wrap_chunk_callback(callback, n_real)
             return self.runner.generate(
                 cl, embeds,
                 guidance_scale=guidance_scale,
@@ -531,23 +620,7 @@ class _DistriPipelineBase:
             cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
             latents, self.unet_config.in_channels, run_chunk,
         )
-        if output_type == "latent":
-            # one entry per image, matching the 'np'/'pil' contract
-            return _mk_output(list(np.asarray(latent)), self.tokenizers)
-        image = _decode_chunked(
-            self._decode, self.vae_params, latent,
-            self.distri_config.batch_size, self.vae_config.scaling_factor,
-        )
-        image = np.asarray(image, np.float32)
-        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
-        if output_type == "np":
-            return _mk_output(list(image), self.tokenizers)
-        from PIL import Image
-
-        return _mk_output(
-            [Image.fromarray((im * 255).round().astype(np.uint8)) for im in image],
-            self.tokenizers,
-        )
+        return self._finalize(latent, output_type, self.tokenizers)
 
     # -- helpers ----------------------------------------------------------
     def _clip(self, which: int, ids):
@@ -789,7 +862,7 @@ class DistriSDPipeline(_DistriPipelineBase):
         return emb.reshape(n_br, b, *emb.shape[1:]), None
 
 
-class DistriPixArtPipeline:
+class DistriPixArtPipeline(_GenerationMixin):
     """PixArt-alpha (DiT family): T5 text encoder + PixArt transformer + KL
     VAE, driven by the displaced-patch DiT runner or, with
     ``parallelism="pipefusion"``, the patch-pipeline runner.
@@ -999,23 +1072,12 @@ class DistriPixArtPipeline:
             )
         if not cfg.do_classifier_free_guidance:
             guidance_scale = 1.0
-        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
-        negs = (
-            [negative_prompt] * len(prompts)
-            if isinstance(negative_prompt, str)
-            else list(negative_prompt)
-        )
-        assert len(negs) == len(prompts), (
-            f"{len(prompts)} prompts but {len(negs)} negative prompts"
-        )
+        prompts, negs = _normalize_prompts(prompt, negative_prompt)
         self.scheduler.set_timesteps(num_inference_steps)
 
         def run_chunk(cp, cn, cl, n_real):
             emb, mask = self._encode(cp, cn)
-            # diffusers legacy callback(step, timestep, latents); padded
-            # tail rows stripped before the user sees them
-            cb = (None if callback is None
-                  else (lambda i, t, x: callback(i, t, x[:n_real])))
+            cb = _wrap_chunk_callback(callback, n_real)
             return self.runner.generate(
                 cl, emb, guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps, cap_mask=mask,
@@ -1026,23 +1088,7 @@ class DistriPixArtPipeline:
             cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
             latents, self.dit_config.in_channels, run_chunk,
         )
-        if output_type == "latent":
-            return _mk_output(list(np.asarray(latent)), [self.tokenizer])
-        image = _decode_chunked(
-            self._decode, self.vae_params, latent,
-            self.distri_config.batch_size, self.vae_config.scaling_factor,
-        )
-        image = np.asarray(image, np.float32)
-        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
-        if output_type == "np":
-            return _mk_output(list(image), [self.tokenizer])
-        from PIL import Image
-
-        return _mk_output(
-            [Image.fromarray((im * 255).round().astype(np.uint8))
-             for im in image],
-            [self.tokenizer],
-        )
+        return self._finalize(latent, output_type, [self.tokenizer])
 
 
 def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
@@ -1064,7 +1110,7 @@ def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
         return SimpleTokenizer(vocab_size=vocab_size, eos=1, bos=0)
 
 
-class DistriSD3Pipeline:
+class DistriSD3Pipeline(_GenerationMixin):
     """SD3-class MMDiT pipeline — a model family BEYOND the reference
     (whose diffusers 0.24 pin predates SD3 entirely); built so the same
     displaced-patch machinery covers the current diffusion architecture.
@@ -1324,15 +1370,7 @@ class DistriSD3Pipeline:
             )
         if not cfg.do_classifier_free_guidance:
             guidance_scale = 1.0
-        prompts = [prompt] if isinstance(prompt, str) else list(prompt)
-        negs = (
-            [negative_prompt] * len(prompts)
-            if isinstance(negative_prompt, str)
-            else list(negative_prompt)
-        )
-        assert len(negs) == len(prompts), (
-            f"{len(prompts)} prompts but {len(negs)} negative prompts"
-        )
+        prompts, negs = _normalize_prompts(prompt, negative_prompt)
         self.scheduler.set_timesteps(num_inference_steps)
 
         start_step = 0
@@ -1350,10 +1388,7 @@ class DistriSD3Pipeline:
 
         def run_chunk(cp, cn, cl, n_real):
             enc, pooled = self._encode(cp, cn)
-            # diffusers legacy callback(step, timestep, latents); padded
-            # tail rows stripped before the user sees them
-            cb = (None if callback is None
-                  else (lambda i, t, x: callback(i, t, x[:n_real])))
+            cb = _wrap_chunk_callback(callback, n_real)
             return self.runner.generate(
                 cl, enc, pooled, guidance_scale=guidance_scale,
                 num_inference_steps=num_inference_steps,
@@ -1366,21 +1401,5 @@ class DistriSD3Pipeline:
             latents, self.mmdit_config.in_channels, run_chunk,
         )
         toks = [t for t in self.tokenizers if t is not None]
-        if output_type == "latent":
-            return _mk_output(list(np.asarray(latent)), toks)
-        image = _decode_chunked(
-            self._decode, self.vae_params, latent,
-            self.distri_config.batch_size, self.vae_config.scaling_factor,
-            self.vae_config.shift_factor,
-        )
-        image = np.asarray(image, np.float32)
-        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
-        if output_type == "np":
-            return _mk_output(list(image), toks)
-        from PIL import Image
-
-        return _mk_output(
-            [Image.fromarray((im * 255).round().astype(np.uint8))
-             for im in image],
-            toks,
-        )
+        return self._finalize(latent, output_type, toks,
+                              shift=self.vae_config.shift_factor)

@@ -1,0 +1,65 @@
+"""distrifuser_tpu.serve — long-lived inference service over one mesh.
+
+Turns the one-shot pipelines into a request-serving system (ROADMAP north
+star: heavy traffic, mesh never idle):
+
+* `RequestQueue` — bounded admission with deadlines (serve/queue.py);
+* `MicroBatcher` + `BucketTable` — continuous micro-batching with shape
+  bucketing (serve/batcher.py);
+* `ExecutorCache` — LRU compiled-executable cache with startup warmup
+  (serve/cache.py);
+* `InferenceServer` — the scheduler thread tying them together, with
+  per-request lifecycle metrics (serve/server.py);
+* `PipelineExecutor` — adapter from the repo's pipelines
+  (serve/executors.py); `serve.testing` has the weightless fakes.
+
+``python -m distrifuser_tpu.serve --demo`` runs a CPU-only end-to-end
+demonstration (serve/__main__.py); ``scripts/serve_bench.py`` is the
+closed/open-loop load generator.  Architecture notes: docs/SERVING.md.
+"""
+
+from ..utils.config import DEFAULT_BUCKETS, ServeConfig
+from .batcher import BatchKey, BucketTable, MicroBatcher, NoBucketError
+from .cache import ExecKey, ExecutorCache
+from .queue import (
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    RequestQueue,
+    ServeError,
+    ServeResult,
+    ServerClosedError,
+)
+from .server import InferenceServer
+
+
+def __getattr__(name):
+    # Lazy: executors.py pulls in the pipeline stack; keep `import
+    # distrifuser_tpu.serve` light for fake-only callers (tests, demo).
+    if name in ("PipelineExecutor", "pipeline_executor_factory"):
+        from . import executors
+
+        return getattr(executors, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "BatchKey",
+    "BucketTable",
+    "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
+    "ExecKey",
+    "ExecutorCache",
+    "InferenceServer",
+    "MicroBatcher",
+    "NoBucketError",
+    "PipelineExecutor",
+    "QueueFullError",
+    "Request",
+    "RequestQueue",
+    "ServeConfig",
+    "ServeError",
+    "ServeResult",
+    "ServerClosedError",
+    "pipeline_executor_factory",
+]

@@ -1,0 +1,135 @@
+"""LRU compiled-executable cache.
+
+A diffusion service's worst latency cliff is the request-path retrace:
+a (resolution, steps) combination seen for the first time pays seconds to
+minutes of XLA compilation while the mesh idles.  This cache makes that a
+*startup* cost instead of a *request* cost:
+
+* entries are **executors** — callables wrapping a fully prepared pipeline
+  (pipeline construction + `prepare()` = ahead-of-time compilation of the
+  denoise loop) for one `ExecKey`;
+* the key is (model id, bucket HxW, steps, guidance mode, mesh plan) —
+  exactly the things that change the XLA program.  Prompt, seed, and
+  guidance *scale* are runtime inputs and share a program;
+* **LRU bounded**: compiled programs pin HBM (weights are shared, but each
+  program's buffers are not free), so capacity evicts the coldest bucket
+  rather than growing without bound;
+* `warmup` prefetches the hot buckets at startup, so steady-state traffic
+  only ever hits.
+
+Thread model: `get`/`warmup` are called by the single scheduler thread (or
+startup thread before serving); a lock still guards the map so stats reads
+from other threads are consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecKey:
+    """Identity of one compiled executor.  ``mesh_plan`` is
+    `DistriConfig.mesh_plan` — the same bucket on a different mesh layout is
+    a different XLA program."""
+
+    model_id: str
+    scheduler: str
+    height: int
+    width: int
+    steps: int
+    cfg: bool
+    mesh_plan: str
+
+    def short(self) -> str:
+        g = "cfg" if self.cfg else "nocfg"
+        return (f"{self.model_id}:{self.height}x{self.width}"
+                f"@{self.steps}st:{g}:{self.mesh_plan}")
+
+
+class ExecutorCache:
+    """LRU of prepared executors, keyed by `ExecKey`.
+
+    ``build_fn(key)`` constructs and warms an executor (expected to be
+    expensive — it compiles); ``on_evict(key, executor)`` lets the owner
+    release device buffers when an entry falls out.
+    """
+
+    def __init__(
+        self,
+        build_fn: Callable[[ExecKey], Any],
+        capacity: int,
+        on_evict: Optional[Callable[[ExecKey, Any], None]] = None,
+    ):
+        assert capacity >= 1, capacity
+        self.build_fn = build_fn
+        self.capacity = capacity
+        self.on_evict = on_evict
+        self._entries: "OrderedDict[ExecKey, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.build_seconds = 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: ExecKey) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get(self, key: ExecKey) -> Tuple[Any, bool]:
+        """(executor, hit?) — builds on miss, evicting LRU entries beyond
+        capacity.  The build runs outside the lock: stats reads never stall
+        behind a multi-second compile."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key], True
+            self.misses += 1
+        t0 = time.monotonic()
+        ex = self.build_fn(key)
+        dt = time.monotonic() - t0
+        evicted: List[Tuple[ExecKey, Any]] = []
+        with self._lock:
+            self.build_seconds += dt
+            self._entries[key] = ex
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                old_key, old_ex = self._entries.popitem(last=False)
+                self.evictions += 1
+                evicted.append((old_key, old_ex))
+        if self.on_evict:
+            for old_key, old_ex in evicted:
+                self.on_evict(old_key, old_ex)
+        return ex, False
+
+    def warmup(self, keys: Iterable[ExecKey]) -> int:
+        """Prefetch executors for the given keys (startup path).  Returns
+        how many were newly built.  Warmup misses are intentional — they
+        are the misses bought here so requests only ever hit."""
+        built = 0
+        for key in keys:
+            _, hit = self.get(key)
+            built += 0 if hit else 1
+        return built
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": [k.short() for k in self._entries],
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "evictions": self.evictions,
+                "build_seconds": round(self.build_seconds, 6),
+            }

@@ -1,0 +1,113 @@
+"""Adapters from the one-shot pipelines to the serve executor contract.
+
+A serve executor owns one *prepared* pipeline at one bucket resolution:
+DistriConfig fixes height/width at construction (the compiled program's
+shape), so the bucket table in serve/batcher.py maps requests onto a small
+set of pipeline instances, and the `ExecutorCache` bounds how many stay
+resident.
+
+Per-request seeds inside one coalesced batch are honored by drawing each
+request's initial latent from its own PRNG key here and handing the stacked
+batch to the pipeline's pre-bucketed entry (`generate_batch`) — the same
+noise each request would have received running alone, so coalescing never
+changes a request's image.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+from .cache import ExecKey
+
+
+class PipelineExecutor:
+    """Wrap a prepared distrifuser_tpu pipeline as a serve executor.
+
+    ``pipeline`` must match the key it serves: built at (key.height,
+    key.width) with do_classifier_free_guidance == key.cfg and the key's
+    scheduler family; ``prepare(key.steps)`` should already have run (the
+    factory in `pipeline_executor_factory` does all of this).
+    """
+
+    def __init__(self, pipeline, steps: int):
+        self.pipeline = pipeline
+        self.steps = steps
+        self.batch_size = pipeline.distri_config.batch_size
+
+    def _in_channels(self) -> int:
+        pipe = self.pipeline
+        for attr in ("unet_config", "dit_config", "mmdit_config"):
+            cfg = getattr(pipe, attr, None)
+            if cfg is not None:
+                return cfg.in_channels
+        raise AttributeError(f"{type(pipe).__name__} has no model config")
+
+    def _draw_latents(self, seeds: Sequence[int]):
+        """Per-request seeded initial noise (scaled like _batched_generate's
+        internal draw), stacked into one batch."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.pipeline.distri_config
+        self.pipeline.scheduler.set_timesteps(self.steps)
+        shape = (1, cfg.latent_height, cfg.latent_width, self._in_channels())
+        lats = [
+            jax.random.normal(jax.random.PRNGKey(int(s)), shape, jnp.float32)
+            for s in seeds
+        ]
+        return jnp.concatenate(lats, axis=0) * \
+            self.pipeline.scheduler.init_noise_sigma
+
+    def __call__(
+        self,
+        prompts: List[str],
+        negative_prompts: List[str],
+        guidance_scale: float,
+        seeds: List[int],
+    ) -> List[Any]:
+        n_real = len(prompts)
+        bs = self.batch_size
+        pad = (-n_real) % bs
+        if pad:
+            # pad to the compiled batch width by repeating the tail (same
+            # convention as pipelines._pad_rows); padded outputs dropped
+            prompts = prompts + [prompts[-1]] * pad
+            negative_prompts = negative_prompts + [negative_prompts[-1]] * pad
+            seeds = list(seeds) + [seeds[-1]] * pad
+        # A batch wider than the compiled width (batcher max_batch_size >
+        # pipeline batch_size) runs as several exactly-bs invocations of the
+        # same cached program — never a retrace, never a contract error.
+        latents = self._draw_latents(seeds)
+        images: List[Any] = []
+        for i in range(0, len(prompts), bs):
+            out = self.pipeline.generate_batch(
+                prompts[i:i + bs],
+                negative_prompts[i:i + bs],
+                num_inference_steps=self.steps,
+                guidance_scale=guidance_scale,
+                latents=latents[i:i + bs],
+                output_type="np",
+            )
+            images.extend(out.images)
+        return images[:n_real]
+
+
+def pipeline_executor_factory(
+    build_pipeline: Callable[[ExecKey], Any],
+) -> Callable[[ExecKey], PipelineExecutor]:
+    """Executor factory for `InferenceServer` from a pipeline builder.
+
+    ``build_pipeline(key)`` constructs the pipeline for a bucket — e.g. a
+    DistriConfig at (key.height, key.width) with
+    do_classifier_free_guidance=key.cfg, then ``from_pretrained`` /
+    ``from_params`` with key.scheduler.  The factory runs the ahead-of-time
+    compile (`prepare`) so cache misses pay the full cost HERE, off the
+    per-request path, and hands back a ready executor.
+    """
+
+    def factory(key: ExecKey) -> PipelineExecutor:
+        pipe = build_pipeline(key)
+        pipe.prepare(key.steps)
+        return PipelineExecutor(pipe, key.steps)
+
+    return factory

@@ -1,0 +1,184 @@
+"""Admission-controlled request queue for the inference service.
+
+The queue is the service's backpressure boundary (PipeFusion-class serving
+systems win throughput at this orchestration layer, not inside the model):
+
+* **bounded depth** — `put` beyond ``max_depth`` raises `QueueFullError`,
+  the 429-style signal an upstream load balancer retries against a less
+  loaded replica.  Nothing is silently dropped.
+* **deadlines** — every request carries an absolute expiry; the batcher
+  rejects (never executes) requests whose deadline passed while queued.
+  Late work is pure wasted mesh time, and executing it would also delay
+  every live request behind it.
+* **FIFO within a compatibility class** — `pop_where` scans in arrival
+  order, so two requests for the same bucket can never reorder.
+
+Thread model: producers call `put` from any thread; the single scheduler
+thread (serve/server.py) drains via `wait_nonempty` / `pop_expired` /
+`pop_where`.  All state is guarded by one lock + condition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class ServeError(RuntimeError):
+    """Base class for serve-layer rejections."""
+
+
+class QueueFullError(ServeError):
+    """Admission rejected: queue at max depth (HTTP-429 analog)."""
+
+
+class DeadlineExceededError(ServeError):
+    """Request expired while waiting for a batch slot; it was NOT executed."""
+
+
+class ServerClosedError(ServeError):
+    """Submitted to a server that has been stopped."""
+
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its lifecycle bookkeeping.
+
+    ``deadline`` is absolute `time.monotonic()` time.  ``height``/``width``
+    are the *requested* resolution; the batcher snaps them to ``bucket``
+    (the compiled-program resolution) at scheduling time — the output is
+    generated at bucket resolution, with the requested size recorded so a
+    fronting layer can crop/resize.
+    """
+
+    prompt: str
+    height: int
+    width: int
+    num_inference_steps: int
+    deadline: float
+    negative_prompt: str = ""
+    guidance_scale: float = 5.0
+    seed: int = 0
+    request_id: int = dataclasses.field(
+        default_factory=lambda: next(_REQUEST_IDS)
+    )
+    enqueue_ts: float = dataclasses.field(default_factory=time.monotonic)
+    future: Future = dataclasses.field(default_factory=Future)
+    bucket: Optional[tuple] = None  # (h, w), set by the batcher
+
+    def expired(self, now: float) -> bool:
+        return now >= self.deadline
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a request's future resolves to: outputs plus the per-request
+    lifecycle metrics (the JSON artifact is aggregated from these)."""
+
+    request_id: int
+    output: Any
+    bucket: tuple
+    requested_size: tuple
+    queue_wait_s: float
+    execute_s: float
+    e2e_s: float
+    batch_size: int
+    compile_hit: bool
+
+
+class RequestQueue:
+    """Bounded FIFO with predicate-scoped draining (see module docstring)."""
+
+    def __init__(self, max_depth: int):
+        assert max_depth >= 1, max_depth
+        self.max_depth = max_depth
+        self._items: List[Request] = []
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+        self._seq = 0  # bumped on every put; lets waiters sleep until an
+        # ARRIVAL rather than mere non-emptiness (batcher linger loop)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def seq(self) -> int:
+        """Arrival sequence number (monotonic; see wait_arrival)."""
+        with self._lock:
+            return self._seq
+
+    def put(self, req: Request) -> None:
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+            if len(self._items) >= self.max_depth:
+                raise QueueFullError(
+                    f"queue at max depth {self.max_depth}; retry later"
+                )
+            self._items.append(req)
+            self._seq += 1
+            self._nonempty.notify_all()
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        """Block until the queue has an item (True) or timeout (False)."""
+        with self._lock:
+            if self._items:
+                return True
+            self._nonempty.wait(timeout)
+            return bool(self._items)
+
+    def wait_arrival(self, seen_seq: int, timeout: float) -> int:
+        """Block until a put() lands after ``seen_seq`` (or timeout); returns
+        the current sequence.  Unlike wait_nonempty this does NOT return
+        immediately while incompatible requests sit queued — the batcher's
+        linger loop would otherwise busy-spin a core for the whole window."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._seq == seen_seq and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(remaining)
+            return self._seq
+
+    def pop_expired(self, now: float) -> List[Request]:
+        """Remove and return every request whose deadline has passed."""
+        with self._lock:
+            dead = [r for r in self._items if r.expired(now)]
+            if dead:
+                self._items = [r for r in self._items if not r.expired(now)]
+            return dead
+
+    def pop_where(self, pred: Callable[[Request], bool],
+                  limit: int) -> List[Request]:
+        """Remove and return up to ``limit`` requests matching ``pred``,
+        in arrival order (FIFO within the compatibility class)."""
+        assert limit >= 0, limit
+        with self._lock:
+            taken: List[Request] = []
+            kept: List[Request] = []
+            for r in self._items:
+                if len(taken) < limit and pred(r):
+                    taken.append(r)
+                else:
+                    kept.append(r)
+            self._items = kept
+            return taken
+
+    def close(self) -> List[Request]:
+        """Stop admitting; return whatever was still queued (the server
+        fails their futures with ServerClosedError)."""
+        with self._lock:
+            self._closed = True
+            drained, self._items = self._items, []
+            self._nonempty.notify_all()
+            return drained

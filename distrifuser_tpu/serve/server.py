@@ -1,0 +1,339 @@
+"""The long-lived inference server: admission -> micro-batch -> execute.
+
+`InferenceServer` ties the serve pieces together around ONE mesh:
+
+* `submit()` (any thread) runs admission control and returns a
+  `concurrent.futures.Future` resolving to a `ServeResult`;
+* a single scheduler thread drains the queue through the `MicroBatcher`,
+  fetches the bucket's executor from the `ExecutorCache` (warm = hit, cold
+  = compile), runs the coalesced batch through it, and resolves the
+  futures (the executor pads to its compiled batch width and strips);
+* every request's lifecycle (queue wait, batch size, compile hit/miss,
+  execute and end-to-end latency) lands in streaming histograms
+  (utils/metrics.py) exported as one JSON artifact — the serving analog of
+  `bench.py`'s one-JSON-line contract.
+
+One scheduler thread is deliberate: the service owns one device mesh, and
+the mesh runs one program at a time — extra dispatch threads would only
+interleave compiles with execution.  Concurrency lives in the *queue*
+(callers block on futures, not on the mesh) and in the batcher that turns
+queue depth into batch width.
+
+The executor contract (what `executor_factory(key)` must return):
+  * ``batch_size`` attribute — the compiled batch width to pad to;
+  * ``__call__(prompts, negative_prompts, guidance_scale, seeds) -> list``
+    of per-request outputs, ``len == len(prompts)`` (already unpadded).
+`serve/executors.py` adapts the real pipelines; `serve/testing.py` has the
+deterministic weightless fake used by tests, the demo, and
+``scripts/serve_bench.py --dry-run``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.config import ServeConfig
+from ..utils.metrics import Counter, LatencyHistogram
+from .batcher import BatchKey, BucketTable, MicroBatcher, NoBucketError
+from .cache import ExecKey, ExecutorCache
+from .queue import (
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    RequestQueue,
+    ServeResult,
+    ServerClosedError,
+)
+
+
+class InferenceServer:
+    """Async request scheduler with continuous micro-batching over one mesh.
+
+    ``executor_factory(key: ExecKey)`` builds (and compiles) the executor
+    for a bucket; ``model_id``/``scheduler``/``mesh_plan`` identify the
+    served model in cache keys — pass ``distri_config.mesh_plan`` when
+    wrapping real pipelines so a mesh change invalidates the cache keys.
+    """
+
+    def __init__(
+        self,
+        executor_factory: Callable[[ExecKey], Any],
+        config: Optional[ServeConfig] = None,
+        *,
+        model_id: str = "model",
+        scheduler: str = "ddim",
+        mesh_plan: str = "dp1.cfg1.sp1",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or ServeConfig()
+        self.model_id = model_id
+        self.scheduler = scheduler
+        self.mesh_plan = mesh_plan
+        self.clock = clock
+        self.queue = RequestQueue(self.config.max_queue_depth)
+        self.cache = ExecutorCache(
+            executor_factory, capacity=self.config.cache_capacity
+        )
+        self.counters = Counter()
+        self.hist_queue_wait = LatencyHistogram()
+        self.hist_execute = LatencyHistogram()
+        self.hist_e2e = LatencyHistogram()
+        self._batch_sizes = Counter()
+        self.batcher = MicroBatcher(
+            self.queue,
+            BucketTable(self.config.buckets),
+            model_id=model_id,
+            scheduler=scheduler,
+            max_batch_size=self.config.max_batch_size,
+            batch_window_s=self.config.batch_window_s,
+            on_reject=self._reject,
+            clock=clock,
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, warmup: bool = True) -> "InferenceServer":
+        """Spin up the scheduler thread; with ``warmup``, first prefetch
+        the configured hot buckets so their compiles happen before the
+        first request is admitted."""
+        assert self._thread is None, "server already started"
+        if warmup and self.config.warmup_buckets:
+            self.cache.warmup(self._warmup_keys())
+        self._stop.clear()
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._loop, name="distrifuser-serve", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: stop admitting, finish nothing further, fail
+        still-queued futures with `ServerClosedError`."""
+        self._stop.set()
+        for req in self.queue.close():
+            self.counters.inc("rejected_server_closed")
+            self._resolve(req.future, exc=ServerClosedError("server stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _warmup_keys(self) -> List[ExecKey]:
+        keys = []
+        table = self.batcher.table
+        for entry in self.config.warmup_buckets:
+            h, w = entry[0], entry[1]
+            steps = entry[2] if len(entry) > 2 else self.config.default_steps
+            bh, bw = table.snap(h, w)
+            keys.append(self._exec_key_for(bh, bw, steps,
+                                           cfg=self.config.warmup_cfg))
+        return keys
+
+    def _exec_key_for(self, h: int, w: int, steps: int, cfg: bool) -> ExecKey:
+        return ExecKey(
+            model_id=self.model_id,
+            scheduler=self.scheduler,
+            height=h,
+            width=w,
+            steps=steps,
+            cfg=cfg,
+            mesh_plan=self.mesh_plan,
+        )
+
+    # -- submission (any thread) ------------------------------------------
+
+    def submit(
+        self,
+        prompt: str,
+        *,
+        height: int,
+        width: int,
+        negative_prompt: str = "",
+        num_inference_steps: Optional[int] = None,
+        guidance_scale: float = 5.0,
+        seed: int = 0,
+        ttl_s: Optional[float] = None,
+    ) -> Future:
+        """Admit one request; returns a Future of `ServeResult`.
+
+        Raises `QueueFullError` (backpressure — retry against another
+        replica or later) or `ServerClosedError` immediately; deadline and
+        bucket rejections fail the *future* instead, since they are decided
+        at scheduling time."""
+        if not self._started or self._stop.is_set():
+            raise ServerClosedError("server is not running")
+        steps = (self.config.default_steps if num_inference_steps is None
+                 else num_inference_steps)
+        ttl = self.config.default_ttl_s if ttl_s is None else ttl_s
+        req = Request(
+            prompt=prompt,
+            negative_prompt=negative_prompt,
+            height=height,
+            width=width,
+            num_inference_steps=steps,
+            guidance_scale=guidance_scale,
+            seed=seed,
+            deadline=self.clock() + ttl,
+            enqueue_ts=self.clock(),
+        )
+        self.counters.inc("submitted")
+        try:
+            self.queue.put(req)
+        except QueueFullError:
+            self.counters.inc("rejected_queue_full")
+            raise
+        return req.future
+
+    # -- scheduling loop (single thread) ----------------------------------
+
+    @staticmethod
+    def _resolve(future, *, result=None, exc: Optional[Exception] = None) -> None:
+        """set_result/set_exception tolerating an already-resolved future
+        (a caller may cancel() while the request is queued — that must not
+        take down the scheduler thread)."""
+        try:
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(result)
+        except Exception:
+            pass  # cancelled/raced future: the caller gave up on it
+
+    def _reject(self, req: Request, exc: Exception) -> None:
+        if isinstance(exc, DeadlineExceededError):
+            self.counters.inc("rejected_deadline")
+        elif isinstance(exc, NoBucketError):
+            self.counters.inc("rejected_no_bucket")
+        else:
+            self.counters.inc("rejected_other")
+        self._resolve(req.future, exc=exc)
+
+    def _loop(self) -> None:
+        # The scheduler thread IS the service: an unexpected error
+        # (contract-violating executor, future-callback bug) must fail
+        # loudly in metrics and keep serving, never die silently.
+        import traceback
+
+        while not self._stop.is_set():
+            try:
+                got = self.batcher.next_batch(timeout=0.05)
+            except Exception:  # noqa: BLE001
+                self.counters.inc("scheduler_errors")
+                traceback.print_exc()
+                continue
+            if got is None:
+                continue
+            key, batch = got
+            try:
+                self._execute(key, batch)
+            except Exception as exc:  # noqa: BLE001
+                self.counters.inc("scheduler_errors")
+                traceback.print_exc()
+                for req in batch:
+                    self._resolve(req.future, exc=exc)
+
+    def _execute(self, key: BatchKey, batch: List[Request]) -> None:
+        dispatch_ts = self.clock()
+        ekey = self._exec_key_for(key.height, key.width, key.steps, key.cfg)
+        try:
+            executor, hit = self.cache.get(ekey)
+        except Exception as exc:  # build failed: fail the batch, keep serving
+            self.counters.inc("failed_build", len(batch))
+            for req in batch:
+                self._resolve(req.future, exc=exc)
+            return
+        self.counters.inc("batches")
+        self.counters.inc("requests_compile_hit" if hit
+                          else "requests_compile_miss", len(batch))
+        self._batch_sizes.inc(f"size_{len(batch)}")
+
+        prompts = [r.prompt for r in batch]
+        negs = [r.negative_prompt for r in batch]
+        seeds = [r.seed for r in batch]
+        t0 = self.clock()
+        try:
+            outputs = executor(prompts, negs, key.guidance_scale, seeds)
+        except Exception as exc:
+            self.counters.inc("failed_execute", len(batch))
+            for req in batch:
+                self._resolve(req.future, exc=exc)
+            return
+        t1 = self.clock()
+        if len(outputs) != len(batch):
+            # contract violation; surfaces via the _loop guard, which fails
+            # the batch's futures and counts a scheduler_error
+            raise RuntimeError(
+                f"executor returned {len(outputs)} outputs for a batch of "
+                f"{len(batch)}"
+            )
+        exec_s = t1 - t0
+        for req, out in zip(batch, outputs):
+            queue_wait = dispatch_ts - req.enqueue_ts
+            e2e = t1 - req.enqueue_ts
+            self.hist_queue_wait.observe(queue_wait)
+            self.hist_execute.observe(exec_s)
+            self.hist_e2e.observe(e2e)
+            self.counters.inc("completed")
+            self._resolve(req.future, result=ServeResult(
+                request_id=req.request_id,
+                output=out,
+                bucket=(key.height, key.width),
+                requested_size=(req.height, req.width),
+                queue_wait_s=queue_wait,
+                execute_s=exec_s,
+                e2e_s=e2e,
+                batch_size=len(batch),
+                compile_hit=hit,
+            ))
+
+    # -- observability -----------------------------------------------------
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """JSON-friendly service metrics — the serve artifact schema
+        (docs/SERVING.md) consumed by scripts/serve_bench.py."""
+        sizes = self._batch_sizes.snapshot()
+        n_batches = sum(sizes.values())
+        n_reqs = sum(int(k.split("_")[1]) * v for k, v in sizes.items())
+        return {
+            "model_id": self.model_id,
+            "scheduler": self.scheduler,
+            "mesh_plan": self.mesh_plan,
+            "config": {
+                "max_queue_depth": self.config.max_queue_depth,
+                "max_batch_size": self.config.max_batch_size,
+                "batch_window_s": self.config.batch_window_s,
+                "cache_capacity": self.config.cache_capacity,
+                "buckets": [list(b) for b in self.batcher.table.buckets],
+            },
+            "requests": self.counters.snapshot(),
+            "latency_s": {
+                "queue_wait": self.hist_queue_wait.snapshot(),
+                "execute": self.hist_execute.snapshot(),
+                "e2e": self.hist_e2e.snapshot(),
+            },
+            "batch_size": {
+                "hist": sizes,
+                "mean": (n_reqs / n_batches) if n_batches else 0.0,
+            },
+            "cache": self.cache.stats(),
+        }
+
+    def export_metrics(self, path: str) -> Dict[str, Any]:
+        snap = self.metrics_snapshot()
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return snap

@@ -1,0 +1,85 @@
+"""Deterministic weightless fakes for the serve layer.
+
+Everything the scheduler does — admission, bucketing, coalescing,
+deadlines, cache hits/evictions, metrics — is independent of what the
+executor computes, so tests, the ``--demo`` entry point, and
+``scripts/serve_bench.py --dry-run`` all drive the real scheduler with
+these fakes: no weights, no devices, milliseconds per "generation", and
+outputs that are a pure function of (prompt, seed, bucket, steps) so any
+reordering or cross-request mixup is detectable.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Any, List
+
+import numpy as np
+
+from .cache import ExecKey
+
+
+def fake_image(prompt: str, seed: int, key: ExecKey) -> np.ndarray:
+    """Deterministic tiny "image" for (prompt, seed, bucket, steps): an
+    8x8x3 float array seeded from a crc32 of the identifying tuple."""
+    h = zlib.crc32(
+        f"{prompt}|{seed}|{key.height}x{key.width}|{key.steps}|{key.cfg}"
+        .encode()
+    )
+    rng = np.random.RandomState(h % (2**31))
+    return rng.rand(8, 8, 3).astype(np.float32)
+
+
+class FakeExecutor:
+    """Serve-executor fake: optional simulated step time, call log.
+
+    ``batch_sizes`` records the *real* (unpadded) size of every invocation
+    — what tests assert coalescing against.
+    """
+
+    def __init__(self, key: ExecKey, batch_size: int = 8,
+                 step_time_s: float = 0.0):
+        self.key = key
+        self.batch_size = batch_size
+        self.step_time_s = step_time_s
+        self.batch_sizes: List[int] = []
+
+    def __call__(self, prompts: List[str], negative_prompts: List[str],
+                 guidance_scale: float, seeds: List[int]) -> List[Any]:
+        assert len(prompts) == len(negative_prompts) == len(seeds)
+        self.batch_sizes.append(len(prompts))
+        if self.step_time_s:
+            # batched invocation costs one pass regardless of batch size —
+            # the whole point of coalescing
+            time.sleep(self.step_time_s * self.key.steps)
+        return [fake_image(p, s, self.key) for p, s in zip(prompts, seeds)]
+
+
+class FakeExecutorFactory:
+    """Counts builds and keeps every built executor inspectable.
+
+    ``build_delay_s`` simulates the compile cost a cache miss pays, so
+    load-generator runs show the warm/cold latency split without XLA.
+    """
+
+    def __init__(self, batch_size: int = 8, build_delay_s: float = 0.0,
+                 step_time_s: float = 0.0):
+        self.batch_size = batch_size
+        self.build_delay_s = build_delay_s
+        self.step_time_s = step_time_s
+        self.built: List[ExecKey] = []
+        self.executors: List[FakeExecutor] = []
+
+    def __call__(self, key: ExecKey) -> FakeExecutor:
+        if self.build_delay_s:
+            time.sleep(self.build_delay_s)
+        self.built.append(key)
+        ex = FakeExecutor(key, batch_size=self.batch_size,
+                          step_time_s=self.step_time_s)
+        self.executors.append(ex)
+        return ex
+
+    def batch_sizes(self) -> List[int]:
+        """Every invocation's real batch size, across all executors."""
+        return [n for ex in self.executors for n in ex.batch_sizes]

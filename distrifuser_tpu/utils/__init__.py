@@ -1,2 +1,9 @@
-from .config import CFG_AXIS, SP_AXIS, DistriConfig, init_multihost
+from .config import (
+    CFG_AXIS,
+    DEFAULT_BUCKETS,
+    SP_AXIS,
+    DistriConfig,
+    ServeConfig,
+    init_multihost,
+)
 from .env import check_env, default_backend, is_power_of_2

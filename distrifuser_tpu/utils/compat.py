@@ -1,0 +1,48 @@
+"""JAX version-portability shims.
+
+The framework is written against the jax >= 0.8 surface (`jax.shard_map`
+with `check_vma`), but deployment images pin older runtimes — the current
+container ships 0.4.x, where the same machinery lives at
+`jax.experimental.shard_map.shard_map` and the replication-check kwarg is
+spelled `check_rep`.  Every shard_map call site in the repo imports from
+here so the version split is handled exactly once.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older lines: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The kwarg spelling does NOT track the import location (top-level
+# jax.shard_map existed before the check_rep -> check_vma rename), so probe
+# the signature instead of keying on where the import succeeded.
+import inspect as _inspect
+
+_REP_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+# jaxlib 0.4.x hard-aborts (SIGABRT inside backend_compile) on the fused
+# per-step callback program: `io_callback(ordered=True)` inside a
+# shard_map'd lax.scan.  Runners route callback-carrying generates through
+# the host-driven stepwise loop when this is False — same step numerics,
+# per-step dispatch instead of one fused program.
+SUPPORTS_FUSED_CALLBACK = _REP_KW == "check_vma"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` with the repo's calling convention on any jax line.
+
+    ``check_vma`` follows the >= 0.8 spelling; on 0.4.x it forwards to
+    ``check_rep`` (same semantics: disable the replication/varying-axis
+    checker, required for all-gather-style replicated outputs).
+    """
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_REP_KW: check_vma},
+    )

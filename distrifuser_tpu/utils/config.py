@@ -311,3 +311,103 @@ class DistriConfig:
     def is_sp(self) -> bool:
         """True when the spatial/sequence axis is actually split."""
         return self.parallelism in ("patch", "naive_patch") and self.n_device_per_batch > 1
+
+    @property
+    def mesh_plan(self) -> str:
+        """Compact mesh descriptor, e.g. ``"dp1.cfg2.sp4"`` — part of the
+        serve layer's compiled-executable cache key: two configs with the
+        same resolution but different meshes compile different programs."""
+        cfg_dim = self.group_size // self.n_device_per_batch
+        return f"dp{self.dp_degree}.cfg{cfg_dim}.sp{self.n_device_per_batch}"
+
+
+# Default resolution bucket table for the serve layer: the SDXL training
+# resolutions ladder up to the repo's benchmarked 2048px high-res point.
+DEFAULT_BUCKETS = (
+    (512, 512),
+    (768, 768),
+    (1024, 1024),
+    (1024, 2048),
+    (2048, 1024),
+    (2048, 2048),
+)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Configuration block for ``distrifuser_tpu.serve`` (the long-lived
+    inference service).  Kept here, beside DistriConfig, so one module owns
+    every run-shaping knob; the serve subsystem never invents defaults.
+
+    Admission control:
+      * ``max_queue_depth`` — bound on requests waiting for a batch slot;
+        submissions beyond it are rejected 429-style (QueueFullError), the
+        backpressure signal for upstream load balancers.
+      * ``default_ttl_s`` — per-request deadline when the caller gives none;
+        a request that waits past its deadline is *rejected*, never executed
+        (late work is wasted mesh time).
+
+    Micro-batching:
+      * ``max_batch_size`` — cap on requests coalesced into one invocation.
+      * ``batch_window_s`` — how long the batcher lingers for compatible
+        followers after the first request of a batch arrives.  0 disables
+        coalescing-by-wait (batches still form from a backlog).
+
+    Shape bucketing / compiled cache:
+      * ``buckets`` — (height, width) table; a request snaps to the smallest
+        bucket covering it, so the compiled program for a bucket is reused
+        across nearby resolutions.
+      * ``cache_capacity`` — LRU bound on resident compiled executables.
+      * ``warmup_buckets`` — (height, width[, steps]) tuples compiled at
+        startup so steady-state traffic never pays a request-path retrace;
+        ``warmup_cfg`` is the guidance mode they compile for (match it to
+        your traffic — a CFG-off service warming cfg=True executors buys
+        nothing and burns an LRU slot).
+    """
+
+    max_queue_depth: int = 64
+    default_ttl_s: float = 120.0
+    max_batch_size: int = 8
+    batch_window_s: float = 0.02
+    buckets: Sequence[Sequence[int]] = DEFAULT_BUCKETS
+    cache_capacity: int = 8
+    warmup_buckets: Sequence[Sequence[int]] = ()
+    warmup_cfg: bool = True
+    default_steps: int = 50
+
+    def __post_init__(self) -> None:
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}"
+            )
+        if self.max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {self.max_batch_size}"
+            )
+        if self.default_ttl_s <= 0:
+            raise ValueError(
+                f"default_ttl_s must be > 0, got {self.default_ttl_s}"
+            )
+        if self.batch_window_s < 0:
+            raise ValueError(
+                f"batch_window_s must be >= 0, got {self.batch_window_s}"
+            )
+        if self.cache_capacity < 1:
+            raise ValueError(
+                f"cache_capacity must be >= 1, got {self.cache_capacity}"
+            )
+        # BucketTable owns bucket validation and the area-major ordering
+        # invariant ("smallest covering bucket" scans front-to-back) — one
+        # normalization, not a copy here that could drift.  Lazy import:
+        # the serve package imports this module at load time.
+        from ..serve.batcher import BucketTable
+
+        self.buckets = BucketTable(self.buckets).buckets
+        warm = []
+        for b in self.warmup_buckets:
+            if len(b) not in (2, 3):
+                raise ValueError(
+                    f"warmup bucket {tuple(b)}: expected (h, w) or (h, w, steps)"
+                )
+            warm.append(tuple(int(x) for x in b))
+        self.warmup_buckets = tuple(warm)

@@ -13,9 +13,10 @@ from __future__ import annotations
 
 import jax
 
-# jax.shard_map with `check_vma` (the API this framework is written against)
-# first shipped in the 0.7 line; the mesh/collective code assumes it.
-_MIN_JAX = (0, 8, 0)
+# The mesh/collective code is written against jax.shard_map (>= 0.8 spelling,
+# `check_vma`); utils/compat.py bridges back to the 0.4.x experimental API
+# (`check_rep`).  The floor is the oldest line the compat shim covers.
+_MIN_JAX = (0, 4, 30)
 
 
 def _version_tuple(v: str) -> tuple[int, ...]:
@@ -33,6 +34,7 @@ def check_env() -> None:
             f"distrifuser_tpu requires jax >= {'.'.join(map(str, _MIN_JAX))} "
             f"(shard_map + async collective scheduling); found {jax.__version__}"
         )
+    from . import compat  # noqa: F401 -- raises ImportError if no shard_map
 
 
 def default_backend() -> str:

@@ -1,4 +1,4 @@
-"""Native image-quality metrics: PSNR, LPIPS (AlexNet), FID.
+"""Native metrics: image quality (PSNR, LPIPS, FID) and serving latency.
 
 The reference computes PSNR via torchmetrics, LPIPS via the `lpips` package
 and FID via `cleanfid` (/root/reference/scripts/compute_metrics.py:62-79) —
@@ -21,6 +21,11 @@ the only pluggable piece:
 The *math* (normalization, Fréchet distance incl. the sqrtm branch cuts,
 feature statistics) is fully tested with random weights; only the numbers'
 comparability to published tables depends on the pretrained files.
+
+The serving metrics (`LatencyHistogram`, `Counter`) back the request
+lifecycle instrumentation in `distrifuser_tpu/serve`: streaming accumulators
+in the same spirit as `RunningStatistics` — bounded memory regardless of
+request count, JSON-friendly snapshots for `bench.py`-style artifacts.
 """
 
 from __future__ import annotations
@@ -242,6 +247,119 @@ def load_fid_extractor(path: str, batch: int = 32) -> Callable[[np.ndarray], np.
         return np.concatenate(outs, axis=0)
 
     return extract
+
+
+# --------------------------------------------------------------------------
+# Serving-latency metrics (streaming, bounded memory — like RunningStatistics)
+# --------------------------------------------------------------------------
+
+
+class LatencyHistogram:
+    """Streaming latency histogram over geometric buckets.
+
+    Serving metrics must survive millions of requests, so raw samples are
+    never retained: observations land in log-spaced buckets (factor
+    ``2**0.25`` per bucket ≈ 19% relative resolution — tighter than the
+    2x-per-bucket Prometheus default) plus exact running count/sum/min/max.
+    Quantiles interpolate within the bucket (log-midpoint), so reported
+    percentiles carry the bucket's relative error, never more.
+
+    Range: ``lo`` seconds to ``hi`` seconds; observations outside clamp to
+    the boundary buckets (and still count exactly in min/max/sum).
+    """
+
+    _FACTOR = 2.0 ** 0.25
+
+    def __init__(self, lo: float = 1e-4, hi: float = 1e3):
+        assert 0 < lo < hi, (lo, hi)
+        self.lo = lo
+        self.hi = hi
+        import math
+
+        self._n_buckets = (
+            int(math.ceil(math.log(hi / lo) / math.log(self._FACTOR))) + 1
+        )
+        self._counts = np.zeros(self._n_buckets, np.int64)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def _bucket(self, v: float) -> int:
+        import math
+
+        if v <= self.lo:
+            return 0
+        i = int(math.log(v / self.lo) / math.log(self._FACTOR))
+        return min(i, self._n_buckets - 1)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self._counts[self._bucket(v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile (0 <= q <= 1) by bucket interpolation,
+        clamped to the exact observed [min, max]."""
+        assert 0.0 <= q <= 1.0, q
+        if self.count == 0:
+            return float("nan")
+        rank = q * (self.count - 1)
+        cum = 0
+        for i, c in enumerate(self._counts):
+            cum += int(c)
+            if cum > rank:
+                # log-midpoint of bucket i, clamped to the observed range
+                mid = self.lo * self._FACTOR ** (i + 0.5)
+                return float(min(max(mid, self.min), self.max))
+        return float(self.max)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else float("nan")
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-friendly summary (the serve artifact schema)."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class Counter:
+    """Named monotonic counters with a JSON-friendly snapshot.
+
+    Locked: the serve layer increments from client threads (submit-path
+    rejections) concurrently with the scheduler thread, and a bare
+    read-modify-write would drop counts under that interleaving."""
+
+    def __init__(self):
+        import threading
+
+        self._c: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._c.items()))
 
 
 def fid_between_dirs(

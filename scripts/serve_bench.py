@@ -1,0 +1,292 @@
+"""Load generator for the serve subsystem (distrifuser_tpu/serve).
+
+Drives an `InferenceServer` with synthetic traffic and writes ONE JSON
+artifact (bench.py convention: parseable line on stdout, full artifact via
+--out) containing the load parameters, throughput, and the server's
+per-request lifecycle metrics — queue wait / execute / e2e histograms,
+batch-size distribution, compiled-cache hit rate.
+
+Two load models:
+  * closed-loop (``--mode closed``): ``--concurrency`` workers, each
+    submitting and waiting, ``--requests`` total — measures capacity;
+  * open-loop (``--mode open``): fixed arrival rate ``--rate`` for
+    ``--duration`` seconds regardless of completions — measures behavior
+    under overload (429s, deadline rejects, queue growth).
+
+Backends:
+  * ``--dry-run``: the deterministic weightless fake executor
+    (serve/testing.py) — scheduler behavior only, runs anywhere in
+    milliseconds;
+  * ``--tiny-pipeline``: real tiny random-weight SD pipelines built per
+    bucket through serve.pipeline_executor_factory — the full compile/
+    cache/execute path on CPU (no snapshot needed; weights random because
+    latency is weight-value-independent).
+Real snapshots plug in the same way via pipeline_executor_factory; this
+box has no egress, so that path is exercised on real hardware only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distrifuser_tpu.serve import (  # noqa: E402
+    InferenceServer,
+    QueueFullError,
+    ServeConfig,
+)
+
+PROMPTS = (
+    "a photo of an astronaut riding a horse",
+    "a watercolor painting of a city skyline at dusk",
+    "a macro shot of a dew-covered leaf",
+    "a corgi wearing sunglasses on a beach",
+)
+
+# (height, width, weight): traffic mix over requested resolutions — off-grid
+# sizes exercise bucket snapping
+RESOLUTION_MIX = (
+    (512, 512, 0.5),
+    (640, 448, 0.2),
+    (1024, 1024, 0.2),
+    (768, 1536, 0.1),
+)
+
+
+def _pick_resolution(rng: random.Random):
+    r = rng.random()
+    acc = 0.0
+    for h, w, p in RESOLUTION_MIX:
+        acc += p
+        if r <= acc:
+            return h, w
+    return RESOLUTION_MIX[-1][:2]
+
+
+def _make_dry_factory(args):
+    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+
+    return FakeExecutorFactory(
+        batch_size=args.max_batch_size,
+        build_delay_s=args.fake_build_s,
+        step_time_s=args.fake_step_s,
+    ), "fake"
+
+
+def _make_tiny_factory(args):
+    """Real pipelines (tiny architecture, random weights) built per bucket
+    — the factory the cache calls on a miss, compiling via prepare()."""
+    import jax
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.clip import init_clip_params, tiny_clip_config
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+    from distrifuser_tpu.serve import pipeline_executor_factory
+
+    def build_pipeline(key):
+        dcfg = DistriConfig(
+            height=key.height, width=key.width,
+            do_classifier_free_guidance=key.cfg,
+            batch_size=args.max_batch_size,
+            warmup_steps=1,
+        )
+        tc = tiny_clip_config(hidden=32)
+        ucfg = tiny_config(cross_attention_dim=32, sdxl=False)
+        vcfg = tiny_vae_config()
+        return DistriSDPipeline.from_params(
+            dcfg, ucfg, init_unet_params(jax.random.PRNGKey(0), ucfg),
+            vcfg, init_vae_params(jax.random.PRNGKey(1), vcfg),
+            [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+            scheduler=args.scheduler,
+        )
+
+    mesh_plan = DistriConfig().mesh_plan
+    return pipeline_executor_factory(build_pipeline), mesh_plan
+
+
+def run_load(server: InferenceServer, args) -> dict:
+    rng = random.Random(args.seed)
+    futures = []
+    rejected = {"queue_full": 0}
+    lock = threading.Lock()
+
+    def submit_one(i: int):
+        with lock:
+            h, w = _pick_resolution(rng)
+        try:
+            f = server.submit(
+                PROMPTS[i % len(PROMPTS)],
+                height=h, width=w,
+                num_inference_steps=args.steps,
+                seed=i,
+                ttl_s=args.ttl_s,
+            )
+        except QueueFullError:
+            with lock:
+                rejected["queue_full"] += 1
+            return None
+        with lock:
+            futures.append(f)
+        return f
+
+    t_start = time.monotonic()
+    if args.mode == "closed":
+        remaining = list(range(args.requests))
+        idx_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with idx_lock:
+                    if not remaining:
+                        return
+                    i = remaining.pop()
+                mine = submit_one(i)
+                if mine is not None:
+                    try:
+                        mine.result(timeout=args.ttl_s + 60)
+                    except Exception:
+                        pass  # rejections are counted from the futures below
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:  # open loop: fixed arrival rate, submissions never wait
+        interval = 1.0 / args.rate
+        n = int(args.rate * args.duration)
+        for i in range(n):
+            submit_one(i)
+            time.sleep(interval)
+
+    completed, failed = 0, 0
+    for f in futures:
+        try:
+            f.result(timeout=args.ttl_s + 60)
+            completed += 1
+        except Exception:
+            failed += 1
+    wall = time.monotonic() - t_start
+    return {
+        "wall_s": wall,
+        "submitted": len(futures) + rejected["queue_full"],
+        "completed": completed,
+        "failed_or_rejected_late": failed,
+        "rejected_queue_full": rejected["queue_full"],
+        "throughput_rps": completed / wall if wall > 0 else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="closed loop: total requests")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed loop: in-flight callers")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="open loop: arrivals per second")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="open loop: seconds of load")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--scheduler", type=str, default="ddim")
+    ap.add_argument("--ttl_s", type=float, default=30.0)
+    ap.add_argument("--max_batch_size", type=int, default=4)
+    ap.add_argument("--batch_window_s", type=float, default=0.02)
+    ap.add_argument("--max_queue_depth", type=int, default=64)
+    ap.add_argument("--cache_capacity", type=int, default=8)
+    ap.add_argument("--buckets", type=str,
+                    default="512x512,1024x1024,1024x2048,2048x2048",
+                    help="comma-separated HxW bucket table")
+    ap.add_argument("--warmup", type=str, default="512x512",
+                    help="comma-separated HxW buckets to compile at startup "
+                         "('' disables warmup)")
+    backend = ap.add_mutually_exclusive_group(required=True)
+    backend.add_argument("--dry-run", action="store_true",
+                         help="weightless fake executor (scheduler only)")
+    backend.add_argument("--tiny-pipeline", action="store_true",
+                         help="real tiny random-weight pipelines (CPU ok)")
+    ap.add_argument("--fake_build_s", type=float, default=0.05,
+                    help="dry-run: simulated compile per cache miss")
+    ap.add_argument("--fake_step_s", type=float, default=0.002,
+                    help="dry-run: simulated per-step latency")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the full JSON artifact here")
+    args = ap.parse_args(argv)
+
+    def parse_hw(spec):
+        return tuple(
+            tuple(int(x) for x in b.split("x")) for b in spec.split(",") if b
+        )
+
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=args.batch_window_s,
+        buckets=parse_hw(args.buckets),
+        warmup_buckets=tuple((h, w, args.steps)
+                             for h, w in parse_hw(args.warmup)),
+        default_steps=args.steps,
+        cache_capacity=args.cache_capacity,
+        default_ttl_s=args.ttl_s,
+    )
+    if args.dry_run:
+        factory, mesh_plan = _make_dry_factory(args)
+        model_id = "dry-run"
+    else:
+        factory, mesh_plan = _make_tiny_factory(args)
+        model_id = "tiny-sd"
+
+    server = InferenceServer(
+        factory, config, model_id=model_id, scheduler=args.scheduler,
+        mesh_plan=mesh_plan,
+    )
+    with server:
+        load = run_load(server, args)
+        metrics = server.metrics_snapshot()
+
+    artifact = {
+        "bench": {
+            "mode": args.mode,
+            "backend": "dry-run" if args.dry_run else "tiny-pipeline",
+            "requests": args.requests if args.mode == "closed" else None,
+            "concurrency": (args.concurrency if args.mode == "closed"
+                            else None),
+            "rate_rps": args.rate if args.mode == "open" else None,
+            "duration_s": args.duration if args.mode == "open" else None,
+            "steps": args.steps,
+            "resolution_mix": [list(r) for r in RESOLUTION_MIX],
+        },
+        "load": load,
+        "metrics": metrics,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    # bench.py contract: one parseable summary line on stdout
+    print(json.dumps({
+        "metric": f"serve_{args.mode}_loop_throughput",
+        "value": round(load["throughput_rps"], 3),
+        "unit": "requests/s",
+        "completed": load["completed"],
+        "rejected_queue_full": load["rejected_queue_full"],
+        "cache_hit_rate": round(metrics["cache"]["hit_rate"], 3),
+        "mean_batch_size": round(metrics["batch_size"]["mean"], 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from distrifuser_tpu.utils.compat import shard_map
 
 from distrifuser_tpu.parallel import collectives as col
 from distrifuser_tpu.utils.config import SP_AXIS

@@ -88,3 +88,9 @@ def test_comm_batch_collapses_collective_count(devices8):
     ).compile().as_text()
     stale_u = max(analyze_loop_collectives(hlo_u), key=lambda r: r.n_deferred)
     assert stale_u.n_deferred > stale.n_deferred
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

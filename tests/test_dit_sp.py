@@ -463,3 +463,9 @@ def test_pipefusion_rejects_callbacks():
     with pytest.raises(ValueError, match="token"):
         runner.generate(lat, enc, num_inference_steps=2,
                         callback=lambda i, t, x: None)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -70,3 +70,9 @@ def test_dp_through_pipeline(devices8):
     lat = np.stack(out.images)
     assert lat.shape == (2, dcfg.latent_height, dcfg.latent_width, 4)
     assert np.isfinite(lat).all()
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -6,6 +6,7 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,6 +25,8 @@ def test_entry_tiny_compiles(monkeypatch):
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # compiles patch + tensor + dp loops on the fake
+# 8-device mesh — minutes on the 2-core tier-1 CPU runner
 def test_dryrun_multichip_8(monkeypatch):
     monkeypatch.setenv("DISTRIFUSER_TPU_GRAFT_PRESET", "tiny")
     monkeypatch.setenv("DISTRIFUSER_TPU_FLASH", "0")  # see above

@@ -15,6 +15,7 @@ from distrifuser_tpu import DistriConfig
 from distrifuser_tpu.models.unet import init_unet_params, tiny_config
 from distrifuser_tpu.parallel.runner import DenoiseRunner
 from distrifuser_tpu.schedulers import get_scheduler
+import pytest
 
 
 def test_2048_generation_executes(devices8):
@@ -47,3 +48,9 @@ def test_3840_8way_traces(devices8):
     gs = jax.ShapeDtypeStruct((), jnp.float32)
     lowered = loop.lower(runner.params, lat, enc, None, gs)
     assert lowered is not None
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

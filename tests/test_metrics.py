@@ -13,11 +13,54 @@ import pytest
 
 from distrifuser_tpu.utils.metrics import (
     LPIPS,
+    Counter,
+    LatencyHistogram,
     feature_statistics,
     fid_from_features,
     frechet_distance,
     psnr,
 )
+
+
+def test_latency_histogram_quantiles_approximate():
+    h = LatencyHistogram()
+    r = np.random.RandomState(0)
+    samples = np.abs(r.lognormal(mean=-2.0, sigma=1.0, size=5000))
+    for s in samples:
+        h.observe(float(s))
+    assert h.count == 5000
+    assert h.min == samples.min() and h.max == samples.max()
+    assert h.mean == pytest.approx(samples.mean(), rel=1e-9)
+    # bucket resolution is 2**0.25 per bucket -> ~19% relative error bound
+    for q in (0.5, 0.9, 0.99):
+        exact = float(np.quantile(samples, q))
+        assert h.quantile(q) == pytest.approx(exact, rel=0.2), q
+
+
+def test_latency_histogram_snapshot_and_empty():
+    assert LatencyHistogram().snapshot() == {"count": 0}
+    h = LatencyHistogram()
+    h.observe(0.5)
+    snap = h.snapshot()
+    assert snap["count"] == 1
+    # single observation: every quantile clamps to the exact value
+    assert snap["p50"] == snap["p99"] == 0.5
+    # out-of-range observations clamp to boundary buckets but keep exact
+    # min/max/mean
+    h2 = LatencyHistogram(lo=1e-3, hi=1.0)
+    h2.observe(1e-6)
+    h2.observe(50.0)
+    assert h2.min == 1e-6 and h2.max == 50.0
+    assert h2.quantile(0.0) >= 1e-6 and h2.quantile(1.0) <= 50.0
+
+
+def test_counter():
+    c = Counter()
+    c.inc("a")
+    c.inc("a", 2)
+    c.inc("b")
+    assert c.get("a") == 3 and c.get("missing") == 0
+    assert c.snapshot() == {"a": 3, "b": 1}
 
 
 def test_psnr_basics():

@@ -404,3 +404,9 @@ def test_config_from_json_prefix():
         mm.mmdit_config_from_json({"dual_attention_layers": [1, 2]})
     with pytest.raises(ValueError, match="dual_attention_blocks"):
         dataclasses.replace(mm.tiny_mmdit_config(), dual_attention_blocks=9)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

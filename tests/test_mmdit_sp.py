@@ -514,3 +514,9 @@ def test_stepwise_cfg_modes_match_fused():
         b = np.asarray(stepw.generate(lat, enc, pooled, **kw))
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4,
                                    err_msg=str(ckw["split_batch"]))
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

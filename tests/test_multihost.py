@@ -11,6 +11,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 
@@ -20,6 +22,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow  # needs a runtime with multiprocess collectives: the
+# 0.4.x-line CPU backend refuses ("Multiprocess computations aren't
+# implemented on the CPU backend"); runs on real pods / newer jax CPU
 def test_two_process_generation():
     port = _free_port()
     env = {**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}

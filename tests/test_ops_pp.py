@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from distrifuser_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrifuser_tpu.ops import (
@@ -316,3 +316,9 @@ def test_patch_attention_stale_kv(devices8):
     # refreshed state holds x2's gathered kv
     want_state = np.stack([kv2[:, j * l : (j + 1) * l] for j in range(n)])
     np.testing.assert_allclose(np.asarray(state2["attn"]), want_state, atol=1e-5)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

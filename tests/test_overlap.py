@@ -69,3 +69,9 @@ def test_sync_path_collectives_are_inline(devices8):
     assert body.n_inline > 0, (
         "analysis lost discrimination: sync-phase gathers classified deferred"
     )
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -375,3 +375,9 @@ def test_hybrid_matches_fused(sched):
     b = np.asarray(build(hybrid_loop=True).generate(
         lat, enc, guidance_scale=4.0, num_inference_steps=5))
     np.testing.assert_allclose(a, b, atol=2e-4)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

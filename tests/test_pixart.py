@@ -400,3 +400,9 @@ def test_pixart_pipeline_callback():
     with pytest.raises(ValueError, match="token"):
         pipe_pf(prompt="a fox", num_inference_steps=2, output_type="latent",
                 callback=lambda i, t, x: None)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from distrifuser_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrifuser_tpu import DistriConfig
@@ -136,3 +136,9 @@ def test_ring_no_sync_mode_traces(devices8):
 def test_attn_impl_validation(devices8):
     with pytest.raises(ValueError, match="attn_impl"):
         DistriConfig(devices=devices8, attn_impl="bogus")
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -198,3 +198,30 @@ def test_bf16_denoise_psnr_vs_fp32():
     rng = float(a.max() - a.min())
     psnr = 10 * np.log10(rng ** 2 / mse)
     assert psnr >= 40.0, f"bf16 denoise deviates from fp32: {psnr:.1f} dB"
+
+
+def test_compiled_handle_is_cached_and_observable():
+    """The serve layer's contract: compiled_handle returns the SAME object
+    for a repeated signature (no request-path retrace) and cache_info
+    reports builds/entries."""
+    runner, cfg, ucfg = make_runner(jax.devices("cpu"), 1)
+    assert runner.cache_info() == {"entries": [], "builds": 0}
+    h1 = runner.compiled_handle(3)
+    h2 = runner.compiled_handle(3)
+    assert h1 is h2
+    assert runner.cache_info()["builds"] == 1
+    runner.compiled_handle(4)
+    info = runner.cache_info()
+    assert info["builds"] == 2 and len(info["entries"]) == 2
+    # generate() dispatches to the prepared handle, not a fresh build
+    runner.prepare(3)
+    lat, enc = make_inputs(cfg, ucfg)
+    out = runner.generate(lat, enc, num_inference_steps=3)
+    assert np.isfinite(np.asarray(out)).all()
+    assert runner.cache_info()["builds"] == 2
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

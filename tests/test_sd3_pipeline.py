@@ -312,3 +312,9 @@ def test_sd3_with_t5_encoder(devices8):
     b = without("a fox", **kw).images[0]
     assert np.isfinite(a).all()
     assert np.abs(a - b).max() > 0
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

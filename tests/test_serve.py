@@ -1,0 +1,388 @@
+"""Serve-subsystem tests (distrifuser_tpu/serve) with the deterministic
+weightless fakes — scheduler behavior only: admission, bucketing, FIFO,
+deadlines, coalescing, cache eviction, metrics.  No weights, no devices;
+the real-pipeline adapter is covered by test_serve_pipeline.py."""
+
+import threading
+import time
+
+import pytest
+
+from distrifuser_tpu.serve import (
+    BucketTable,
+    DeadlineExceededError,
+    ExecKey,
+    ExecutorCache,
+    InferenceServer,
+    MicroBatcher,
+    NoBucketError,
+    QueueFullError,
+    Request,
+    RequestQueue,
+    ServeConfig,
+    ServerClosedError,
+)
+from distrifuser_tpu.serve.testing import FakeExecutorFactory, fake_image
+
+
+def mk_request(prompt="p", h=512, w=512, steps=4, gs=5.0, seed=0,
+               ttl=60.0, now=None):
+    now = time.monotonic() if now is None else now
+    return Request(
+        prompt=prompt, height=h, width=w, num_inference_steps=steps,
+        guidance_scale=gs, seed=seed, deadline=now + ttl, enqueue_ts=now,
+    )
+
+
+def mk_batcher(queue, table=None, **kw):
+    kw.setdefault("model_id", "m")
+    kw.setdefault("scheduler", "ddim")
+    kw.setdefault("max_batch_size", 4)
+    return MicroBatcher(queue, table or BucketTable(((512, 512), (1024, 1024))), **kw)
+
+
+# --------------------------------------------------------------------------
+# bucket snapping
+# --------------------------------------------------------------------------
+
+
+def test_bucket_snap_smallest_covering():
+    table = BucketTable(((1024, 1024), (512, 512), (768, 768), (1024, 2048)))
+    assert table.snap(512, 512) == (512, 512)  # exact
+    assert table.snap(500, 300) == (512, 512)  # smallest covering
+    assert table.snap(513, 512) == (768, 768)  # one dim over -> next bucket
+    assert table.snap(600, 1200) == (1024, 2048)  # wide: skips 1024x1024
+    with pytest.raises(NoBucketError):
+        table.snap(4096, 4096)
+
+
+def test_bucket_table_orders_by_area():
+    table = BucketTable(((2048, 2048), (512, 512), (1024, 1024)))
+    assert table.buckets == ((512, 512), (1024, 1024), (2048, 2048))
+
+
+def test_serve_config_validates_and_sorts_buckets():
+    cfg = ServeConfig(buckets=((1024, 1024), (512, 512)))
+    assert cfg.buckets == ((512, 512), (1024, 1024))
+    with pytest.raises(ValueError, match="multiples of 8"):
+        ServeConfig(buckets=((500, 500),))
+    with pytest.raises(ValueError, match="max_queue_depth"):
+        ServeConfig(max_queue_depth=0)
+    with pytest.raises(ValueError, match="warmup bucket"):
+        ServeConfig(warmup_buckets=((512,),))
+
+
+# --------------------------------------------------------------------------
+# queue: bounded admission
+# --------------------------------------------------------------------------
+
+
+def test_queue_full_rejection():
+    q = RequestQueue(max_depth=2)
+    q.put(mk_request())
+    q.put(mk_request())
+    with pytest.raises(QueueFullError):
+        q.put(mk_request())
+
+
+def test_queue_closed_rejection():
+    q = RequestQueue(max_depth=2)
+    q.put(mk_request())
+    drained = q.close()
+    assert len(drained) == 1
+    with pytest.raises(ServerClosedError):
+        q.put(mk_request())
+
+
+# --------------------------------------------------------------------------
+# batcher: FIFO, coalescing, deadlines
+# --------------------------------------------------------------------------
+
+
+def test_fifo_preserved_within_bucket():
+    q = RequestQueue(max_depth=16)
+    reqs = [mk_request(prompt=f"p{i}") for i in range(4)]
+    for r in reqs:
+        q.put(r)
+    b = mk_batcher(q)
+    key, batch = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch] == ["p0", "p1", "p2", "p3"]
+    assert (key.height, key.width) == (512, 512)
+
+
+def test_incompatible_requests_do_not_coalesce():
+    q = RequestQueue(max_depth=16)
+    q.put(mk_request(prompt="small"))
+    q.put(mk_request(prompt="big", h=1000, w=1000))
+    q.put(mk_request(prompt="small2"))
+    q.put(mk_request(prompt="different-steps", steps=8))
+    q.put(mk_request(prompt="different-scale", gs=2.0))
+    b = mk_batcher(q)
+    key1, batch1 = b.next_batch(timeout=0.0)
+    # leader "small" coalesces with "small2" only (same bucket/steps/scale),
+    # FIFO across the skipped incompatible one
+    assert [r.prompt for r in batch1] == ["small", "small2"]
+    key2, batch2 = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch2] == ["big"]
+    assert (key2.height, key2.width) == (1024, 1024)
+    _, batch3 = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch3] == ["different-steps"]
+    _, batch4 = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch4] == ["different-scale"]
+
+
+def test_max_batch_size_respected():
+    q = RequestQueue(max_depth=16)
+    for i in range(6):
+        q.put(mk_request(prompt=f"p{i}"))
+    b = mk_batcher(q, max_batch_size=4)
+    _, batch = b.next_batch(timeout=0.0)
+    assert len(batch) == 4
+    _, batch2 = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch2] == ["p4", "p5"]
+
+
+def test_expired_request_rejected_not_executed():
+    q = RequestQueue(max_depth=16)
+    dead = mk_request(prompt="late", ttl=-1.0)  # already expired
+    live = mk_request(prompt="live")
+    q.put(dead)
+    q.put(live)
+    rejected = []
+    b = mk_batcher(q, on_reject=lambda r, e: rejected.append((r, e)))
+    _, batch = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch] == ["live"]
+    assert [r.prompt for r, _ in rejected] == ["late"]
+    assert isinstance(rejected[0][1], DeadlineExceededError)
+    assert not dead.future.done()  # batcher only reports; the server
+    # fails the future (covered in test_server_deadline_* below)
+
+
+def test_unsnappable_request_rejected():
+    q = RequestQueue(max_depth=16)
+    q.put(mk_request(prompt="huge", h=8192, w=8192))
+    q.put(mk_request(prompt="ok"))
+    rejected = []
+    b = mk_batcher(q, on_reject=lambda r, e: rejected.append(e))
+    _, batch = b.next_batch(timeout=0.0)
+    assert [r.prompt for r in batch] == ["ok"]
+    assert isinstance(rejected[0], NoBucketError)
+
+
+def test_batch_window_waits_for_followers():
+    q = RequestQueue(max_depth=16)
+    q.put(mk_request(prompt="first"))
+    b = mk_batcher(q, batch_window_s=0.5)
+    late = mk_request(prompt="late-arrival")
+
+    def arrive_late():
+        time.sleep(0.1)
+        q.put(late)
+
+    t = threading.Thread(target=arrive_late)
+    t.start()
+    _, batch = b.next_batch(timeout=0.0)
+    t.join()
+    assert [r.prompt for r in batch] == ["first", "late-arrival"]
+
+
+# --------------------------------------------------------------------------
+# compiled-executable cache
+# --------------------------------------------------------------------------
+
+
+def key_for(h, w, steps=4):
+    return ExecKey(model_id="m", scheduler="ddim", height=h, width=w,
+                   steps=steps, cfg=True, mesh_plan="dp1.cfg1.sp1")
+
+
+def test_cache_hit_miss_and_lru_eviction():
+    evicted = []
+    cache = ExecutorCache(
+        lambda k: f"exec-{k.height}", capacity=2,
+        on_evict=lambda k, e: evicted.append(k),
+    )
+    k1, k2, k3 = key_for(512, 512), key_for(768, 768), key_for(1024, 1024)
+    assert cache.get(k1) == ("exec-512", False)
+    assert cache.get(k1) == ("exec-512", True)
+    assert cache.get(k2) == ("exec-768", False)
+    # touch k1 so k2 is the LRU victim when k3 lands
+    assert cache.get(k1)[1] is True
+    assert cache.get(k3) == ("exec-1024", False)
+    assert evicted == [k2]
+    assert k2 not in cache and k1 in cache and k3 in cache
+    # k2 rebuilds: eviction at capacity, not permanent loss
+    assert cache.get(k2) == ("exec-768", False)
+    s = cache.stats()
+    assert s["hits"] == 2 and s["misses"] == 4 and s["evictions"] == 2
+
+
+def test_cache_warmup_counts_builds():
+    cache = ExecutorCache(lambda k: object(), capacity=4)
+    built = cache.warmup([key_for(512, 512), key_for(768, 768),
+                          key_for(512, 512)])
+    assert built == 2
+    assert cache.stats()["misses"] == 2
+
+
+# --------------------------------------------------------------------------
+# server end-to-end (fake executors)
+# --------------------------------------------------------------------------
+
+
+def serve_config(**kw):
+    kw.setdefault("max_queue_depth", 16)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_window_s", 0.2)
+    kw.setdefault("buckets", ((512, 512), (1024, 1024)))
+    kw.setdefault("default_steps", 4)
+    return ServeConfig(**kw)
+
+
+def test_server_coalesces_concurrent_requests():
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config()) as server:
+        futs = []
+
+        def client(i):
+            futs.append(server.submit(f"p{i}", height=512, width=512, seed=i))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = [f.result(timeout=30) for f in futs]
+    assert max(factory.batch_sizes()) >= 2  # coalescing happened
+    assert {r.bucket for r in results} == {(512, 512)}
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["completed"] == 4
+    assert snap["cache"]["misses"] == 1  # one bucket -> one compile
+
+
+def test_warmup_respects_guidance_mode():
+    factory = FakeExecutorFactory(batch_size=4)
+    config = serve_config(warmup_buckets=((512, 512, 4),), warmup_cfg=False)
+    with InferenceServer(factory, config) as server:
+        # a CFG-off request (guidance_scale <= 1) hits the warmed executor
+        r = server.submit("p", height=512, width=512,
+                          guidance_scale=1.0).result(timeout=30)
+    assert r.compile_hit
+    assert [k.cfg for k in factory.built] == [False]
+
+
+def test_server_warmup_then_only_hits():
+    factory = FakeExecutorFactory(batch_size=4)
+    config = serve_config(warmup_buckets=((512, 512, 4),))
+    with InferenceServer(factory, config) as server:
+        assert server.cache.stats()["misses"] == 1  # the warmup build
+        for i in range(3):
+            r = server.submit(f"p{i}", height=512, width=512).result(timeout=30)
+            assert r.compile_hit
+    snap = server.metrics_snapshot()
+    assert snap["cache"]["hits"] > 0
+    assert snap["cache"]["misses"] == 1  # never missed on the request path
+    assert snap["requests"].get("requests_compile_miss", 0) == 0
+
+
+def test_server_deadline_rejects_queued_request():
+    # occupy the single scheduler with a slow batch (4 steps x 0.1s), then
+    # queue a request whose deadline lapses while it waits — it must be
+    # rejected at scheduling time, never executed
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.1)
+    with InferenceServer(factory, serve_config(batch_window_s=0.0)) as server:
+        slow = server.submit("slow", height=512, width=512)
+        time.sleep(0.1)  # scheduler picks up "slow" and blocks in execute
+        fut = server.submit("too-late", height=512, width=512, ttl_s=0.05)
+        slow.result(timeout=30)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+    assert server.metrics_snapshot()["requests"]["rejected_deadline"] == 1
+    assert factory.batch_sizes() == [1]  # only "slow" ever executed
+
+
+def test_server_result_is_deterministic_fake():
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config()) as server:
+        r = server.submit("corgi", height=512, width=512, seed=7).result(timeout=30)
+    expected = fake_image("corgi", 7, factory.built[0])
+    assert (r.output == expected).all()
+    assert r.requested_size == (512, 512)
+    assert r.e2e_s >= r.queue_wait_s >= 0
+
+
+def test_server_rejects_after_stop():
+    factory = FakeExecutorFactory(batch_size=4)
+    server = InferenceServer(factory, serve_config()).start(warmup=False)
+    server.stop()
+    with pytest.raises(ServerClosedError):
+        server.submit("p", height=512, width=512)
+
+
+def test_wait_arrival_sleeps_through_incompatible_backlog():
+    q = RequestQueue(max_depth=4)
+    q.put(mk_request(prompt="incompatible"))
+    seen = q.seq
+    t0 = time.monotonic()
+    # nothing arrives: wait_arrival must BLOCK for the window (no spin on
+    # the non-empty queue) and report no change
+    assert q.wait_arrival(seen, 0.1) == seen
+    assert time.monotonic() - t0 >= 0.09
+    q.put(mk_request(prompt="new"))
+    assert q.wait_arrival(seen, 5.0) == seen + 1  # returns on arrival
+
+
+def test_cancelled_future_does_not_kill_scheduler():
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.05)
+    with InferenceServer(factory, serve_config()) as server:
+        doomed = server.submit("cancel-me", height=512, width=512)
+        doomed.cancel()  # may succeed while queued; resolution must not
+        # take down the scheduler thread
+        ok = server.submit("live", height=512, width=512).result(timeout=30)
+    assert ok.output is not None
+
+
+def test_broken_executor_fails_batch_not_server():
+    class Broken:
+        batch_size = 4
+
+        def __call__(self, prompts, negs, gs, seeds):
+            return []  # violates the length contract
+
+    calls = {"n": 0}
+
+    def factory(key):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return Broken()
+        from distrifuser_tpu.serve.testing import FakeExecutor
+
+        return FakeExecutor(key, batch_size=4)
+
+    config = serve_config(cache_capacity=1, batch_window_s=0.0,
+                          buckets=((512, 512), (1024, 1024)))
+    with InferenceServer(factory, config) as server:
+        bad = server.submit("p", height=512, width=512)
+        with pytest.raises(RuntimeError, match="outputs for a batch"):
+            bad.result(timeout=30)
+        # a different bucket evicts the broken executor (capacity 1) and
+        # the server keeps serving
+        ok = server.submit("p", height=1024, width=1024).result(timeout=30)
+    assert ok.output is not None
+    assert server.counters.get("scheduler_errors") == 1
+
+
+def test_server_metrics_snapshot_schema():
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config()) as server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        snap = server.metrics_snapshot()
+    for section in ("config", "requests", "latency_s", "batch_size", "cache"):
+        assert section in snap, section
+    for hist in snap["latency_s"].values():
+        assert hist["count"] == 1
+        assert set(hist) >= {"mean", "min", "max", "p50", "p90", "p99"}
+    import json
+
+    json.dumps(snap)  # JSON-serializable end to end

@@ -153,3 +153,9 @@ def test_hybrid_matches_fused(devices8):
     a2 = np.asarray(fused.generate(lat, enc, num_inference_steps=2))
     b2 = np.asarray(hybrid.generate(lat, enc, num_inference_steps=2))
     np.testing.assert_allclose(a2, b2, atol=2e-4)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from distrifuser_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrifuser_tpu.models.unet import (
@@ -161,3 +161,9 @@ def test_sd15_and_sdxl_configs_build():
     for cfg in (sd15_config(), sdxl_config()):
         # just init a few top-level params to catch structural mistakes cheaply
         assert cfg.time_embed_dim == cfg.block_out_channels[0] * 4
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from distrifuser_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distrifuser_tpu import DistriConfig
@@ -111,3 +111,9 @@ def test_pipeline_uses_sp_decode(devices8):
                    guidance_scale=5.0, seed=0, output_type="np")
         imgs[vae_sp] = np.asarray(out.images[0])
     np.testing.assert_allclose(imgs[True], imgs[False], rtol=1e-4, atol=1e-4)
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow

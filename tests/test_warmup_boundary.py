@@ -13,6 +13,7 @@ from distrifuser_tpu import DistriConfig
 from distrifuser_tpu.models.unet import init_unet_params, tiny_config
 from distrifuser_tpu.parallel.runner import DenoiseRunner
 from distrifuser_tpu.schedulers import get_scheduler
+import pytest
 
 
 def _run(devices8, mode, steps, warmup):
@@ -41,3 +42,9 @@ def test_first_stale_step_diverges(devices8):
     assert np.abs(a - b).max() > 1e-6, (
         "displaced mode never engaged the stale path"
     )
+
+
+# CPU-compile-heavy module: the fake 8-device mesh compiles full
+# multi-device denoise loops, minutes per test on the tier-1 CPU runner.
+# Runs with `-m slow` and on real-hardware rounds.
+pytestmark = pytest.mark.slow
