@@ -444,14 +444,39 @@ def unet_forward(
     *,
     dispatch=None,
     added_cond: Optional[Dict[str, Any]] = None,
+    cache_depth: int = 0,
+    deep_cache=None,
 ):
     """Full UNet forward.
 
     ``sample``: [B, H, W, C] latent — the *full* latent in patch mode (conv_in
     slices to local rows, matching the reference where every rank receives the
     full input, distri_sdxl_unet_pp.py:134-146).  Returns [B, h(_local), W, C].
+
+    Temporal step-cache entry points (parallel/stepcache.py): with
+    ``cache_depth = K > 0`` the deepest K resolution levels — down blocks
+    ``L-K..L-1``, the mid block, and up blocks ``0..K-1`` — form the *deep
+    subtree*, and the return value becomes ``(out, deep)``:
+
+    * ``deep_cache is None`` (a **full** step): everything runs; ``deep`` is
+      the freshly computed deep-subtree output — the feature entering up
+      block K, after up block K-1's upsampler — for the carry;
+    * ``deep_cache`` given (a **shallow** step): only the shallow layers
+      execute — down blocks ``0..L-K-1`` minus block ``L-K-1``'s downsampler
+      (it feeds the deep subtree only), then up blocks ``K..L-1`` resuming
+      from ``deep_cache``; ``deep`` returns None (the caller keeps carrying
+      its cache).  Skip-connection bookkeeping is exact: the shallow layers
+      push precisely the skips the shallow up blocks pop.
     """
     d = dispatch or DenseDispatch()
+    n_levels = len(cfg.block_out_channels)
+    if cache_depth and not 1 <= cache_depth < n_levels:
+        raise ValueError(
+            f"cache_depth must be in [1, {n_levels - 1}] for "
+            f"{n_levels}-level UNet, got {cache_depth}"
+        )
+    cut = n_levels - cache_depth  # first deep down-block index
+    shallow = cache_depth > 0 and deep_cache is not None
     dtype = params["conv_in"]["kernel"].dtype
     b = sample.shape[0]
     if jnp.ndim(timesteps) == 0:
@@ -482,6 +507,8 @@ def unet_forward(
     x = d.conv_in(params["conv_in"], sample.astype(dtype), "conv_in")
     skips = [x]
     for i, btype in enumerate(cfg.down_block_types):
+        if shallow and i >= cut:
+            break
         bp = params["down_blocks"][i]
         for j in range(cfg.layers_per_block):
             name = f"down_blocks.{i}.resnets.{j}"
@@ -494,24 +521,34 @@ def unet_forward(
                     norm_groups=groups,
                 )
             skips.append(x)
-        if i < len(cfg.down_block_types) - 1:
+        if i < len(cfg.down_block_types) - 1 and not (shallow and i == cut - 1):
+            # block cut-1's downsampler feeds the deep subtree only
             x = d.conv(bp["downsamplers"][0]["conv"], x,
                        f"down_blocks.{i}.downsamplers.0.conv", stride=2)
             skips.append(x)
 
-    # --- mid ---
-    mp = params["mid_block"]
-    x = d.resnet(mp["resnets"][0], x, temb, "mid_block.resnets.0", groups=groups)
-    x = transformer_2d(
-        d, mp["attentions"][0], x, enc, "mid_block.attentions.0",
-        heads=cfg.heads_for_block(len(cfg.block_out_channels) - 1),
-        use_linear_projection=cfg.use_linear_projection, norm_groups=groups,
-    )
-    x = d.resnet(mp["resnets"][1], x, temb, "mid_block.resnets.1", groups=groups)
+    if not shallow:
+        # --- mid ---
+        mp = params["mid_block"]
+        x = d.resnet(mp["resnets"][0], x, temb, "mid_block.resnets.0", groups=groups)
+        x = transformer_2d(
+            d, mp["attentions"][0], x, enc, "mid_block.attentions.0",
+            heads=cfg.heads_for_block(len(cfg.block_out_channels) - 1),
+            use_linear_projection=cfg.use_linear_projection, norm_groups=groups,
+        )
+        x = d.resnet(mp["resnets"][1], x, temb, "mid_block.resnets.1", groups=groups)
 
     # --- up path ---
+    deep_out = None
     n_blocks = len(cfg.block_out_channels)
     for i, btype in enumerate(cfg.up_block_types):
+        if shallow and i < cache_depth:
+            continue
+        if cache_depth and i == cache_depth:
+            if shallow:
+                x = deep_cache
+            else:
+                deep_out = x
         bp = params["up_blocks"][i]
         for j in range(cfg.layers_per_block + 1):
             skip = skips.pop()
@@ -532,6 +569,8 @@ def unet_forward(
     assert not skips
     x = d.group_norm(params["conv_norm_out"], x, "conv_norm_out", groups=groups)
     x = d.conv(params["conv_out"], silu(x), "conv_out")
+    if cache_depth:
+        return x, deep_out
     return x
 
 

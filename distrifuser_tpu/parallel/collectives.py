@@ -32,9 +32,9 @@ def all_gather_seq(x, axis: str = SP_AXIS):
     return lax.all_gather(x, axis, axis=1, tiled=True)
 
 
-def psum_mean(x, n: int, axis: str = SP_AXIS):
-    """Average over the axis (reference all_reduce(SUM)/n, pp/groupnorm.py:79-80)."""
-    del n
+def psum_mean(x, axis: str = SP_AXIS):
+    """Average over the axis (reference all_reduce(SUM)/n, pp/groupnorm.py:79-80).
+    `lax.pmean` reads the peer count off the bound mesh axis itself."""
     return lax.pmean(x, axis)
 
 

@@ -39,12 +39,19 @@ from jax import lax
 
 from ..utils.config import SP_AXIS
 
-# Trace-time registry of state-name -> layer kind ("attn" | "gn" | "conv2d"),
-# filled by the emitting op itself (the only party that KNOWS its kind) so
-# reports never classify by name heuristics.  Populated as a Python side
-# effect during tracing; names are unique per architecture, so a flat map is
-# safe across models.
+# Trace-time registry of state-name -> layer kind ("attn" | "gn" | "conv2d"
+# | "stepcache"), filled by the emitting op itself (the only party that KNOWS
+# its kind) so reports never classify by name heuristics.  Populated as a
+# Python side effect during tracing; names are unique per architecture, so a
+# flat map is safe across models.
 KIND_REGISTRY: Dict[str, str] = {}
+
+# Names carried through UNTOUCHED (not freshly exchanged) by the most recent
+# carry_unconsumed() trace — how comm_volume_report distinguishes a shallow
+# step's fresh refresh traffic from the deep state it merely passes along.
+# Same trace-time side-effect convention as KIND_REGISTRY; callers that need
+# it clear it before tracing one step.
+CARRIED_REGISTRY: set = set()
 
 # Static phases of the denoising loop. ``SYNC`` is the warmup / full_sync
 # path (all collectives blocking-fresh, reference counter <= warmup_steps,
@@ -154,6 +161,28 @@ class PatchContext:
 
             top, bottom = halo_exchange(x, halo, self.n, self.axis)
             self.emit(name, jnp.stack([top, bottom]))
+
+    def carry_unconsumed(self) -> None:
+        """Pass every ``state_in`` entry this step did not re-emit through to
+        ``state_out`` unchanged.
+
+        The temporal step-cache (parallel/stepcache.py) skips whole layers on
+        shallow steps, so their displaced buffers — and the deep-feature
+        cache itself — must ride the carry untouched to keep the pytree
+        structure identical across the full/shallow pair of loop bodies (a
+        lax.scan carry cannot change structure).  Also covers full steps in
+        ``no_sync`` mode, where no layer refreshes but the step-cache entry
+        still does.  Call after ``flush()``; records the carried names in
+        ``CARRIED_REGISTRY`` for the comm report."""
+        assert not self._def_gather and not self._def_halo, (
+            "carry_unconsumed must run after flush()"
+        )
+        if self.state_in is None:
+            return
+        for name, value in self.state_in.items():
+            if name not in self.state_out:
+                self.state_out[name] = value
+                CARRIED_REGISTRY.add(name)
 
     def flush(self) -> None:
         """Run the batched refresh exchanges deferred by ``batch_comm``.

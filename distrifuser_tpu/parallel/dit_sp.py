@@ -60,6 +60,7 @@ from ..utils.config import (
 )
 from .collectives import all_gather_seq
 from .guidance import branch_select, combine_guidance
+from .stepcache import is_shallow_at, run_cadence
 
 
 class DiTDenoiseRunner:
@@ -115,6 +116,14 @@ class DiTDenoiseRunner:
                 f"token count {dit_config.num_tokens} must be divisible by "
                 f"the sp degree {n}"
             )
+        if distri_config.step_cache_enabled and not (
+            1 <= distri_config.step_cache_depth < dit_config.depth
+        ):
+            raise ValueError(
+                f"step_cache_depth={distri_config.step_cache_depth} must be "
+                f"in [1, {dit_config.depth - 1}] for this {dit_config.depth}-"
+                "block DiT (at least one block must stay shallow)"
+            )
         if (distri_config.height // 8 != dit_config.sample_size) or (
             distri_config.width // 8 != dit_config.sample_size
         ):
@@ -130,13 +139,18 @@ class DiTDenoiseRunner:
     # ------------------------------------------------------------------
 
     def _eval_model(self, params, x_full, s, kv_state, phase_sync,
-                    cap_kv, c6_all, temb_all, pos, cap_bias):
+                    cap_kv, c6_all, temb_all, pos, cap_bias, shallow=False):
         """One DiT evaluation on this device's token rows.
 
         Returns (full guided-input epsilon [Bl, N, D_out], new kv_state).
         ``kv_state``: gathered [depth, 2, Bl, N, hidden] stale K/V
         (attn_impl="gather") or the own [depth, Bl, N/n, 2*hidden] chunk
-        (attn_impl="ring").
+        (attn_impl="ring") — or, with the step cache enabled,
+        ``{"kv": <that state>, "deep": [Bl, N/n, hidden]}`` where ``deep``
+        is the residual the deepest ``step_cache_depth`` blocks added on
+        the last full step.  ``shallow`` runs only the first
+        ``depth - step_cache_depth`` blocks and adds the carried residual
+        (the skipped blocks' displaced KV rides through untouched).
         """
         cfg, dcfg = self.cfg, self.dcfg
         sched = self.scheduler
@@ -327,9 +341,48 @@ class DiTDenoiseRunner:
         else:
             block_body = block_body_ring if ring else block_body_gather
 
-        h, kv_new = lax.scan(
-            block_body, h, (params["blocks"], cap_kv, kv_state)
-        )
+        if cfg.step_cache_enabled:
+            kv_blocks, deep = kv_state["kv"], kv_state["deep"]
+            d_keep = dcfg.depth - cfg.step_cache_depth
+            if shallow:
+                # shallow body: only the first d_keep blocks execute; the
+                # deepest blocks' contribution is the carried residual, and
+                # their displaced KV (and the residual) pass through — so
+                # their refresh collectives never appear in this body.
+                head_xs = jax.tree.map(
+                    lambda l: l[:d_keep],
+                    (params["blocks"], cap_kv, kv_blocks),
+                )
+                h, kv_head = lax.scan(block_body, h, head_xs)
+                h = h + deep
+                kv_new = {
+                    "kv": jax.tree.map(
+                        lambda fresh, old: jnp.concatenate(
+                            [fresh, old[d_keep:]], axis=0
+                        ),
+                        kv_head, kv_blocks,
+                    ),
+                    "deep": deep,
+                }
+            else:
+                # full body: run everything, capturing h at the cut so the
+                # deep residual (h_final - h_mid) refreshes the carry
+                def full_body(carry, xs):
+                    hcur, h_mid = carry
+                    h2, fresh = block_body(hcur, xs[1:])
+                    h_mid = jnp.where(xs[0] == d_keep - 1, h2, h_mid)
+                    return (h2, h_mid), fresh
+
+                (h, h_mid), kv_all = lax.scan(
+                    full_body, (h, h),
+                    (jnp.arange(dcfg.depth), params["blocks"], cap_kv,
+                     kv_blocks),
+                )
+                kv_new = {"kv": kv_all, "deep": h - h_mid}
+        else:
+            h, kv_new = lax.scan(
+                block_body, h, (params["blocks"], cap_kv, kv_state)
+            )
         eps_rows = dit_mod.final_layer(params, dcfg, h, temb_all[s])
         eps_full = all_gather_seq(eps_rows, self.seq_axes)
         return eps_full, kv_new
@@ -349,10 +402,10 @@ class DiTDenoiseRunner:
         temb_all = jax.vmap(lambda t: dit_mod.t_embed(params, dcfg, t))(ts)
         c6_all = jax.vmap(lambda e: dit_mod.adaln_table(params, dcfg, e))(temb_all)
 
-        def step(x, sstate, kv, s, phase_sync):
+        def step(x, sstate, kv, s, phase_sync, shallow=False):
             eps, kv = self._eval_model(
                 params, x, s, kv, phase_sync, cap_kv, c6_all, temb_all, pos,
-                cap_bias,
+                cap_bias, shallow=shallow,
             )
             guided = combine_guidance(cfg, eps, gs, batch)
             x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
@@ -365,16 +418,22 @@ class DiTDenoiseRunner:
         if cfg.attn_impl in ("ulysses", "usp"):
             # exact and stateless: a minimal placeholder keeps the block
             # scan's xs structure uniform
-            return jnp.zeros((dcfg.depth, 1), compute_dtype)
-        if cfg.attn_impl == "ring":
+            kv = jnp.zeros((dcfg.depth, 1), compute_dtype)
+        elif cfg.attn_impl == "ring":
             chunk = dcfg.num_tokens // cfg.n_device_per_batch
-            return jnp.zeros(
+            kv = jnp.zeros(
                 (dcfg.depth, bloc, chunk, 2 * dcfg.hidden_size), compute_dtype
             )
-        return jnp.zeros(
-            (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
-            compute_dtype,
-        )
+        else:
+            kv = jnp.zeros(
+                (dcfg.depth, 2, bloc, dcfg.num_tokens, dcfg.hidden_size),
+                compute_dtype,
+            )
+        if cfg.step_cache_enabled:
+            chunk = dcfg.num_tokens // cfg.n_device_per_batch
+            return {"kv": kv, "deep": jnp.zeros(
+                (bloc, chunk, dcfg.hidden_size), compute_dtype)}
+        return kv
 
     def _device_loop(self, params, latents, enc, cap_mask, gs, num_steps):
         cfg, dcfg = self.cfg, self.dcfg
@@ -387,11 +446,31 @@ class DiTDenoiseRunner:
         kv0 = self._kv0(bloc, compute_dtype)
 
         full_sync = cfg.mode == "full_sync" or not cfg.is_sp
-        n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
 
         def sync_body(i, carry):
             x, ss, kv = carry
             return step(x, ss, kv, i, True)
+
+        if cfg.step_cache_enabled:
+            # temporal step-cache cadence (parallel/stepcache.py): full
+            # warmup, then super-steps of (interval-1) shallow + 1 full —
+            # the same two-bodies-in-a-scan shape as the UNet runner's
+            n_sync = min(cfg.warmup_steps + 1, num_steps)
+            x, sstate, kv = lax.fori_loop(
+                0, n_sync, sync_body, (x, sstate, kv0)
+            )
+
+            def run_step(carry, i, shallow):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, full_sync, shallow)
+
+            x, _, _ = run_cadence(
+                (x, sstate, kv), n_sync, num_steps - n_sync,
+                cfg.step_cache_interval, run_step,
+            )
+            return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
+
+        n_sync = num_steps if full_sync else min(cfg.warmup_steps + 1, num_steps)
 
         x, sstate, kv = lax.fori_loop(0, n_sync, sync_body, (x, sstate, kv0))
 
@@ -507,7 +586,7 @@ class DiTDenoiseRunner:
         )
         return P(DP_AXIS), kv_spec, ss_spec, P(None, DP_AXIS)
 
-    def _make_stepper(self, phase_sync: bool):
+    def _make_stepper(self, phase_sync: bool, shallow: bool = False):
         """Un-jitted shard_map'd single step over PATCHIFIED tokens
         [B, N, token_dim] (global-array signature)."""
         x_spec, kv_spec, ss_spec, enc_spec = self._token_specs()
@@ -515,8 +594,10 @@ class DiTDenoiseRunner:
         def device_step(params, s, x, kv, sstate, enc, cap_mask, gs):
             step, _, _ = self._make_step(params, enc, cap_mask, gs,
                                          x.shape[0])
-            x, sstate, kv_new = step(x, sstate, kv[0], s, phase_sync)
-            return x, sstate, kv_new[None]
+            kv_local = jax.tree.map(lambda l: l[0], kv)
+            x, sstate, kv_new = step(x, sstate, kv_local, s, phase_sync,
+                                     shallow)
+            return x, sstate, jax.tree.map(lambda l: l[None], kv_new)
 
         def stepper(params, s, x, kv, sstate, enc, cap_mask, gs):
             return shard_map(
@@ -530,14 +611,17 @@ class DiTDenoiseRunner:
 
         return stepper
 
-    def _ensure_stepper(self, num_steps: int, sync: bool):
-        """Jitted per-step program cached by (num_steps, phase) — the
-        scheduler tables bake at trace time (same convention as the UNet
-        and MMDiT runners)."""
+    def _ensure_stepper(self, num_steps: int, sync: bool,
+                        shallow: bool = False):
+        """Jitted per-step program cached by (num_steps, phase, shallow) —
+        the scheduler tables bake at trace time (same convention as the
+        UNet and MMDiT runners)."""
         fns = self._compiled.setdefault(("stepwise", num_steps), {})
-        if sync not in fns:
-            fns[sync] = jax.jit(self._make_stepper(sync), donate_argnums=(3,))
-        return fns[sync]
+        fkey = (sync, shallow)
+        if fkey not in fns:
+            fns[fkey] = jax.jit(self._make_stepper(sync, shallow),
+                                donate_argnums=(3,))
+        return fns[fkey]
 
     def _kv0_global(self, batch):
         """Global stepwise-layout zeros: per-device _kv0 stacked over every
@@ -547,12 +631,15 @@ class DiTDenoiseRunner:
         bloc = (1 if cfg.cfg_split or not cfg.do_classifier_free_guidance
                 else 2) * (batch // cfg.dp_degree)
         per_dev = self._kv0(bloc, self.params["proj_in"]["kernel"].dtype)
-        return jnp.zeros((n_total,) + per_dev.shape, per_dev.dtype)
+        return jax.tree.map(
+            lambda l: jnp.zeros((n_total,) + l.shape, l.dtype), per_dev
+        )
 
     def _exec_phases(self, num_steps: int):
         full_sync = self.cfg.mode == "full_sync" or not self.cfg.is_sp
-        return (num_steps if full_sync
-                else min(self.cfg.warmup_steps + 1, num_steps))
+        if full_sync and not self.cfg.step_cache_enabled:
+            return num_steps
+        return min(self.cfg.warmup_steps + 1, num_steps)
 
     def _generate_stepwise(self, latents, enc, cap_mask, gs, num_steps,
                            callback=None):
@@ -563,11 +650,17 @@ class DiTDenoiseRunner:
         sched = self.scheduler
         sched.set_timesteps(num_steps)
         n_sync = self._exec_phases(num_steps)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        sc = cfg.step_cache_enabled
         x = dit_mod.patchify(dcfg, jnp.asarray(latents, jnp.float32))
         sstate = sched.init_state(x.shape)
         kv = self._kv0_global(latents.shape[0])
         for i in range(num_steps):
-            x, sstate, kv = self._ensure_stepper(num_steps, i < n_sync)(
+            shallow = sc and is_shallow_at(i, n_sync,
+                                           cfg.step_cache_interval)
+            x, sstate, kv = self._ensure_stepper(
+                num_steps, one_phase or i < n_sync, shallow
+            )(
                 self.params, jnp.asarray(i), x, kv, sstate, enc, cap_mask,
                 gs,
             )
@@ -636,8 +729,15 @@ class DiTDenoiseRunner:
         cfg, dcfg = self.cfg, self.dcfg
         n = cfg.n_device_per_batch
         if not cfg.is_sp:
-            return {"layout": cfg.attn_impl, "kv_state_elems": 0,
-                    "per_step_collective_elems": 0}
+            report = {"layout": cfg.attn_impl, "kv_state_elems": 0,
+                      "per_step_collective_elems": 0}
+            if cfg.step_cache_enabled:
+                report["step_cache"] = {
+                    "interval": cfg.step_cache_interval,
+                    "depth": cfg.step_cache_depth,
+                    "shallow_per_step_collective_elems": 0,
+                }
+            return report
         # Per-device folded batch (guidance.branch_select): cfg_split keeps
         # one branch locally; otherwise CFG rides the batch dim as 2B.
         n_br_local = (
@@ -667,8 +767,20 @@ class DiTDenoiseRunner:
             a2a = depth * b * chunk * hid * 4 if u > 1 else 0
             ring_hops = depth * (r - 1) * b * (chunk * u) * 2 * hid // u
             per_step = a2a + ring_hops + eps_gather
-        return {"layout": cfg.attn_impl, "kv_state_elems": int(state),
-                "per_step_collective_elems": int(per_step)}
+        report = {"layout": cfg.attn_impl, "kv_state_elems": int(state),
+                  "per_step_collective_elems": int(per_step)}
+        if cfg.step_cache_enabled:
+            # shallow steps run only d_keep of depth blocks, so the
+            # per-block exchange volume scales down proportionally; the
+            # final epsilon gather always runs
+            d_keep = depth - cfg.step_cache_depth
+            shallow = (per_step - eps_gather) * d_keep // depth + eps_gather
+            report["step_cache"] = {
+                "interval": cfg.step_cache_interval,
+                "depth": cfg.step_cache_depth,
+                "shallow_per_step_collective_elems": int(shallow),
+            }
+        return report
 
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
                  cap_mask=None, callback=None):
@@ -691,9 +803,11 @@ class DiTDenoiseRunner:
         if callback is not None:
             from ..utils.compat import SUPPORTS_FUSED_CALLBACK
 
-            if not SUPPORTS_FUSED_CALLBACK:
+            if not SUPPORTS_FUSED_CALLBACK or self.cfg.step_cache_enabled:
                 # this jaxlib aborts compiling the ordered-io_callback
-                # program (utils/compat.py) — host-driven loop instead
+                # program (utils/compat.py) — host-driven loop instead.
+                # Step-cache callbacks also take the host loop: the
+                # stepwise steppers replay the exact cadence.
                 return self._generate_stepwise(
                     latents, enc, cap_mask, gs, num_inference_steps, callback,
                 )

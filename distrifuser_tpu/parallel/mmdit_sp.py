@@ -56,6 +56,7 @@ from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import all_gather_seq
 from .guidance import branch_select, combine_guidance
+from .stepcache import is_shallow_at, run_cadence
 
 
 class MMDiTDenoiseRunner:
@@ -97,6 +98,19 @@ class MMDiTDenoiseRunner:
                 f"token count {mmdit_config.num_tokens} must be divisible "
                 f"by the sp degree {n}"
             )
+        if distri_config.step_cache_enabled:
+            k_cache = distri_config.step_cache_depth
+            max_k = mmdit_config.depth - max(
+                mmdit_config.dual_attention_blocks, 1
+            )
+            if not 1 <= k_cache <= max_k:
+                raise ValueError(
+                    f"step_cache_depth={k_cache} must be in [1, {max_k}] for "
+                    f"this {mmdit_config.depth}-block MMDiT: the cut must "
+                    "stay below the dual-attention prefix "
+                    f"({mmdit_config.dual_attention_blocks} blocks) and "
+                    "leave at least one shallow block"
+                )
         if (distri_config.height // 8 != mmdit_config.sample_size) or (
             distri_config.width // 8 != mmdit_config.sample_size
         ):
@@ -112,7 +126,7 @@ class MMDiTDenoiseRunner:
     # ------------------------------------------------------------------
 
     def _eval_model(self, params, x_full, s, kv_state, phase_sync,
-                    ctx0, vec_all, pos):
+                    ctx0, vec_all, pos, shallow=False):
         """One MMDiT evaluation on this device's token rows.
 
         Returns (full guided-input velocity [Bl, N, D_out], new kv_state).
@@ -120,7 +134,12 @@ class MMDiTDenoiseRunner:
         or, with dual-attention blocks (SD3.5-medium), a dict
         ``{"j": [depth, ...] joint-image KV, "d": [k_dual, ...] attn2 KV}``
         (attn2 is image-only self-attention over the same sharded rows, so
-        its displaced state has the same per-block layout).
+        its displaced state has the same per-block layout).  With the step
+        cache enabled the whole thing wraps to ``{"kv": <that state>,
+        "deep": [Bl, N/n, hidden]}``; ``shallow`` runs only the first
+        ``depth - step_cache_depth`` blocks on the image stream and adds the
+        carried deep residual (the skipped blocks' displaced KV rides
+        through untouched — the cut always sits past the dual prefix).
         ``ctx0``: [Bl, Lc, hidden] projected context entering block 0 —
         recomputed per step is unnecessary (it is timestep-independent),
         but the stream EVOLVES through the blocks, so it restarts from
@@ -270,20 +289,74 @@ class MMDiTDenoiseRunner:
         ring = cfg.attn_impl == "ring"
         block_body = block_body_ring if ring else block_body_gather
         k_dual = mcfg.dual_attention_blocks
+        sc = cfg.step_cache_enabled
+        inner = kv_state["kv"] if sc else kv_state
+        d_keep = mcfg.depth - cfg.step_cache_depth if sc else mcfg.depth
+
+        def capture_body(carry, xs):
+            # block_body wrapped to record the image stream at the cut, so
+            # a full step can refresh the deep residual (h_final - h_mid)
+            streams, h_mid = carry
+            streams, fresh = block_body(streams, xs[1:])
+            h_mid = jnp.where(xs[0] == d_keep - 1, streams[0], h_mid)
+            return (streams, h_mid), fresh
+
         if k_dual:
             dual_body = dual_body_ring if ring else dual_body_gather
-            kv_j, kv_d = kv_state["j"], kv_state["d"]
+            kv_j, kv_d = inner["j"], inner["d"]
             bp_pre = jax.tree.map(lambda l: l[:k_dual], params["blocks"])
             (h, hc), (kvj_pre, kvd_new) = lax.scan(
                 dual_body, (h, ctx0),
                 (bp_pre, params["blocks_dual"], kv_j[:k_dual], kv_d),
             )
-            bp_suf = jax.tree.map(lambda l: l[k_dual:], params["blocks"])
-            (h, _), kvj_suf = lax.scan(
-                block_body, (h, hc), (bp_suf, kv_j[k_dual:])
+            if sc and shallow:
+                bp_mid = jax.tree.map(
+                    lambda l: l[k_dual:d_keep], params["blocks"]
+                )
+                (h, _), kvj_mid = lax.scan(
+                    block_body, (h, hc), (bp_mid, kv_j[k_dual:d_keep])
+                )
+                h = h + kv_state["deep"]
+                kv_new = {
+                    "kv": {"j": jnp.concatenate(
+                        [kvj_pre, kvj_mid, kv_j[d_keep:]], axis=0),
+                        "d": kvd_new},
+                    "deep": kv_state["deep"],
+                }
+            elif sc:
+                bp_suf = jax.tree.map(lambda l: l[k_dual:], params["blocks"])
+                ((h, _), h_mid), kvj_suf = lax.scan(
+                    capture_body, ((h, hc), h),
+                    (jnp.arange(k_dual, mcfg.depth), bp_suf, kv_j[k_dual:]),
+                )
+                kv_new = {
+                    "kv": {"j": jnp.concatenate([kvj_pre, kvj_suf], axis=0),
+                           "d": kvd_new},
+                    "deep": h - h_mid,
+                }
+            else:
+                bp_suf = jax.tree.map(lambda l: l[k_dual:], params["blocks"])
+                (h, _), kvj_suf = lax.scan(
+                    block_body, (h, hc), (bp_suf, kv_j[k_dual:])
+                )
+                kv_new = {"j": jnp.concatenate([kvj_pre, kvj_suf], axis=0),
+                          "d": kvd_new}
+        elif sc and shallow:
+            head = jax.tree.map(
+                lambda l: l[:d_keep], (params["blocks"], inner)
             )
-            kv_new = {"j": jnp.concatenate([kvj_pre, kvj_suf], axis=0),
-                      "d": kvd_new}
+            (h, _), kv_head = lax.scan(block_body, (h, ctx0), head)
+            h = h + kv_state["deep"]
+            kv_new = {
+                "kv": jnp.concatenate([kv_head, inner[d_keep:]], axis=0),
+                "deep": kv_state["deep"],
+            }
+        elif sc:
+            ((h, _), h_mid), kv_all = lax.scan(
+                capture_body, ((h, ctx0), h),
+                (jnp.arange(mcfg.depth), params["blocks"], inner),
+            )
+            kv_new = {"kv": kv_all, "deep": h - h_mid}
         else:
             (h, _), kv_new = lax.scan(
                 block_body, (h, ctx0), (params["blocks"], kv_state)
@@ -309,9 +382,10 @@ class MMDiTDenoiseRunner:
             lambda t: mm.cond_vec(params, mcfg, t, my_pooled)
         )(ts)
 
-        def step(x, sstate, kv, s, phase_sync):
+        def step(x, sstate, kv, s, phase_sync, shallow=False):
             out, kv = self._eval_model(
-                params, x, s, kv, phase_sync, ctx0, vec_all, pos
+                params, x, s, kv, phase_sync, ctx0, vec_all, pos,
+                shallow=shallow,
             )
             guided = combine_guidance(cfg, out, gs, batch)
             x, sstate = sched.step(x, guided.astype(jnp.float32), s, sstate)
@@ -339,8 +413,14 @@ class MMDiTDenoiseRunner:
                 )
 
         if mcfg.dual_attention_blocks:
-            return {"j": mk(mcfg.depth), "d": mk(mcfg.dual_attention_blocks)}
-        return mk(mcfg.depth)
+            kv = {"j": mk(mcfg.depth), "d": mk(mcfg.dual_attention_blocks)}
+        else:
+            kv = mk(mcfg.depth)
+        if self.cfg.step_cache_enabled:
+            chunk = mcfg.num_tokens // self.cfg.n_device_per_batch
+            return {"kv": kv, "deep": jnp.zeros(
+                (bloc, chunk, mcfg.hidden_size), compute_dtype)}
+        return kv
 
     def _device_loop(self, params, latents, enc, pooled, gs, num_steps,
                      start_step=0, end_step=None):
@@ -365,6 +445,23 @@ class MMDiTDenoiseRunner:
         x, sstate, kv = lax.fori_loop(
             start_step, start_step + n_sync, sync_body, (x, sstate, kv0)
         )
+
+        if cfg.step_cache_enabled:
+            # temporal step-cache cadence (parallel/stepcache.py): super-
+            # steps of (interval-1) shallow + 1 full after the warmup —
+            # the same two-bodies-in-a-scan shape as the other runners
+            steady_sync = cfg.mode == "full_sync" or not cfg.is_sp
+            s0 = start_step + n_sync
+
+            def run_step(carry, i, shallow):
+                x, ss, kv = carry
+                return step(x, ss, kv, i, steady_sync, shallow)
+
+            x, _, _ = run_cadence(
+                (x, sstate, kv), s0, num_steps - s0,
+                cfg.step_cache_interval, run_step,
+            )
+            return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
 
         if start_step + n_sync < num_steps:
             def stale_body(carry, i):
@@ -419,7 +516,7 @@ class MMDiTDenoiseRunner:
         )
         return lat_spec, kv_spec, ss_spec, P(None, DP_AXIS)
 
-    def _make_stepper(self, phase_sync: bool):
+    def _make_stepper(self, phase_sync: bool, shallow: bool = False):
         """Un-jitted shard_map'd single step over PATCHIFIED tokens
         [B, N, token_dim] (global-array signature): the host loop and the
         compiled-callback loop both drive it."""
@@ -429,7 +526,8 @@ class MMDiTDenoiseRunner:
         def device_step(params, s, x, kv, sstate, enc, pooled, gs):
             step, _, _ = self._make_step(params, enc, pooled, gs, x.shape[0])
             kv_local = jax.tree.map(lambda l: l[0], kv)
-            x, sstate, kv_new = step(x, sstate, kv_local, s, phase_sync)
+            x, sstate, kv_new = step(x, sstate, kv_local, s, phase_sync,
+                                     shallow)
             return x, sstate, jax.tree.map(lambda l: l[None], kv_new)
 
         def stepper(params, s, x, kv, sstate, enc, pooled, gs):
@@ -460,19 +558,22 @@ class MMDiTDenoiseRunner:
         num_exec_end = num_steps if end_step is None else end_step
         full_sync = self.cfg.mode == "full_sync" or not self.cfg.is_sp
         n_exec = num_exec_end - start_step
-        n_sync = n_exec if full_sync else min(self.cfg.warmup_steps + 1,
-                                              n_exec)
+        n_sync = (n_exec if full_sync and not self.cfg.step_cache_enabled
+                  else min(self.cfg.warmup_steps + 1, n_exec))
         return num_exec_end, n_sync
 
-    def _ensure_stepper(self, num_steps: int, sync: bool):
-        """Jitted per-step program, cached by (num_steps, phase): _make_step
-        bakes the scheduler tables at trace time, so a different step count
-        MUST get a fresh program (same convention as DenoiseRunner's
-        ("stepwise", num_steps))."""
+    def _ensure_stepper(self, num_steps: int, sync: bool,
+                        shallow: bool = False):
+        """Jitted per-step program, cached by (num_steps, phase, shallow):
+        _make_step bakes the scheduler tables at trace time, so a different
+        step count MUST get a fresh program (same convention as
+        DenoiseRunner's ("stepwise", num_steps))."""
         fns = self._compiled.setdefault(("stepwise", num_steps), {})
-        if sync not in fns:
-            fns[sync] = jax.jit(self._make_stepper(sync), donate_argnums=(3,))
-        return fns[sync]
+        fkey = (sync, shallow)
+        if fkey not in fns:
+            fns[fkey] = jax.jit(self._make_stepper(sync, shallow),
+                                donate_argnums=(3,))
+        return fns[fkey]
 
     def _ensure_stale_scan(self, num_steps: int):
         """Hybrid mode's fused stale-only program for the default execution
@@ -498,9 +599,14 @@ class MMDiTDenoiseRunner:
         sstate = sched.init_state(x.shape)
         kv = self._kv0_global(latents.shape[0])
         pooled = jnp.asarray(pooled)
+        sc = cfg.step_cache_enabled
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
         for i in range(start_step, num_exec_end):
-            sync = i < start_step + n_sync
-            x, sstate, kv = self._ensure_stepper(num_steps, sync)(
+            sync = one_phase or i < start_step + n_sync
+            shallow = sc and is_shallow_at(
+                i, start_step + n_sync, cfg.step_cache_interval
+            )
+            x, sstate, kv = self._ensure_stepper(num_steps, sync, shallow)(
                 self.params, jnp.asarray(i), x, kv, sstate, enc, pooled, gs,
             )
             if callback is not None:
@@ -630,8 +736,15 @@ class MMDiTDenoiseRunner:
         n = cfg.n_device_per_batch
         layout = cfg.attn_impl
         if not cfg.is_sp:
-            return {"layout": layout, "kv_state_elems": 0,
-                    "per_step_collective_elems": 0}
+            report = {"layout": layout, "kv_state_elems": 0,
+                      "per_step_collective_elems": 0}
+            if cfg.step_cache_enabled:
+                report["step_cache"] = {
+                    "interval": cfg.step_cache_interval,
+                    "depth": cfg.step_cache_depth,
+                    "shallow_per_step_collective_elems": 0,
+                }
+            return report
         n_br_local = (
             1 if cfg.cfg_split or not cfg.do_classifier_free_guidance else 2
         )
@@ -650,8 +763,22 @@ class MMDiTDenoiseRunner:
         else:
             state = n_attn * 2 * b * n_tok * hid
             per_step = n_attn * 2 * b * n_tok * hid + out_gather
-        return {"layout": layout, "kv_state_elems": int(state),
-                "per_step_collective_elems": int(per_step)}
+        report = {"layout": layout, "kv_state_elems": int(state),
+                  "per_step_collective_elems": int(per_step)}
+        if cfg.step_cache_enabled:
+            # shallow steps run d_keep of depth joint blocks (the dual
+            # prefix always runs — the cut sits past it); the output gather
+            # always runs
+            d_keep = mcfg.depth - cfg.step_cache_depth
+            n_attn_sh = d_keep + mcfg.dual_attention_blocks
+            shallow = ((per_step - out_gather) * n_attn_sh // n_attn
+                       + out_gather)
+            report["step_cache"] = {
+                "interval": cfg.step_cache_interval,
+                "depth": cfg.step_cache_depth,
+                "shallow_per_step_collective_elems": int(shallow),
+            }
+        return report
 
     def generate(self, latents, enc, pooled, guidance_scale=5.0,
                  num_inference_steps=20, start_step=0, end_step=None,
@@ -678,9 +805,11 @@ class MMDiTDenoiseRunner:
         if callback is not None:
             from ..utils.compat import SUPPORTS_FUSED_CALLBACK
 
-            if not SUPPORTS_FUSED_CALLBACK:
+            if not SUPPORTS_FUSED_CALLBACK or self.cfg.step_cache_enabled:
                 # this jaxlib aborts compiling the ordered-io_callback
-                # program (utils/compat.py) — host-driven loop instead
+                # program (utils/compat.py) — host-driven loop instead.
+                # Step-cache callbacks also take the host loop: the
+                # stepwise steppers replay the exact cadence.
                 return self._generate_stepwise(
                     jnp.asarray(latents), enc, pooled, gs,
                     num_inference_steps, start_step, end_step, callback,

@@ -47,8 +47,15 @@ from ..models.unet import (
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import gather_cols, gather_rows
-from .context import KIND_REGISTRY, PHASE_STALE, PHASE_SYNC, PatchContext
+from .context import (
+    CARRIED_REGISTRY,
+    KIND_REGISTRY,
+    PHASE_STALE,
+    PHASE_SYNC,
+    PatchContext,
+)
 from .guidance import branch_select, combine_guidance
+from .stepcache import STEPCACHE_KEY, is_shallow_at, run_cadence
 
 
 def _check_geometry(cfg: DistriConfig, ucfg: UNetConfig) -> None:
@@ -115,6 +122,15 @@ class DenoiseRunner:
                 "the all-to-all head shard does not apply — use 'gather' or "
                 "'ring' here"
             )
+        n_levels = len(unet_config.block_out_channels)
+        if distri_config.step_cache_enabled and not (
+            1 <= distri_config.step_cache_depth < n_levels
+        ):
+            raise ValueError(
+                f"step_cache_depth={distri_config.step_cache_depth} must be "
+                f"in [1, {n_levels - 1}] for this {n_levels}-level UNet "
+                "(at least one level must stay shallow)"
+            )
         _check_geometry(distri_config, unet_config)
         self._compiled: Dict[Any, Any] = {}
         self._builds = 0  # fused-loop builds (cache_info observability)
@@ -132,9 +148,12 @@ class DenoiseRunner:
         the batch dim (single-device CFG, reference world_size==1 path)."""
         return branch_select(self.cfg, enc, added)
 
-    def _unet_local(self, params, x_in, t, my_enc, my_added, text_kv, phase, pstate):
+    def _unet_local(self, params, x_in, t, my_enc, my_added, text_kv, phase,
+                    pstate, shallow=False):
         """One UNet evaluation on this device; returns (full-latent output
-        for this branch-batch, new patch state)."""
+        for this branch-batch, new patch state).  ``shallow`` (step-cache
+        cadence) skips the deep subtree and substitutes the carried deep
+        feature; a non-shallow call with the cache enabled re-emits it."""
         cfg, ucfg = self.cfg, self.ucfg
         if cfg.parallelism == "patch":
             ctx = PatchContext(
@@ -146,11 +165,27 @@ class DenoiseRunner:
                 state_in=pstate,
                 text_kv=text_kv,
             )
-            out_local = unet_forward(
-                params, ucfg, x_in, t, my_enc,
-                dispatch=PatchDispatch(ctx), added_cond=my_added,
-            )
+            cd = cfg.step_cache_depth if cfg.step_cache_enabled else 0
+            if cd:
+                out_local, deep = unet_forward(
+                    params, ucfg, x_in, t, my_enc,
+                    dispatch=PatchDispatch(ctx), added_cond=my_added,
+                    cache_depth=cd,
+                    deep_cache=ctx.stale(STEPCACHE_KEY) if shallow else None,
+                )
+                if deep is not None:  # full step: refresh the temporal cache
+                    ctx.emit(STEPCACHE_KEY, deep, kind="stepcache")
+            else:
+                out_local = unet_forward(
+                    params, ucfg, x_in, t, my_enc,
+                    dispatch=PatchDispatch(ctx), added_cond=my_added,
+                )
             ctx.flush()  # batched refresh exchange (no-op unless comm_batch)
+            if cd:
+                # skipped layers' buffers (and, on shallow steps, the deep
+                # cache) ride the carry untouched: the full/shallow bodies
+                # must return one pytree structure
+                ctx.carry_unconsumed()
             out = gather_rows(out_local) if cfg.is_sp else out_local
             new_state = ctx.state_out if ctx.state_out else pstate
             return out, new_state
@@ -202,7 +237,7 @@ class DenoiseRunner:
     def _cfg_combine(self, out, gs, batch):
         return combine_guidance(self.cfg, out, gs, batch)
 
-    def _make_step(self, phase):
+    def _make_step(self, phase, shallow=False):
         sched = self.scheduler
 
         def step(params, i, x, pstate, sstate, my_enc, my_added, text_kv, gs):
@@ -215,7 +250,8 @@ class DenoiseRunner:
             if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate":
                 pstate = {"step": i}
             out, new_pstate = self._unet_local(
-                params, x_in, t, my_enc, my_added, text_kv, phase, pstate
+                params, x_in, t, my_enc, my_added, text_kv, phase, pstate,
+                shallow=shallow,
             )
             guided = self._cfg_combine(out, gs, batch)
             x_next, sstate = sched.step(x, guided.astype(jnp.float32), i, sstate)
@@ -266,6 +302,42 @@ class DenoiseRunner:
                 my_enc, my_added, text_kv, gs,
             )
             return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), pshape)
+
+        if cfg.step_cache_enabled:
+            # Temporal step-cache cadence (parallel/stepcache.py): full sync
+            # warmup, then super-steps of (interval-1) shallow + 1 full —
+            # exactly two step bodies composed into the scan, the same
+            # full-program shape as the sync/stale pair.  In one-phase
+            # configs (full_sync / single-device patch) both cadence bodies
+            # run the sync phase; the temporal deep reuse applies either way.
+            one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+            step_full = step_sync if one_phase else step_stale
+            step_shallow = self._make_step(
+                PHASE_SYNC if one_phase else PHASE_STALE, shallow=True
+            )
+            interval = cfg.step_cache_interval
+            n_sync = min(cfg.warmup_steps + 1, num_steps - start_step)
+
+            def warm_body(i, carry):
+                x, ps, ss = carry
+                return step_sync(params, i, x, ps, ss, my_enc, my_added,
+                                 text_kv, gs)
+
+            x, pstate, sstate = lax.fori_loop(
+                start_step, start_step + n_sync, warm_body,
+                (x, state_zeros(None), sstate)
+            )
+            s0 = start_step + n_sync
+
+            def run_step(carry, i, shallow):
+                x, ps, ss = carry
+                fn = step_shallow if shallow else step_full
+                return fn(params, i, x, ps, ss, my_enc, my_added, text_kv,
+                          gs)
+
+            x, _, _ = run_cadence((x, pstate, sstate), s0, num_steps - s0,
+                                  interval, run_step)
+            return x
 
         if cfg.parallelism != "patch" or cfg.mode == "full_sync" or not cfg.is_sp:
             # one phase for everything: naive_patch / tensor / full_sync —
@@ -449,7 +521,7 @@ class DenoiseRunner:
 
         fns = self._compiled.setdefault(("stepwise", num_steps), {})
         for i in range(n_sync):
-            fkey = (PHASE_SYNC, pstate is not None)
+            fkey = (PHASE_SYNC, pstate is not None, False)
             if fkey not in fns:
                 fns[fkey] = self._build_stepwise(PHASE_SYNC, pstate is not None)
             x, pstate, sstate = fns[fkey](
@@ -465,7 +537,7 @@ class DenoiseRunner:
     # per-step (uncompiled-loop) mode: the reference's --no_cuda_graph
     # ------------------------------------------------------------------
 
-    def _make_stepper(self, phase, with_state: bool):
+    def _make_stepper(self, phase, with_state: bool, shallow: bool = False):
         """Un-jitted shard_map'd single step with the global-array signature.
 
         The patch state crosses the shard_map boundary here, so its leaves are
@@ -489,7 +561,7 @@ class DenoiseRunner:
             text_kv = (
                 {} if cfg.parallelism == "tensor" else precompute_text_kv(params, my_enc)
             )
-            step = self._make_step(phase)
+            step = self._make_step(phase, shallow=shallow)
             return step(params, i, x, pstate, sstate, my_enc, my_added, text_kv, gs)
 
         lat_spec = P(DP_AXIS)
@@ -518,9 +590,9 @@ class DenoiseRunner:
         donate = (3,) if with_state and cfg.parallelism == "patch" else ()
         return stepper, donate
 
-    def _build_stepwise(self, phase, with_state: bool):
+    def _build_stepwise(self, phase, with_state: bool, shallow: bool = False):
         """One jitted denoising step driven from Python."""
-        stepper, donate = self._make_stepper(phase, with_state)
+        stepper, donate = self._make_stepper(phase, with_state, shallow)
         return jax.jit(stepper, donate_argnums=donate)
 
     def _stepwise_state_seed(self):
@@ -621,9 +693,10 @@ class DenoiseRunner:
         x = jnp.asarray(latents, jnp.float32)
         sstate = self.scheduler.init_state(x.shape)
         pstate: Any = self._stepwise_state_seed()
+        sc = cfg.step_cache_enabled
         one_phase = (cfg.parallelism != "patch" or cfg.mode == "full_sync"
                      or not cfg.is_sp)
-        n_sync = (num_exec_end - start_step if one_phase
+        n_sync = (num_exec_end - start_step if one_phase and not sc
                   else min(cfg.warmup_steps + 1, num_exec_end - start_step))
 
         key = ("stepwise", num_steps)
@@ -631,11 +704,16 @@ class DenoiseRunner:
             self._compiled[key] = {}
         fns = self._compiled[key]
         for i in range(start_step, num_exec_end):
-            phase = PHASE_SYNC if i < start_step + n_sync else PHASE_STALE
+            phase = (PHASE_SYNC if one_phase or i < start_step + n_sync
+                     else PHASE_STALE)
+            # the same shallow-first pattern run_cadence compiles
+            shallow = sc and is_shallow_at(
+                i, start_step + n_sync, cfg.step_cache_interval
+            )
             with_state = pstate is not None
-            fkey = (phase, with_state)
+            fkey = (phase, with_state, shallow)
             if fkey not in fns:
-                fns[fkey] = self._build_stepwise(phase, with_state)
+                fns[fkey] = self._build_stepwise(phase, with_state, shallow)
             x, pstate, sstate = fns[fkey](
                 self.params, jnp.asarray(i), x, pstate, sstate, enc, added, gs
             )
@@ -647,15 +725,27 @@ class DenoiseRunner:
     # observability
     # ------------------------------------------------------------------
 
-    def comm_volume_report(self, batch_size: int = None, text_len: int = 77):
+    def comm_volume_report(self, batch_size: int = None, text_len: int = 77,
+                           *, per_phase: bool = False):
         """Per-layer-type stale-buffer element counts.
 
         Parity with the reference's verbose buffer stats at create_buffer
         time (utils.py:152-158): reports how many elements per device the
         displaced-patch state holds, grouped by layer type.  Computed with
         jax.eval_shape — no device work.
+
+        ``per_phase=True`` returns the step-cache-aware breakdown instead:
+        ``{"phases": {"sync"|"stale"|"shallow": {kind: fresh-exchange
+        elements}}, "flops": {...}}`` — per phase, only the state a step
+        FRESHLY exchanges is counted (carried-through deep buffers are
+        excluded via CARRIED_REGISTRY), and ``flops`` estimates the
+        full-vs-shallow step cost via XLA cost analysis
+        (``_flop_estimate``), so the cache's compute and comm savings are
+        inspectable without a chip.
         """
         cfg = self.cfg
+        if per_phase:
+            return self._comm_volume_per_phase(batch_size, text_len)
         if cfg.parallelism != "patch" or not cfg.is_sp:
             return {}
         self.scheduler.set_timesteps(2)
@@ -702,6 +792,112 @@ class DenoiseRunner:
             for t, numel in sorted(report.items()):
                 print(f"  {t}: {numel / 1e6:.3f}M elements")
         return report
+
+    def _comm_volume_per_phase(self, batch_size: int = None,
+                               text_len: int = 77) -> Dict[str, Any]:
+        """Step-cache-aware comm/compute breakdown (comm_volume_report
+        per_phase=True).  Each phase is traced with jax.eval_shape through
+        the same step closures the loops run; a phase's count is the
+        elements it freshly exchanges (state it merely carries — skipped
+        deep layers, the deep cache on shallow steps — is subtracted via
+        CARRIED_REGISTRY)."""
+        cfg = self.cfg
+        if cfg.parallelism != "patch":
+            return {"phases": {}, "flops": None}
+        self.scheduler.set_timesteps(2)
+        lat, enc, added, gs = self._abstract_inputs(
+            batch_size, text_len, per_group=True
+        )
+
+        def trace(step, pstate_in):
+            has_state = pstate_in is not None
+
+            def one_step(params, latents, enc, added, gs, *maybe_state):
+                my_enc, my_added, _ = self._branch_inputs(enc, added)
+                text_kv = precompute_text_kv(params, my_enc)
+                sstate = self.scheduler.init_state(latents.shape)
+                _, pout, _ = step(
+                    params, 1, latents.astype(jnp.float32),
+                    maybe_state[0] if has_state else None, sstate,
+                    my_enc, my_added, text_kv, gs,
+                )
+                return pout
+
+            args = (self.params, lat, enc, added, gs)
+            specs = (self.param_specs, P(), P(), P(), P())
+            if has_state:
+                args += (pstate_in,)
+                specs += (P(),)
+            CARRIED_REGISTRY.clear()
+            shapes = jax.eval_shape(
+                lambda *a: shard_map(
+                    one_step, mesh=cfg.mesh, in_specs=specs,
+                    out_specs=P(), check_vma=False,
+                )(*a),
+                *args,
+            )
+            carried = set(CARRIED_REGISTRY)
+            if shapes is None:  # stateless step (single device, cache off)
+                shapes = {}
+            report: Dict[str, int] = {}
+            for name, s in shapes.items():
+                if name in carried:
+                    continue
+                t = KIND_REGISTRY.get(name, "other")
+                report[t] = report.get(t, 0) + int(np.prod(s.shape))
+            return shapes, report
+
+        phases: Dict[str, Dict[str, int]] = {}
+        sync_shapes, phases["sync"] = trace(self._make_step(PHASE_SYNC), None)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        if not one_phase:
+            _, phases["stale"] = trace(
+                self._make_step(PHASE_STALE), sync_shapes
+            )
+        if cfg.step_cache_enabled:
+            steady = PHASE_SYNC if one_phase else PHASE_STALE
+            _, phases["shallow"] = trace(
+                self._make_step(steady, shallow=True), sync_shapes
+            )
+        return {"phases": phases, "flops": self._flop_estimate(batch_size,
+                                                               text_len)}
+
+    def _flop_estimate(self, batch_size: int = None,
+                       text_len: int = 77) -> Optional[Dict[str, float]]:
+        """{"full", "shallow", "shallow_ratio"}: estimated FLOPs of one
+        steady-state denoise step vs its shallow-cadence counterpart, from
+        XLA cost analysis of the lowered per-step programs (abstract inputs
+        — no execution, no chip).  None when the cache is off or the
+        backend's cost model is unavailable."""
+        cfg = self.cfg
+        if not cfg.step_cache_enabled:
+            return None
+        lat, enc, added, gs = self._abstract_inputs(batch_size, text_len)
+        self.scheduler.set_timesteps(2)
+        sstate = self.scheduler.init_state(lat.shape)
+        seed_step, _ = self._make_stepper(PHASE_SYNC, False)
+        _, pshape, _ = jax.eval_shape(
+            seed_step, self.params, jnp.asarray(1), lat, None, sstate, enc,
+            added, gs,
+        )
+        steady = (PHASE_SYNC if cfg.mode == "full_sync" or not cfg.is_sp
+                  else PHASE_STALE)
+        out: Dict[str, float] = {}
+        for name, shallow in (("full", False), ("shallow", True)):
+            stepper, _ = self._make_stepper(steady, True, shallow)
+            try:
+                ca = jax.jit(stepper).lower(
+                    self.params, jnp.asarray(1), lat, pshape, sstate, enc,
+                    added, gs,
+                ).cost_analysis()
+                if not isinstance(ca, dict):  # older API: list per device
+                    ca = ca[0]
+                out[name] = float(ca["flops"])
+            except Exception:
+                return None
+        if out["full"] > 0:
+            out["shallow_ratio"] = out["shallow"] / out["full"]
+        return out
 
     # ------------------------------------------------------------------
     # public API
@@ -811,9 +1007,12 @@ class DenoiseRunner:
         if callback is not None and self.cfg.use_compiled_step:
             from ..utils.compat import SUPPORTS_FUSED_CALLBACK
 
-            if not SUPPORTS_FUSED_CALLBACK:
+            if not SUPPORTS_FUSED_CALLBACK or self.cfg.step_cache_enabled:
                 # this jaxlib aborts compiling the ordered-io_callback
-                # program (utils/compat.py) — host-driven loop instead
+                # program (utils/compat.py) — host-driven loop instead.
+                # Step-cache runs also take the host loop when a callback is
+                # requested: the stepwise steppers replay the exact cadence
+                # without teaching the io_callback program a third body.
                 return self._generate_stepwise(
                     jnp.asarray(latents), prompt_embeds, added,
                     jnp.asarray(guidance_scale, jnp.float32),

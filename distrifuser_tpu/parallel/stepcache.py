@@ -1,0 +1,121 @@
+"""Temporal step-cache cadence: shared bookkeeping for the full/shallow loop.
+
+DistriFusion exploits *spatial* redundancy (stale patch context); the step
+cache exploits the matching *temporal* redundancy: adjacent denoising steps
+produce near-identical deep activations (PipeFusion, arXiv 2405.14430;
+partially conditioned patch parallelism, arXiv 2412.02962 shows partial /
+stale context replaces full recomputation with negligible quality loss).
+With ``step_cache_interval = I`` and ``step_cache_depth = K`` (DistriConfig),
+the post-warmup denoise loop runs a static cadence of **super-steps**:
+
+    [ shallow x (I-1), full x 1 ] [ shallow x (I-1), full x 1 ] ... tail
+
+* a **full** step runs every block of the network and stashes the deep
+  subtree's output (UNet: the feature entering the first shallow up block;
+  DiT/MMDiT: the residual delta added by the deepest K transformer blocks)
+  into the functional carry state, alongside the displaced-patch buffers;
+* a **shallow** step executes only the shallow layers and substitutes the
+  carried deep feature — and, because a skipped layer emits nothing, its
+  stale-refresh halo/KV collectives vanish from the shallow body too
+  (verifiable with utils/overlap.py on the compiled HLO).
+
+The cadence is *shallow-first* within each super-step: every warmup (sync)
+step is itself a full run that refreshes the deep cache, so the first
+post-warmup step may already reuse it.  The tail (``rest % I`` steps) stays
+shallow — its staleness is bounded by the same interval.
+
+The cadence is static per compilation: the compiled program carries exactly
+two step bodies (full + shallow) composed into the scan the same way the
+sync/stale pair already is in parallel/runner.py, dit_sp.py and mmdit_sp.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+# Name of the deep-feature entry in the UNet's patch-state carry.  Lives in
+# the same pytree as the displaced halo/KV/moment buffers (parallel/context
+# semantics); the emitting runner tags it kind="stepcache" in KIND_REGISTRY.
+STEPCACHE_KEY = "stepcache.deep"
+
+
+def cadence_split(rest: int, interval: int) -> Tuple[int, int]:
+    """(n_super, tail) for ``rest`` post-warmup steps: ``n_super`` complete
+    super-steps of ``interval`` steps each, then ``tail`` (< interval)
+    trailing shallow steps."""
+    if interval < 2:
+        raise ValueError(f"step-cache interval must be >= 2, got {interval}")
+    return divmod(rest, interval)
+
+
+def is_shallow_step(k: int, interval: int) -> bool:
+    """Is post-warmup step ``k`` (0-based) a shallow step?  Shallow-first:
+    positions 0..interval-2 of each super-step are shallow, the last is the
+    full refresh.  The single source of truth shared by the fused loop
+    (which unrolls one super-step per scan iteration) and the host-driven
+    stepwise loop (which classifies step by step)."""
+    return (k % interval) < interval - 1
+
+
+def is_shallow_at(i: int, cadence_start: int, interval: int) -> bool:
+    """Is absolute step index ``i`` shallow, with the cadence starting at
+    ``cadence_start`` (the first post-warmup step)?  False during warmup and
+    with the cache off — the host-driven stepwise loops classify each step
+    through this so they replay exactly what run_cadence compiles."""
+    return (interval > 1 and i >= cadence_start
+            and is_shallow_step(i - cadence_start, interval))
+
+
+def run_cadence(
+    carry: Any,
+    s0: int,
+    n_rest: int,
+    interval: int,
+    run_step: Callable[[Any, Any, bool], Any],
+):
+    """Execute the post-warmup cadence over ``n_rest`` steps starting at
+    absolute index ``s0``: one ``lax.scan`` over the complete super-steps —
+    each (interval-1) shallow steps in a nested ``fori_loop`` + 1 full step,
+    so the compiled program carries ONE shallow body and ONE full body
+    regardless of interval (XLA inlines the trip-count-1 inner loop at
+    interval 2) — then the (< interval) trailing shallow steps as another
+    fori.  ``run_step(carry, i, shallow) -> carry`` is the runner's step
+    closure; the one home for the loop shape shared by the UNet/DiT/MMDiT
+    fused loops."""
+    n_super, tail = cadence_split(n_rest, interval)
+
+    def shallow_loop(carry, start, stop):
+        return lax.fori_loop(
+            start, stop, lambda i, c: run_step(c, i, True), carry
+        )
+
+    def super_body(carry, i0):
+        carry = shallow_loop(carry, i0, i0 + interval - 1)
+        return run_step(carry, i0 + interval - 1, False), None
+
+    if n_super:
+        carry, _ = lax.scan(
+            super_body, carry, s0 + interval * jnp.arange(n_super)
+        )
+    if tail:
+        t0 = s0 + n_super * interval
+        carry = shallow_loop(carry, t0, t0 + tail)
+    return carry
+
+
+def shallow_step_count(num_steps: int, warmup_steps: int, interval: int) -> int:
+    """How many of ``num_steps`` denoise steps run shallow under the cadence
+    (0 when the cache is off, i.e. interval <= 1).
+
+    Steps 0..min(warmup_steps, num_steps-1) are synchronous full runs; the
+    remaining ``rest`` follow the shallow-first cadence, so
+    ``rest - rest // interval`` of them are shallow.  Used by the serve
+    layer's shallow-step-share metrics and the bench report."""
+    if interval <= 1 or num_steps <= 0:
+        return 0
+    n_sync = min(warmup_steps + 1, num_steps)
+    rest = num_steps - n_sync
+    return rest - rest // interval

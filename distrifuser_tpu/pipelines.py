@@ -397,6 +397,27 @@ class _GenerationMixin:
     batched entry.  Requires ``distri_config``, ``vae_config``,
     ``vae_params``, and ``_decode`` on the instance."""
 
+    def step_cache_plan(self, num_inference_steps: int) -> dict:
+        """How the temporal step-cache cadence (docs/PERF.md) plays out over
+        a run of ``num_inference_steps``: the serve executors read this for
+        the shallow-step-share metrics, and it doubles as a user-facing
+        what-will-actually-run probe."""
+        from .parallel.stepcache import shallow_step_count
+
+        cfg = self.distri_config
+        shallow = (
+            shallow_step_count(num_inference_steps, cfg.warmup_steps,
+                               cfg.step_cache_interval)
+            if cfg.step_cache_enabled else 0
+        )
+        return {
+            "enabled": cfg.step_cache_enabled,
+            "interval": cfg.step_cache_interval,
+            "depth": cfg.step_cache_depth,
+            "total_steps": num_inference_steps,
+            "shallow_steps": shallow,
+        }
+
     def _finalize(self, latent, output_type, tokenizers,
                   shift: float = 0.0) -> "PipelineOutput":
         """latent -> PipelineOutput for 'latent' | 'np' | 'pil'.  ``shift``

@@ -35,7 +35,10 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 class ExecKey:
     """Identity of one compiled executor.  ``mesh_plan`` is
     `DistriConfig.mesh_plan` — the same bucket on a different mesh layout is
-    a different XLA program."""
+    a different XLA program.  The step-cache cadence knobs
+    (``step_cache_interval``/``step_cache_depth``, DistriConfig) are compile
+    fields too: the cadence is static per compilation, so two requests
+    differing only in cadence must not share an executor."""
 
     model_id: str
     scheduler: str
@@ -44,11 +47,15 @@ class ExecKey:
     steps: int
     cfg: bool
     mesh_plan: str
+    step_cache_interval: int = 1
+    step_cache_depth: int = 0
 
     def short(self) -> str:
         g = "cfg" if self.cfg else "nocfg"
+        sc = (f":sc{self.step_cache_interval}x{self.step_cache_depth}"
+              if self.step_cache_interval > 1 else "")
         return (f"{self.model_id}:{self.height}x{self.width}"
-                f"@{self.steps}st:{g}:{self.mesh_plan}")
+                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}")
 
 
 class ExecutorCache:

@@ -33,6 +33,10 @@ class PipelineExecutor:
         self.pipeline = pipeline
         self.steps = steps
         self.batch_size = pipeline.distri_config.batch_size
+        # per-invocation shallow-step count under the step-cache cadence
+        # (0 with the cache off) — the server's shallow-share metrics read
+        # this off every executor it dispatches to
+        self.shallow_steps = pipeline.step_cache_plan(steps)["shallow_steps"]
 
     def _in_channels(self) -> int:
         pipe = self.pipeline

@@ -151,6 +151,8 @@ class InferenceServer:
             steps=steps,
             cfg=cfg,
             mesh_plan=self.mesh_plan,
+            step_cache_interval=self.config.step_cache_interval,
+            step_cache_depth=self.config.step_cache_depth,
         )
 
     # -- submission (any thread) ------------------------------------------
@@ -280,6 +282,12 @@ class InferenceServer:
                 f"{len(batch)}"
             )
         exec_s = t1 - t0
+        # shallow-step share: how much of the mesh time the step cache
+        # saved from full network evaluations (0 when the cache is off)
+        self.counters.inc("denoise_steps_total", key.steps * len(batch))
+        shallow = int(getattr(executor, "shallow_steps", 0))
+        if shallow:
+            self.counters.inc("denoise_steps_shallow", shallow * len(batch))
         for req, out in zip(batch, outputs):
             queue_wait = dispatch_ts - req.enqueue_ts
             e2e = t1 - req.enqueue_ts
@@ -307,6 +315,9 @@ class InferenceServer:
         sizes = self._batch_sizes.snapshot()
         n_batches = sum(sizes.values())
         n_reqs = sum(int(k.split("_")[1]) * v for k, v in sizes.items())
+        reqs = self.counters.snapshot()
+        steps_total = reqs.get("denoise_steps_total", 0)
+        steps_shallow = reqs.get("denoise_steps_shallow", 0)
         return {
             "model_id": self.model_id,
             "scheduler": self.scheduler,
@@ -318,7 +329,15 @@ class InferenceServer:
                 "cache_capacity": self.config.cache_capacity,
                 "buckets": [list(b) for b in self.batcher.table.buckets],
             },
-            "requests": self.counters.snapshot(),
+            "requests": reqs,
+            "step_cache": {
+                "interval": self.config.step_cache_interval,
+                "depth": self.config.step_cache_depth,
+                "steps_total": steps_total,
+                "steps_shallow": steps_shallow,
+                "shallow_share": (steps_shallow / steps_total
+                                  if steps_total else 0.0),
+            },
             "latency_s": {
                 "queue_wait": self.hist_queue_wait.snapshot(),
                 "execute": self.hist_execute.snapshot(),

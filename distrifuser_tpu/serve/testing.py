@@ -44,6 +44,13 @@ class FakeExecutor:
         self.batch_size = batch_size
         self.step_time_s = step_time_s
         self.batch_sizes: List[int] = []
+        # mirror PipelineExecutor's shallow-step accounting from the key's
+        # cadence so fake-backed servers exercise the share metrics too
+        from ..parallel.stepcache import shallow_step_count
+
+        self.shallow_steps = shallow_step_count(
+            key.steps, warmup_steps=0, interval=key.step_cache_interval
+        )
 
     def __call__(self, prompts: List[str], negative_prompts: List[str],
                  guidance_scale: float, seeds: List[int]) -> List[Any]:

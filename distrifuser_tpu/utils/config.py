@@ -59,6 +59,23 @@ PARALLELISMS = ("patch", "tensor", "naive_patch", "pipefusion")
 SPLIT_SCHEMES = ("row", "col", "alternate")
 
 
+def validate_step_cache_knobs(interval: int, depth: int) -> None:
+    """The step-cache knob pairing contract, shared by DistriConfig and
+    ServeConfig so the serve layer rejects a bad cadence at config time
+    with the same rule the pipeline builder will enforce."""
+    if interval < 1:
+        raise ValueError(f"step_cache_interval must be >= 1, got {interval}")
+    if depth < 0:
+        raise ValueError(f"step_cache_depth must be >= 0, got {depth}")
+    if (interval > 1) != (depth > 0):
+        raise ValueError(
+            "step-cache needs BOTH knobs: step_cache_interval >= 2 picks "
+            "the full/shallow cadence and step_cache_depth >= 1 picks how "
+            f"deep the shallow steps cut (got interval={interval}, "
+            f"depth={depth})"
+        )
+
+
 def init_multihost(**kwargs: Any) -> None:
     """Multi-host bootstrap: the TPU analog of `torchrun` + NCCL rendezvous.
 
@@ -131,6 +148,16 @@ class DistriConfig:
     # compile service is slow.  Per-step dispatch overhead applies only to
     # the warmup steps.
     hybrid_loop: bool = False
+    # Temporal step-cache (parallel/stepcache.py): after warmup, run only
+    # one FULL network evaluation every `step_cache_interval` steps; the
+    # other steps execute just the shallow layers and reuse the carried
+    # deep-block output (UNet: mid + deepest `step_cache_depth` levels;
+    # DiT/MMDiT: the deepest `step_cache_depth` transformer blocks).  Off by
+    # default (interval=1, depth=0); enable BOTH knobs together.  The
+    # cadence is static per compilation — two requests differing only in
+    # cadence run different XLA programs (serve keys them separately).
+    step_cache_interval: int = 1
+    step_cache_depth: int = 0
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)
@@ -176,6 +203,22 @@ class DistriConfig:
         if self.height % 8 != 0 or self.width % 8 != 0:
             # Same constraint as the reference pipelines (pipelines.py:71).
             raise ValueError("height and width must be multiples of 8")
+        validate_step_cache_knobs(self.step_cache_interval,
+                                  self.step_cache_depth)
+        if self.step_cache_enabled:
+            if self.parallelism != "patch":
+                raise ValueError(
+                    "step-cache rides the displaced-patch carry state "
+                    f"(parallelism='patch'); {self.parallelism!r} has no "
+                    "cross-step activation carry to stash the deep cache in"
+                )
+            if self.hybrid_loop:
+                raise ValueError(
+                    "step-cache and hybrid_loop are mutually exclusive: the "
+                    "cadence adds a second (shallow) body to the steady-state "
+                    "scan, defeating hybrid's one-body compile-time rationale "
+                    "— use the fully fused loop with the step cache"
+                )
 
         if self.devices is None:
             try:
@@ -256,6 +299,11 @@ class DistriConfig:
         """TPU-native alias for ``use_cuda_graph``: run the denoise loop as a
         single compiled program rather than per-step dispatch."""
         return self.use_cuda_graph
+
+    @property
+    def step_cache_enabled(self) -> bool:
+        """Temporal step-cache cadence active? (parallel/stepcache.py)."""
+        return self.step_cache_interval > 1 and self.step_cache_depth > 0
 
     @property
     def group_size(self) -> int:
@@ -374,6 +422,13 @@ class ServeConfig:
     warmup_buckets: Sequence[Sequence[int]] = ()
     warmup_cfg: bool = True
     default_steps: int = 50
+    # Service-wide step-cache cadence (DistriConfig.step_cache_* semantics):
+    # threaded into every ExecKey so a cadence change invalidates compiled
+    # executors, and surfaced as the shallow-step share in serve metrics.
+    # The pipeline builder behind executor_factory must construct its
+    # DistriConfig with the same knobs.
+    step_cache_interval: int = 1
+    step_cache_depth: int = 0
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -396,6 +451,8 @@ class ServeConfig:
             raise ValueError(
                 f"cache_capacity must be >= 1, got {self.cache_capacity}"
             )
+        validate_step_cache_knobs(self.step_cache_interval,
+                                  self.step_cache_depth)
         # BucketTable owns bucket validation and the area-major ordering
         # invariant ("smallest covering bucket" scans front-to-back) — one
         # normalization, not a copy here that could drift.  Lazy import:

@@ -1,0 +1,119 @@
+"""Temporal step-cache micro-bench: steps/sec cache-off vs cache-on.
+
+Tiny-config CPU-runnable probe of the step cache's compute win
+(parallel/stepcache.py): build two otherwise-identical single-device
+displaced-patch UNet runners — one with the cadence off, one with
+``step_cache_interval x step_cache_depth`` on — run the fused denoise loop
+at identical shapes, and emit ONE JSON line with both steps/sec numbers,
+the speedup, and the runner's own shallow-vs-full FLOP estimate
+(`DenoiseRunner._flop_estimate`, XLA cost analysis — no chip needed).
+
+Random weights: latency is weight-independent.  Timing discipline matches
+bench.py: the compile pass runs outside the timed window, and every timed
+repeat ends in a `jax.device_get` data dependency so async dispatch cannot
+escape the clock.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_stepcache.py \
+        [--steps 16] [--interval 2] [--depth 1] [--repeats 3] [--out FILE]
+
+The tier-1 workflow runs this and uploads the line as an artifact, so the
+bench trajectory records a compute-side number per PR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--interval", type=int, default=2)
+    ap.add_argument("--depth", type=int, default=1)
+    ap.add_argument("--height", type=int, default=128)
+    ap.add_argument("--width", type=int, default=128)
+    ap.add_argument("--warmup_steps", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also append the JSON line to this file")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.parallel.runner import DenoiseRunner
+    from distrifuser_tpu.parallel.stepcache import shallow_step_count
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    ucfg = tiny_config(sdxl=False)
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+
+    def build(**cache_kw):
+        cfg = DistriConfig(
+            devices=jax.devices()[:1], height=args.height, width=args.width,
+            warmup_steps=args.warmup_steps, parallelism="patch", **cache_kw,
+        )
+        return DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim")), cfg
+
+    runner_off, cfg = build()
+    runner_on, _ = build(step_cache_interval=args.interval,
+                         step_cache_depth=args.depth)
+
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (1, cfg.latent_height, cfg.latent_width, ucfg.in_channels)
+    )
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 77, ucfg.cross_attention_dim)
+    )
+
+    def steps_per_s(runner):
+        gen = lambda: jax.device_get(  # noqa: E731 — data dependency ends the clock
+            runner.generate(lat, enc, num_inference_steps=args.steps)
+        )
+        gen()  # compile outside the timed window
+        best = min(
+            (lambda t0: (gen(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            )
+            for _ in range(args.repeats)
+        )
+        return args.steps / best
+
+    off = steps_per_s(runner_off)
+    on = steps_per_s(runner_on)
+    line = {
+        "bench": "stepcache",
+        "backend": jax.default_backend(),
+        "steps": args.steps,
+        "warmup_steps": args.warmup_steps,
+        "interval": args.interval,
+        "depth": args.depth,
+        "shallow_steps": shallow_step_count(
+            args.steps, args.warmup_steps, args.interval
+        ),
+        "height": args.height,
+        "width": args.width,
+        "steps_per_s_off": round(off, 3),
+        "steps_per_s_on": round(on, 3),
+        "speedup": round(on / off, 3),
+        "flops": runner_on._flop_estimate(),
+    }
+    print(json.dumps(line), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+
+if __name__ == "__main__":
+    main()

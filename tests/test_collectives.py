@@ -91,7 +91,7 @@ def test_psum_mean(devices8):
     x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
     out = jax.jit(
         shard_map(
-            lambda xl: col.psum_mean(xl, n),
+            lambda xl: col.psum_mean(xl),
             mesh=mesh,
             in_specs=P(SP_AXIS, None),
             out_specs=P(SP_AXIS, None),

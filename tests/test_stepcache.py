@@ -1,0 +1,390 @@
+"""Temporal step-cache (parallel/stepcache.py): cadence math, parity of the
+full/shallow loop against the cache-off loop on all three model families,
+fused-vs-stepwise equivalence, the per-phase comm/FLOP report, the serve
+surfaces, and (slow) the HLO proof that skipped layers' refresh collectives
+vanish from the shallow body."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distrifuser_tpu import DistriConfig
+from distrifuser_tpu.models import dit as dit_mod
+from distrifuser_tpu.models import mmdit as mm
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+from distrifuser_tpu.parallel import stepcache
+from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+from distrifuser_tpu.parallel.mmdit_sp import MMDiTDenoiseRunner
+from distrifuser_tpu.parallel.runner import DenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+
+
+# ---------------------------------------------------------------------------
+# cadence math
+# ---------------------------------------------------------------------------
+
+
+def test_cadence_math():
+    assert stepcache.cadence_split(8, 2) == (4, 0)
+    assert stepcache.cadence_split(7, 3) == (2, 1)
+    assert stepcache.cadence_split(0, 2) == (0, 0)
+    # shallow-first: positions 0..I-2 shallow, I-1 full
+    assert [stepcache.is_shallow_step(k, 2) for k in range(4)] == [
+        True, False, True, False]
+    assert [stepcache.is_shallow_step(k, 3) for k in range(6)] == [
+        True, True, False, True, True, False]
+    # 10 steps, warmup 1 -> 2 sync, 8 cadenced at interval 2 -> 4 shallow
+    assert stepcache.shallow_step_count(10, 1, 2) == 4
+    # tail steps stay shallow: 7 cadenced at interval 3 -> 5 shallow
+    assert stepcache.shallow_step_count(9, 1, 3) == 5
+    assert stepcache.shallow_step_count(10, 1, 1) == 0  # cache off
+    assert stepcache.shallow_step_count(2, 4, 2) == 0  # never leaves warmup
+
+
+def test_config_validation():
+    kw = dict(devices=jax.devices()[:1], height=128, width=128)
+    with pytest.raises(ValueError, match="BOTH knobs"):
+        DistriConfig(step_cache_interval=2, **kw)
+    with pytest.raises(ValueError, match="BOTH knobs"):
+        DistriConfig(step_cache_depth=1, **kw)
+    with pytest.raises(ValueError, match="hybrid_loop"):
+        DistriConfig(step_cache_interval=2, step_cache_depth=1,
+                     hybrid_loop=True, **kw)
+    with pytest.raises(ValueError, match="parallelism"):
+        DistriConfig(step_cache_interval=2, step_cache_depth=1,
+                     parallelism="naive_patch", **kw)
+    # runner-level depth bound: tiny UNet has 2 levels -> depth must be 1
+    cfg = DistriConfig(step_cache_interval=2, step_cache_depth=2, **kw)
+    ucfg = tiny_config()
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    with pytest.raises(ValueError, match="step_cache_depth"):
+        DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    # DiT depth bound (tiny DiT has 8 blocks)
+    dcfg = dit_mod.tiny_dit_config()
+    cfg_d = DistriConfig(step_cache_interval=2, step_cache_depth=8,
+                         devices=jax.devices()[:1],
+                         height=dcfg.sample_size * 8,
+                         width=dcfg.sample_size * 8)
+    dparams = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+    with pytest.raises(ValueError, match="step_cache_depth"):
+        DiTDenoiseRunner(cfg_d, dcfg, dparams, get_scheduler("ddim"))
+    # MMDiT: the cut must stay past the dual-attention prefix
+    mcfg = dataclasses.replace(mm.tiny_mmdit_config(),
+                               dual_attention_blocks=3)
+    mparams = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+    cfg_m = DistriConfig(step_cache_interval=2, step_cache_depth=2,
+                         devices=jax.devices()[:1],
+                         height=mcfg.sample_size * 8,
+                         width=mcfg.sample_size * 8)
+    with pytest.raises(ValueError, match="dual"):
+        MMDiTDenoiseRunner(cfg_m, mcfg, mparams,
+                           get_scheduler("flow-euler"))
+
+
+# ---------------------------------------------------------------------------
+# UNet parity (single device keeps the tier-1 compile budget small; the
+# multi-device displaced variants run in the slow block below)
+# ---------------------------------------------------------------------------
+
+
+def _unet_runner(devices, n, **kw):
+    cfg = DistriConfig(devices=devices[:n], height=128, width=128,
+                       warmup_steps=1, parallelism="patch", **kw)
+    ucfg = tiny_config(sdxl=False)
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    return DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim")), cfg, ucfg
+
+
+def _unet_inputs(cfg, ucfg):
+    k = jax.random.PRNGKey(42)
+    lat = jax.random.normal(
+        k, (1, cfg.latent_height, cfg.latent_width, ucfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 7, ucfg.cross_attention_dim))
+    return lat, enc
+
+
+def test_unet_parity_single_device():
+    devs = jax.devices()
+    r_off, cfg, ucfg = _unet_runner(devs, 1)
+    r_on, _, _ = _unet_runner(devs, 1, step_cache_interval=2,
+                              step_cache_depth=1)
+    lat, enc = _unet_inputs(cfg, ucfg)
+    # a run that never leaves warmup is bit-identical: every step is full
+    a2 = np.asarray(r_off.generate(lat, enc, num_inference_steps=2))
+    b2 = np.asarray(r_on.generate(lat, enc, num_inference_steps=2))
+    np.testing.assert_array_equal(a2, b2)
+    # cadenced run stays within tolerance of cache-off (measured ~0.03
+    # relative on this config; 0.15 leaves platform margin while still far
+    # below the 0.35 displaced-mode gate in test_runner.py)
+    a6 = np.asarray(r_off.generate(lat, enc, num_inference_steps=6))
+    b6 = np.asarray(r_on.generate(lat, enc, num_inference_steps=6))
+    assert np.isfinite(b6).all()
+    rel = np.abs(a6 - b6).max() / (np.abs(a6).max() + 1e-6)
+    assert rel < 0.15, f"step-cache drift {rel}"
+    assert rel > 0, "cache-on unexpectedly bit-identical: shallow steps dead?"
+    # the host-driven stepwise loop replays the exact cadence
+    r_sw, _, _ = _unet_runner(devs, 1, step_cache_interval=2,
+                              step_cache_depth=1, use_cuda_graph=False)
+    c6 = np.asarray(r_sw.generate(lat, enc, num_inference_steps=6))
+    np.testing.assert_allclose(b6, c6, atol=2e-4)
+
+
+def test_unet_tail_and_callback():
+    """interval 3 with a non-multiple step count exercises the unrolled
+    shallow tail; the callback path must fire per executed step and match
+    the fused cadence numerics."""
+    devs = jax.devices()
+    r_on, cfg, ucfg = _unet_runner(devs, 1, step_cache_interval=3,
+                                   step_cache_depth=1)
+    r_sw, _, _ = _unet_runner(devs, 1, step_cache_interval=3,
+                              step_cache_depth=1, use_cuda_graph=False)
+    lat, enc = _unet_inputs(cfg, ucfg)
+    a = np.asarray(r_on.generate(lat, enc, num_inference_steps=7))
+    b = np.asarray(r_sw.generate(lat, enc, num_inference_steps=7))
+    np.testing.assert_allclose(a, b, atol=2e-4)
+    seen = []
+    out = r_on.generate(lat, enc, num_inference_steps=4,
+                        callback=lambda i, t, x: seen.append(i))
+    assert seen == [0, 1, 2, 3]
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_unet_per_phase_report_and_flops():
+    devs = jax.devices()
+    r_on, _, _ = _unet_runner(devs, 1, step_cache_interval=2,
+                              step_cache_depth=1)
+    rep = r_on.comm_volume_report(per_phase=True)
+    # single device: the only carried state is the deep cache itself, and a
+    # shallow step freshly exchanges nothing
+    assert rep["phases"]["sync"] == {"stepcache": 32768}
+    assert rep["phases"]["shallow"] == {}
+    fl = rep["flops"]
+    assert fl is not None and 0 < fl["shallow_ratio"] < 0.7, fl
+    # cache off: legacy report shape is untouched, per-phase flops absent
+    r_off, _, _ = _unet_runner(devs, 1)
+    assert r_off.comm_volume_report() == {}
+    assert r_off.comm_volume_report(per_phase=True)["flops"] is None
+
+
+# ---------------------------------------------------------------------------
+# DiT / MMDiT parity (deep-block residual cache)
+# ---------------------------------------------------------------------------
+
+
+def _dit_runner(n, dcfg, params, **kw):
+    cfg = DistriConfig(devices=jax.devices()[:n], height=dcfg.sample_size * 8,
+                       width=dcfg.sample_size * 8, warmup_steps=1, **kw)
+    return DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+
+
+def test_dit_parity_single_device():
+    dcfg = dit_mod.tiny_dit_config()
+    params = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+    k = jax.random.PRNGKey(3)
+    lat = jax.random.normal(
+        k, (1, dcfg.sample_size, dcfg.sample_size, dcfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 8, dcfg.caption_dim))
+    r_off = _dit_runner(1, dcfg, params)
+    r_on = _dit_runner(1, dcfg, params, step_cache_interval=2,
+                       step_cache_depth=4)
+    a2 = np.asarray(r_off.generate(lat, enc, num_inference_steps=2))
+    b2 = np.asarray(r_on.generate(lat, enc, num_inference_steps=2))
+    np.testing.assert_array_equal(a2, b2)  # warmup-only: bit-identical
+    a6 = np.asarray(r_off.generate(lat, enc, num_inference_steps=6))
+    b6 = np.asarray(r_on.generate(lat, enc, num_inference_steps=6))
+    assert np.isfinite(b6).all()
+    rel = np.abs(a6 - b6).max() / (np.abs(a6).max() + 1e-6)
+    assert 0 < rel < 0.05, f"DiT step-cache drift {rel}"
+    r_sw = _dit_runner(1, dcfg, params, step_cache_interval=2,
+                       step_cache_depth=4, use_cuda_graph=False)
+    c6 = np.asarray(r_sw.generate(lat, enc, num_inference_steps=6))
+    np.testing.assert_allclose(b6, c6, atol=2e-4)
+    rep = r_on.comm_report()
+    assert rep["step_cache"]["interval"] == 2
+
+
+def test_mmdit_parity_single_device():
+    mcfg = mm.tiny_mmdit_config()
+    params = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (1, mcfg.sample_size, mcfg.sample_size, mcfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 5, mcfg.joint_attention_dim))
+    pooled = jax.random.normal(
+        jax.random.fold_in(k, 2), (2, 1, mcfg.pooled_projection_dim))
+
+    def mk(**kw):
+        cfg = DistriConfig(devices=jax.devices()[:1],
+                           height=mcfg.sample_size * 8,
+                           width=mcfg.sample_size * 8, warmup_steps=1, **kw)
+        return MMDiTDenoiseRunner(cfg, mcfg, params,
+                                  get_scheduler("flow-euler"))
+
+    r_off, r_on = mk(), mk(step_cache_interval=2, step_cache_depth=1)
+    a2 = np.asarray(r_off.generate(lat, enc, pooled, num_inference_steps=2))
+    b2 = np.asarray(r_on.generate(lat, enc, pooled, num_inference_steps=2))
+    np.testing.assert_array_equal(a2, b2)
+    a6 = np.asarray(r_off.generate(lat, enc, pooled, num_inference_steps=6))
+    b6 = np.asarray(r_on.generate(lat, enc, pooled, num_inference_steps=6))
+    assert np.isfinite(b6).all()
+    rel = np.abs(a6 - b6).max() / (np.abs(a6).max() + 1e-6)
+    assert 0 < rel < 0.05, f"MMDiT step-cache drift {rel}"
+    r_sw = mk(step_cache_interval=2, step_cache_depth=1,
+              use_cuda_graph=False)
+    c6 = np.asarray(r_sw.generate(lat, enc, pooled, num_inference_steps=6))
+    np.testing.assert_allclose(b6, c6, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# serve surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_serve_exec_key_and_metrics():
+    from distrifuser_tpu.serve.cache import ExecKey
+    from distrifuser_tpu.serve.server import InferenceServer
+    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+    from distrifuser_tpu.utils.config import ServeConfig
+
+    base = dict(model_id="m", scheduler="ddim", height=512, width=512,
+                steps=8, cfg=True, mesh_plan="dp1.cfg1.sp1")
+    k_off = ExecKey(**base)
+    k_on = ExecKey(**base, step_cache_interval=2, step_cache_depth=1)
+    # two requests differing only in cadence must not share an executor
+    assert k_off != k_on
+    assert ":sc2x1" in k_on.short() and ":sc" not in k_off.short()
+
+    with pytest.raises(ValueError, match="BOTH knobs"):
+        ServeConfig(step_cache_interval=2)
+
+    fac = FakeExecutorFactory(batch_size=4)
+    cfg = ServeConfig(step_cache_interval=2, step_cache_depth=1,
+                      batch_window_s=0.0)
+    srv = InferenceServer(fac, cfg, model_id="m").start(warmup=False)
+    try:
+        futs = [srv.submit(f"p{i}", height=512, width=512,
+                           num_inference_steps=9, seed=i) for i in range(3)]
+        for f in futs:
+            f.result(timeout=10)
+        snap = srv.metrics_snapshot()
+    finally:
+        srv.stop()
+    sc = snap["step_cache"]
+    assert sc["interval"] == 2 and sc["steps_total"] == 27
+    # fake executors model warmup 0: 9 steps -> 4 shallow each
+    assert sc["steps_shallow"] == 12
+    assert 0 < sc["shallow_share"] < 1
+    assert ":sc2x1" in snap["cache"]["entries"][0]
+
+
+def test_pipeline_step_cache_plan(devices8):
+    from test_pipelines import build_sd_pipeline
+
+    pipe, _ = build_sd_pipeline(devices8, 1, step_cache_interval=2,
+                                step_cache_depth=1, warmup_steps=1)
+    plan = pipe.step_cache_plan(10)
+    assert plan == {"enabled": True, "interval": 2, "depth": 1,
+                    "total_steps": 10, "shallow_steps": 4}
+    pipe_off, _ = build_sd_pipeline(devices8, 1)
+    assert pipe_off.step_cache_plan(10)["shallow_steps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# HLO: the shallow body drops the skipped layers' refresh collectives
+# (8-device compiles: minutes on the tier-1 CPU runner -> slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hlo_shallow_body_drops_refresh_collectives(devices8):
+    """The compiled cache-on program (interval 2; 6 steps so the super-step
+    scan has length 2 and survives as a while loop) carries the shallow
+    steps as their own nested loop body (stepcache.run_cadence's inner
+    fori), so the shallow body is directly inspectable.  With
+    mode=separate_gn the only all-gathers are self-attention KV refreshes
+    plus the per-step output gather, and the tiny config's attention all
+    lives in the deep subtree — so:
+
+    * the SHALLOW body must contain NO all-gather refresh at all (every
+      skipped layer's KV gather vanished) and strictly fewer halo permutes
+      than a full step (only shallow convs still displace);
+    * the FULL (super-step) body must match the cache-off stale body's
+      deferred refresh set exactly — the cache changes what shallow steps
+      skip, not what full steps exchange."""
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.utils.overlap import analyze_loop_collectives
+
+    ucfg = unet_mod.tiny_config(sdxl=False)
+    params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg)
+    depth = len(ucfg.block_out_channels) - 1
+
+    def hlo(**kw):
+        cfg = DistriConfig(
+            devices=devices8, height=8 * 8 * (1 << depth) * 2, width=128,
+            warmup_steps=1, parallelism="patch", mode="separate_gn", **kw,
+        )
+        runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+        lat = jnp.zeros(
+            (1, cfg.latent_height, cfg.latent_width, ucfg.in_channels))
+        enc = jnp.zeros((2, 1, 7, ucfg.cross_attention_dim))
+        fn = runner._build(6)
+        return fn.lower(params, lat, enc, None, 5.0).compile().as_text()
+
+    def count(report, prefix, which="deferred"):
+        return sum(1 for op in getattr(report, which).values()
+                   if op.startswith(prefix))
+
+    off_reports = analyze_loop_collectives(hlo())
+    assert off_reports, "no while-loop collectives found"
+    off = max(off_reports, key=lambda r: r.n_deferred)
+    assert count(off, "all-gather") > 0 and count(off, "collective-permute"), (
+        off.deferred, "analysis lost signal")
+
+    on_reports = [r for r in analyze_loop_collectives(
+        hlo(step_cache_interval=2, step_cache_depth=1)) if r.n_deferred]
+    assert len(on_reports) == 2, [
+        (r.body, r.deferred, r.inline) for r in on_reports]
+    full = max(on_reports, key=lambda r: r.n_deferred)
+    shallow = min(on_reports, key=lambda r: r.n_deferred)
+    # full steps exchange exactly what cache-off steps exchange
+    for prefix in ("all-gather", "collective-permute"):
+        assert count(full, prefix) == count(off, prefix), prefix
+    # the shallow body: zero KV refresh gathers anywhere, and strictly
+    # fewer halo permutes than a full step (deep convs' permutes gone)
+    assert count(shallow, "all-gather") == 0, shallow.deferred
+    assert 0 < count(shallow, "collective-permute") < count(
+        off, "collective-permute"), (shallow.deferred, off.deferred)
+    # its only inline collective work is the per-step output gather
+    assert set(shallow.inline.values()) <= {"all-gather"}, shallow.inline
+
+
+@pytest.mark.slow
+def test_unet_multi_device_parity(devices8):
+    """Displaced 8-device (cfg 2 x sp 4) cadence: cache-on tracks cache-off
+    and the stepwise loop replays the fused program exactly."""
+    r_off, cfg, ucfg = _unet_runner(devices8, 8)
+    r_on, _, _ = _unet_runner(devices8, 8, step_cache_interval=2,
+                              step_cache_depth=1)
+    r_sw, _, _ = _unet_runner(devices8, 8, step_cache_interval=2,
+                              step_cache_depth=1, use_cuda_graph=False)
+    lat, enc = _unet_inputs(cfg, ucfg)
+    a = np.asarray(r_off.generate(lat, enc, num_inference_steps=6))
+    b = np.asarray(r_on.generate(lat, enc, num_inference_steps=6))
+    c = np.asarray(r_sw.generate(lat, enc, num_inference_steps=6))
+    assert np.isfinite(b).all()
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    assert rel < 0.2, f"multi-device step-cache drift {rel}"
+    np.testing.assert_allclose(b, c, atol=2e-4)
+    # per-phase report on the real mesh: the shallow phase must freshly
+    # exchange strictly less than the stale phase, and never any attn KV
+    rep = r_on.comm_volume_report(per_phase=True)
+    ph = rep["phases"]
+    assert "attn" not in ph["shallow"]
+    assert sum(ph["shallow"].values()) < sum(
+        v for k, v in ph["stale"].items() if k != "stepcache")
