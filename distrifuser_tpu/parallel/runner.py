@@ -482,6 +482,18 @@ class DenoiseRunner:
         key = (num_steps if start_step == 0 and end_step is None
                else (num_steps, start_step, end_step))
         if key not in self._compiled:
+            # Chaos hook (utils/chaos.py, plans authored in serve/faults.py):
+            # the process-global fault plan, when installed, can fail this
+            # build deterministically — the injection site for "the compile
+            # service is down" scenarios that the serve layer's degradation
+            # ladder must survive.  The registry is a stdlib-only utils
+            # leaf, so this does NOT pull the serving subsystem into the
+            # parallel layer; production runs never install a plan.
+            from ..utils.chaos import active_fault_plan
+
+            plan = active_fault_plan()
+            if plan is not None:
+                plan.check("runner.compile")
             self._builds += 1
             self._compiled[key] = self._build(num_steps, start_step, end_step)
         return self._compiled[key]

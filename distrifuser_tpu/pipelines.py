@@ -418,6 +418,34 @@ class _GenerationMixin:
             "shallow_steps": shallow,
         }
 
+    def set_stepwise(self, enabled: bool = True) -> None:
+        """Switch the denoise loop between the fused compiled scan and
+        the host-driven stepwise loop (the reference's --no_cuda_graph
+        path) — same numerics, per-step dispatch instead of one program.
+
+        This is the compat-shim fallback (utils/compat.py routes
+        callback-carrying generates stepwise on jaxlibs that abort on the
+        fused io_callback program) promoted to a *policy*: the serve
+        layer's degradation ladder (serve/resilience.py) calls it when
+        the fused program fails to compile or OOMs, because the stepwise
+        loop is a far smaller program to compile and hold.  Call before
+        `prepare()`/generation; already-compiled fused programs stay
+        cached and are simply not dispatched to while disabled.
+
+        PipeFusion pipelines reject the switch LOUDLY: `PipeFusionRunner`
+        has no host-driven stepwise loop (its per-patch micro-pipeline IS
+        the program), and silently flipping the flag after construction
+        would report a degradation that changes nothing."""
+        if enabled and self.distri_config.parallelism == "pipefusion":
+            raise ValueError(
+                "stepwise fallback does not apply to the PipeFusion patch "
+                "pipeline: PipeFusionRunner has no host-driven stepwise "
+                "loop (parallel/pipefusion.py) — exclude "
+                "RUNG_STEPWISE via ResilienceConfig"
+                "(allow_stepwise_fallback=False) when serving pipefusion"
+            )
+        self.distri_config.use_cuda_graph = not enabled
+
     def _finalize(self, latent, output_type, tokenizers,
                   shift: float = 0.0) -> "PipelineOutput":
         """latent -> PipelineOutput for 'latent' | 'np' | 'pil'.  ``shift``

@@ -9,26 +9,47 @@ star: heavy traffic, mesh never idle):
 * `ExecutorCache` — LRU compiled-executable cache with startup warmup
   (serve/cache.py);
 * `InferenceServer` — the scheduler thread tying them together, with
-  per-request lifecycle metrics (serve/server.py);
+  per-request lifecycle metrics and a `health()` snapshot
+  (serve/server.py);
+* resilience layer — typed errors (serve/errors.py), retry/backoff +
+  per-key circuit breakers + execution watchdog + the graceful-
+  degradation ladder (serve/resilience.py), and deterministic fault
+  injection (serve/faults.py) so all of it is testable on CPU;
 * `PipelineExecutor` — adapter from the repo's pipelines
   (serve/executors.py); `serve.testing` has the weightless fakes.
 
 ``python -m distrifuser_tpu.serve --demo`` runs a CPU-only end-to-end
 demonstration (serve/__main__.py); ``scripts/serve_bench.py`` is the
-closed/open-loop load generator.  Architecture notes: docs/SERVING.md.
+closed/open-loop load generator and ``scripts/chaos_bench.py`` the same
+load under a fault plan.  Architecture notes: docs/SERVING.md.
 """
 
-from ..utils.config import DEFAULT_BUCKETS, ServeConfig
-from .batcher import BatchKey, BucketTable, MicroBatcher, NoBucketError
+from ..utils.config import DEFAULT_BUCKETS, ResilienceConfig, ServeConfig
+from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
-from .queue import (
+from .errors import (
+    BuildFailedError,
+    CircuitOpenError,
     DeadlineExceededError,
+    ExecuteFailedError,
+    FatalError,
+    NoBucketError,
     QueueFullError,
-    Request,
-    RequestQueue,
+    ResourceExhaustedError,
+    RetryableError,
     ServeError,
-    ServeResult,
     ServerClosedError,
+    WatchdogTimeoutError,
+)
+from .faults import FaultPlan, FaultRule, install_fault_plan
+from .queue import Request, RequestQueue, ServeResult
+from .resilience import (
+    BackoffPolicy,
+    CircuitBreaker,
+    DegradationLadder,
+    ResilienceEngine,
+    RetryBudget,
+    Watchdog,
 )
 from .server import InferenceServer
 
@@ -44,12 +65,21 @@ def __getattr__(name):
 
 
 __all__ = [
+    "BackoffPolicy",
     "BatchKey",
     "BucketTable",
+    "BuildFailedError",
+    "CircuitBreaker",
+    "CircuitOpenError",
     "DEFAULT_BUCKETS",
     "DeadlineExceededError",
+    "DegradationLadder",
     "ExecKey",
+    "ExecuteFailedError",
     "ExecutorCache",
+    "FatalError",
+    "FaultPlan",
+    "FaultRule",
     "InferenceServer",
     "MicroBatcher",
     "NoBucketError",
@@ -57,9 +87,17 @@ __all__ = [
     "QueueFullError",
     "Request",
     "RequestQueue",
+    "ResilienceConfig",
+    "ResilienceEngine",
+    "ResourceExhaustedError",
+    "RetryBudget",
+    "RetryableError",
     "ServeConfig",
     "ServeError",
     "ServeResult",
     "ServerClosedError",
+    "Watchdog",
+    "WatchdogTimeoutError",
+    "install_fault_plan",
     "pipeline_executor_factory",
 ]

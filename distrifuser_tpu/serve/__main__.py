@@ -82,11 +82,14 @@ def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
                 f"{r.queue_wait_s * 1e3:8.1f} {r.e2e_s * 1e3:7.1f}")
 
         snap = server.metrics_snapshot()
+        health = server.health()
         if metrics_path:
             server.export_metrics(metrics_path)
             say(f"\nmetrics JSON written to {metrics_path}")
     say("\nmetrics snapshot:")
     say(json.dumps(snap, indent=2, sort_keys=True))
+    say("\nhealth snapshot (as served while running):")
+    say(json.dumps(health, indent=2, sort_keys=True))
 
     # -- self-checks (the acceptance criteria of the subsystem) -----------
     batch_sizes = factory.batch_sizes()
@@ -100,6 +103,8 @@ def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
         "cache misses only on first bucket use": warm_only_first_use,
         "all requests completed": snap["requests"].get("completed", 0)
         == len(futures),
+        "health: scheduler alive, no open circuits, no degradations":
+        health["scheduler_alive"] and health["status"] == "ok",
     }
     say("")
     ok = True

@@ -25,11 +25,8 @@ import dataclasses
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from .queue import DeadlineExceededError, Request, RequestQueue, ServeError
-
-
-class NoBucketError(ServeError):
-    """Requested resolution exceeds every configured bucket."""
+from .errors import DeadlineExceededError, NoBucketError  # noqa: F401
+from .queue import Request, RequestQueue
 
 
 class BucketTable:
@@ -108,6 +105,7 @@ class MicroBatcher:
         batch_window_s: float = 0.0,
         on_reject: Optional[Callable[[Request, Exception], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        batch_cap: Optional[Callable[[BatchKey], Optional[int]]] = None,
     ):
         assert max_batch_size >= 1, max_batch_size
         self.queue = queue
@@ -118,6 +116,20 @@ class MicroBatcher:
         self.batch_window_s = batch_window_s
         self.on_reject = on_reject or (lambda req, exc: None)
         self.clock = clock
+        # batch_cap(key) -> Optional[int]: a dynamic per-key ceiling below
+        # max_batch_size.  The resilience layer's split_batch degradation
+        # uses it to make an OOM lesson sticky — once a bucket's coalesced
+        # batch had to be halved, the batcher stops FORMING wider batches
+        # for that key instead of re-discovering the OOM per dispatch.
+        self.batch_cap = batch_cap
+
+    def _cap_for(self, key: BatchKey) -> int:
+        cap = self.max_batch_size
+        if self.batch_cap is not None:
+            c = self.batch_cap(key)
+            if c is not None:
+                cap = min(cap, max(1, int(c)))
+        return cap
 
     def _key_of(self, req: Request) -> BatchKey:
         bh, bw = self.table.snap(req.height, req.width)
@@ -172,6 +184,7 @@ class MicroBatcher:
             return None
         req, key = leader
         batch = [req]
+        cap = self._cap_for(key)
 
         def take_followers() -> None:
             def compatible(r: Request) -> bool:
@@ -180,7 +193,7 @@ class MicroBatcher:
                 except NoBucketError:
                     return False
 
-            room = self.max_batch_size - len(batch)
+            room = cap - len(batch)
             if room > 0:
                 more = self.queue.pop_where(compatible, room)
                 for m in more:
@@ -188,10 +201,10 @@ class MicroBatcher:
                 batch.extend(more)
 
         take_followers()
-        if len(batch) < self.max_batch_size and self.batch_window_s > 0:
+        if len(batch) < cap and self.batch_window_s > 0:
             deadline = self.clock() + self.batch_window_s
             seen = self.queue.seq
-            while len(batch) < self.max_batch_size:
+            while len(batch) < cap:
                 remaining = deadline - self.clock()
                 if remaining <= 0:
                     break

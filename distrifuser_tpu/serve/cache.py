@@ -38,7 +38,12 @@ class ExecKey:
     a different XLA program.  The step-cache cadence knobs
     (``step_cache_interval``/``step_cache_depth``, DistriConfig) are compile
     fields too: the cadence is static per compilation, so two requests
-    differing only in cadence must not share an executor."""
+    differing only in cadence must not share an executor.  ``exec_mode``
+    ("fused" | "stepwise") selects the denoise-loop dispatch: the fused
+    compiled scan, or the host-driven stepwise loop — same numerics, a
+    much smaller program; the resilience layer's degradation ladder
+    (serve/resilience.py) switches a failing key to "stepwise" as a
+    policy fallback."""
 
     model_id: str
     scheduler: str
@@ -49,13 +54,22 @@ class ExecKey:
     mesh_plan: str
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    exec_mode: str = "fused"
+
+    def __post_init__(self):
+        if self.exec_mode not in ("fused", "stepwise"):
+            raise ValueError(
+                f"exec_mode must be 'fused' or 'stepwise', got "
+                f"{self.exec_mode!r}"
+            )
 
     def short(self) -> str:
         g = "cfg" if self.cfg else "nocfg"
         sc = (f":sc{self.step_cache_interval}x{self.step_cache_depth}"
               if self.step_cache_interval > 1 else "")
+        em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
         return (f"{self.model_id}:{self.height}x{self.width}"
-                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}")
+                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}{em}")
 
 
 class ExecutorCache:
@@ -117,6 +131,19 @@ class ExecutorCache:
             for old_key, old_ex in evicted:
                 self.on_evict(old_key, old_ex)
         return ex, False
+
+    def invalidate(self, key: ExecKey) -> bool:
+        """Drop one entry (True if it was resident), firing ``on_evict``
+        so its device buffers can be released.  The resilience layer uses
+        this to evict a poisoned executor before retrying a degraded
+        build — a cached broken program must not satisfy the retry."""
+        with self._lock:
+            ex = self._entries.pop(key, None)
+            if ex is not None:
+                self.evictions += 1
+        if ex is not None and self.on_evict:
+            self.on_evict(key, ex)
+        return ex is not None
 
     def warmup(self, keys: Iterable[ExecKey]) -> int:
         """Prefetch executors for the given keys (startup path).  Returns

@@ -1,0 +1,103 @@
+"""Typed error hierarchy for the serve layer.
+
+Every failure a request can see is a `ServeError` subclass, split by the
+ONE property callers and the retry policy need without string matching:
+is the request itself doomed, or could the same request succeed later /
+elsewhere / degraded?
+
+* `RetryableError` — transient or capacity-shaped: the request as posed is
+  fine, the attempt failed.  HTTP analogs: 429 (`QueueFullError`), 503
+  (`CircuitOpenError`), 504 (`WatchdogTimeoutError`).  Upstream load
+  balancers should retry against another replica or after backoff; the
+  in-server retry policy (serve/resilience.py) retries build/execute
+  flavors itself before surfacing them.
+* `FatalError` — the request can never succeed as posed: it expired, the
+  server is gone, or no bucket covers it.  Retrying verbatim is wasted
+  work.
+
+`ResourceExhaustedError` subclasses `ExecuteFailedError` because an OOM
+*is* a failed execution — but it is also the trigger for the graceful-
+degradation ladder (batch split, step-cache off, stepwise fallback,
+smaller bucket), so it keeps its own type.  `is_oom` recognizes both the
+typed error and raw backend errors (jaxlib surfaces HBM exhaustion as an
+`XlaRuntimeError` whose message starts with ``RESOURCE_EXHAUSTED``).
+
+Definitions live here (stdlib-only module, importable from anywhere in
+the package without cycles); `serve/queue.py` and `serve/batcher.py`
+re-export their historical names so existing imports keep working.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for serve-layer rejections and failures."""
+
+
+class RetryableError(ServeError):
+    """Transient: the same request may succeed on retry (here or on
+    another replica).  The in-server retry policy only ever retries
+    these."""
+
+
+class FatalError(ServeError):
+    """Terminal for this request: retrying it verbatim cannot succeed."""
+
+
+# -- retryable ---------------------------------------------------------------
+
+
+class QueueFullError(RetryableError):
+    """Admission rejected: queue at max depth (HTTP-429 analog)."""
+
+
+class CircuitOpenError(RetryableError):
+    """Shed fast: this request's compiled-executor key has tripped its
+    circuit breaker (HTTP-503 analog).  Retry after the cooldown, or
+    against a replica whose breaker for the key is closed."""
+
+
+class WatchdogTimeoutError(RetryableError):
+    """Batch execution exceeded the watchdog wall-time bound; the batch
+    was abandoned (HTTP-504 analog).  The mesh work may still be running
+    on the abandoned worker thread — its result is discarded."""
+
+
+class BuildFailedError(RetryableError):
+    """Executor construction (pipeline build + ahead-of-time compile)
+    failed.  Retryable because the degradation ladder may succeed with a
+    cheaper program (step-cache off, stepwise loop, smaller bucket)."""
+
+
+class ExecuteFailedError(RetryableError):
+    """The batched mesh dispatch raised.  The original exception rides
+    ``__cause__``."""
+
+
+class ResourceExhaustedError(ExecuteFailedError):
+    """OOM-shaped failure (jax RESOURCE_EXHAUSTED or injected): the
+    trigger for the graceful-degradation ladder."""
+
+
+# -- fatal -------------------------------------------------------------------
+
+
+class DeadlineExceededError(FatalError):
+    """Request expired while waiting for a batch slot; it was NOT executed."""
+
+
+class ServerClosedError(FatalError):
+    """Submitted to (or still queued in) a server that has been stopped."""
+
+
+class NoBucketError(FatalError):
+    """Requested resolution exceeds every configured bucket."""
+
+
+def is_oom(exc: BaseException) -> bool:
+    """OOM detector spanning the typed error, injected faults, and raw
+    backend errors (XlaRuntimeError stringifies as
+    ``RESOURCE_EXHAUSTED: ...`` when HBM/host allocation fails)."""
+    if isinstance(exc, ResourceExhaustedError):
+        return True
+    return "RESOURCE_EXHAUSTED" in str(exc)

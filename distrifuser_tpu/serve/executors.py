@@ -15,9 +15,10 @@ changes a request's image.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from .cache import ExecKey
+from .faults import FaultPlan
 
 
 class PipelineExecutor:
@@ -27,11 +28,19 @@ class PipelineExecutor:
     key.width) with do_classifier_free_guidance == key.cfg and the key's
     scheduler family; ``prepare(key.steps)`` should already have run (the
     factory in `pipeline_executor_factory` does all of this).
+
+    ``fault_plan`` (serve/faults.py) injects at site ``"executor.execute"``
+    for direct (server-less) executor use; a server-driven executor gets
+    its faults from the server's own ``"execute"`` site instead.
     """
 
-    def __init__(self, pipeline, steps: int):
+    def __init__(self, pipeline, steps: int, *,
+                 key: Optional[ExecKey] = None,
+                 fault_plan: Optional[FaultPlan] = None):
         self.pipeline = pipeline
         self.steps = steps
+        self.key = key
+        self.fault_plan = fault_plan
         self.batch_size = pipeline.distri_config.batch_size
         # per-invocation shallow-step count under the step-cache cadence
         # (0 with the cache off) — the server's shallow-share metrics read
@@ -69,6 +78,9 @@ class PipelineExecutor:
         guidance_scale: float,
         seeds: List[int],
     ) -> List[Any]:
+        if self.fault_plan is not None:
+            self.fault_plan.check("executor.execute", key=self.key,
+                                  batch_size=len(prompts))
         n_real = len(prompts)
         bs = self.batch_size
         pad = (-n_real) % bs
@@ -96,8 +108,32 @@ class PipelineExecutor:
         return images[:n_real]
 
 
+def apply_key_policy(pipeline, key: ExecKey) -> None:
+    """Make the built pipeline honor the key's degradation-relevant
+    fields even when ``build_pipeline`` ignored them.
+
+    The degradation ladder (serve/resilience.py) produces keys with the
+    step cache disabled or ``exec_mode="stepwise"``; builders written
+    before those fields existed construct their DistriConfig from
+    (height, width, cfg, scheduler) only.  Both degraded directions are
+    safe to force post-construction and pre-`prepare()`: turning the
+    cadence OFF removes a compiled body, and the stepwise switch is the
+    pipeline's own `set_stepwise` policy hook.  (The opposite direction —
+    a key *requesting* a cadence the builder didn't configure — is the
+    builder's job; forcing it here could violate the model's depth
+    bounds, so it is left alone.)"""
+    dcfg = pipeline.distri_config
+    if (key.step_cache_interval == 1
+            and (dcfg.step_cache_interval, dcfg.step_cache_depth) != (1, 0)):
+        dcfg.step_cache_interval = 1
+        dcfg.step_cache_depth = 0
+    if key.exec_mode == "stepwise":
+        pipeline.set_stepwise(True)
+
+
 def pipeline_executor_factory(
     build_pipeline: Callable[[ExecKey], Any],
+    fault_plan: Optional[FaultPlan] = None,
 ) -> Callable[[ExecKey], PipelineExecutor]:
     """Executor factory for `InferenceServer` from a pipeline builder.
 
@@ -106,12 +142,17 @@ def pipeline_executor_factory(
     do_classifier_free_guidance=key.cfg, then ``from_pretrained`` /
     ``from_params`` with key.scheduler.  The factory runs the ahead-of-time
     compile (`prepare`) so cache misses pay the full cost HERE, off the
-    per-request path, and hands back a ready executor.
+    per-request path, and hands back a ready executor.  ``fault_plan``
+    injects at sites ``"executor.build"`` / ``"executor.execute"``.
     """
 
     def factory(key: ExecKey) -> PipelineExecutor:
+        if fault_plan is not None:
+            fault_plan.check("executor.build", key=key)
         pipe = build_pipeline(key)
+        apply_key_policy(pipe, key)
         pipe.prepare(key.steps)
-        return PipelineExecutor(pipe, key.steps)
+        return PipelineExecutor(pipe, key.steps, key=key,
+                                fault_plan=fault_plan)
 
     return factory

@@ -1,0 +1,221 @@
+"""Seedable, deterministic fault injection for the serve + runner stack.
+
+Real deployments see failed compiles, transient execute errors, hung
+devices, and OOMs (preempted/slow devices are the premise of STADI,
+arXiv 2509.04719); nothing in a clean CPU test run does.  A `FaultPlan`
+makes those events *reproducible*: named injection sites consult the plan,
+and each matching `FaultRule` decides — from its own seeded RNG stream —
+whether to raise, sleep, or pass.  The same plan + the same call sequence
+at a site fires the same faults, so every resilience behavior (retry,
+circuit breaking, watchdog, degradation ladder) is testable on the 2-core
+CPU runner.
+
+Injection sites (the convention — sites are plain strings):
+
+* ``"build"`` — `InferenceServer` around `executor_factory(key)` (covers
+  fake and real factories alike);
+* ``"execute"`` — `InferenceServer` inside the watchdog-wrapped batched
+  dispatch (so a ``hang`` here is what the watchdog exists to bound);
+* ``"executor.build"`` / ``"executor.execute"`` — `pipeline_executor_factory`
+  / `PipelineExecutor.__call__` for direct (server-less) executor use;
+* ``"runner.compile"`` — `DenoiseRunner.compiled_handle` before building a
+  fused-loop program (reads the process-global plan, see
+  `install_fault_plan`, because the runner has no serve-layer plumbing).
+
+Fault kinds:
+
+* ``compile_error`` — raises `InjectedCompileError`;
+* ``execute_error`` — raises `InjectedExecuteError`;
+* ``oom`` — raises `InjectedResourceExhausted`, whose message is
+  RESOURCE_EXHAUSTED-shaped so `errors.is_oom` (and any code matching real
+  jaxlib OOMs) classifies it identically;
+* ``hang`` — sleeps ``hang_s`` then returns normally, modelling a stalled
+  device that eventually recovers.  Under a watchdog the call is abandoned
+  at the timeout; the sleeping thread finishes in the background and its
+  result is discarded.
+
+Only the ``execute`` sites run under the watchdog.  A ``hang`` injected
+at a build/compile site blocks its caller for the full ``hang_s`` —
+which is the faithful simulation: executor builds are synchronous in the
+scheduler thread (a slow compile service stalls admission-to-dispatch
+exactly like this), and the watchdog deliberately does not bound them
+because legitimate cold compiles take minutes.  Size ``hang_s``
+accordingly when targeting a build site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+import zlib
+from typing import Dict, Optional, Sequence, Tuple
+
+FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang")
+
+
+class InjectedFault(Exception):
+    """Marker base for injected faults (mixed into concrete kinds) so
+    tests and metrics can tell injected failures from organic ones."""
+
+
+class InjectedCompileError(RuntimeError, InjectedFault):
+    pass
+
+
+class InjectedExecuteError(RuntimeError, InjectedFault):
+    pass
+
+
+class InjectedResourceExhausted(RuntimeError, InjectedFault):
+    """Message deliberately RESOURCE_EXHAUSTED-shaped (jaxlib's OOM
+    surface) so OOM classification has one code path for injected and
+    real faults."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One injection rule: WHERE (site + filters), WHAT (kind), WHEN
+    (probability per call, or exact 0-based call indices at the site).
+
+    Filters are checked before the rule's RNG is consulted, so a rule's
+    random stream advances only on calls it could have fired on — the
+    firing pattern is a pure function of (seed, the site's filtered call
+    sequence).
+    """
+
+    site: str
+    kind: str
+    p: float = 0.0  # per-eligible-call probability
+    at_calls: Tuple[int, ...] = ()  # exact site-call indices (0-based)
+    min_batch: int = 0  # only fire when batch_size >= min_batch
+    key_substr: str = ""  # only fire when ExecKey.short() contains this
+    max_fires: int = -1  # -1 = unbounded
+    hang_s: float = 10.0  # sleep length for kind == "hang"
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault kind must be one of {FAULT_KINDS}, got {self.kind!r}"
+            )
+        if not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"fault probability must be in [0, 1], got {self.p}")
+        if self.p == 0.0 and not self.at_calls:
+            raise ValueError(
+                f"rule {self.site}/{self.kind}: give a probability p > 0 or "
+                "explicit at_calls indices — a rule that can never fire is a "
+                "misconfigured plan, not a no-op"
+            )
+
+
+def _raise_fault(rule: FaultRule, site: str) -> None:
+    msg = f"injected {rule.kind} at site {site!r}"
+    if rule.kind == "compile_error":
+        raise InjectedCompileError(msg)
+    if rule.kind == "execute_error":
+        raise InjectedExecuteError(msg)
+    if rule.kind == "oom":
+        raise InjectedResourceExhausted(
+            f"RESOURCE_EXHAUSTED: {msg} (simulated out-of-memory while "
+            "allocating device buffers)"
+        )
+    raise AssertionError(rule.kind)  # hang handled by the caller
+
+
+class FaultPlan:
+    """A seeded set of `FaultRule`s plus per-site call counters.
+
+    ``check(site, key=..., batch_size=...)`` is the single entry point a
+    site calls; it either returns (no fault), sleeps then returns
+    (``hang``), or raises the injected exception.  At most one rule fires
+    per call (first matching rule in declaration order wins).
+
+    Thread-safe: the scheduler thread and watchdog worker threads consult
+    the same plan.  ``fired()`` snapshots ``{(site, kind): count}`` so
+    benches can report exactly what chaos was applied.
+    """
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int = 0):
+        self.rules = tuple(rules)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._site_calls: Dict[str, int] = {}
+        self._fires: Dict[Tuple[str, str], int] = {}
+        self._rule_fires = [0] * len(self.rules)
+        # one independent deterministic stream per rule: interleaving of
+        # *different* sites can never perturb a rule's pattern
+        self._rngs = [
+            random.Random(
+                zlib.crc32(f"{self.seed}|{i}|{r.site}|{r.kind}".encode())
+            )
+            for i, r in enumerate(self.rules)
+        ]
+
+    # -- internals ----------------------------------------------------------
+
+    def _eligible(self, rule: FaultRule, key, batch_size: Optional[int]) -> bool:
+        if rule.min_batch and (batch_size is None or batch_size < rule.min_batch):
+            return False
+        if rule.key_substr:
+            short = key.short() if hasattr(key, "short") else str(key)
+            if key is None or rule.key_substr not in short:
+                return False
+        return True
+
+    def _pick(self, site: str, key, batch_size: Optional[int]) -> Optional[FaultRule]:
+        with self._lock:
+            call_idx = self._site_calls.get(site, 0)
+            self._site_calls[site] = call_idx + 1
+            for i, rule in enumerate(self.rules):
+                if rule.site != site:
+                    continue
+                if not self._eligible(rule, key, batch_size):
+                    continue
+                if 0 <= rule.max_fires <= self._rule_fires[i]:
+                    continue
+                fire = call_idx in rule.at_calls
+                if not fire and rule.p > 0.0:
+                    fire = self._rngs[i].random() < rule.p
+                if fire:
+                    self._rule_fires[i] += 1
+                    k = (site, rule.kind)
+                    self._fires[k] = self._fires.get(k, 0) + 1
+                    return rule
+            return None
+
+    # -- the site API -------------------------------------------------------
+
+    def check(self, site: str, key=None, batch_size: Optional[int] = None) -> None:
+        """Consult the plan at ``site``; raise/sleep if a rule fires."""
+        rule = self._pick(site, key, batch_size)
+        if rule is None:
+            return
+        if rule.kind == "hang":
+            time.sleep(rule.hang_s)
+            return
+        _raise_fault(rule, site)
+
+    # -- observability ------------------------------------------------------
+
+    def fired(self) -> Dict[str, int]:
+        """``{"site/kind": count}`` of every fault fired so far."""
+        with self._lock:
+            return {f"{s}/{k}": n for (s, k), n in sorted(self._fires.items())}
+
+    def site_calls(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._site_calls.items()))
+
+
+# The process-global plan — the hook for sites with no serve-layer
+# plumbing (DenoiseRunner.compiled_handle) — lives in the stdlib-only
+# leaf utils/chaos.py so the parallel layer can consult it WITHOUT
+# importing this package; re-exported here so chaos tools keep one
+# import surface.  Chaos tools install a plan for a run and clear it
+# after; production code never sets it.
+from ..utils.chaos import (  # noqa: E402, F401  (re-exports)
+    active_fault_plan,
+    clear_fault_plan,
+    install_fault_plan,
+)

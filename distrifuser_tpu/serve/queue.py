@@ -27,21 +27,15 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
 
-
-class ServeError(RuntimeError):
-    """Base class for serve-layer rejections."""
-
-
-class QueueFullError(ServeError):
-    """Admission rejected: queue at max depth (HTTP-429 analog)."""
-
-
-class DeadlineExceededError(ServeError):
-    """Request expired while waiting for a batch slot; it was NOT executed."""
-
-
-class ServerClosedError(ServeError):
-    """Submitted to a server that has been stopped."""
+# Historical home of these errors — re-exported so `from .queue import
+# QueueFullError` keeps working; the full typed hierarchy (Retryable vs
+# Fatal) lives in serve/errors.py.
+from .errors import (  # noqa: F401  (re-exports)
+    DeadlineExceededError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
 
 
 _REQUEST_IDS = itertools.count()
@@ -91,6 +85,11 @@ class ServeResult:
     e2e_s: float
     batch_size: int
     compile_hit: bool
+    # resilience lifecycle: how many retry attempts this request's batch
+    # burned before succeeding, and which sticky degradation rungs
+    # (serve/resilience.py) were active for its executor key
+    retries: int = 0
+    degradations: tuple = ()
 
 
 class RequestQueue:
@@ -109,6 +108,12 @@ class RequestQueue:
     def __len__(self) -> int:
         with self._lock:
             return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        """True once close() ran; a closed queue never admits again."""
+        with self._lock:
+            return self._closed
 
     @property
     def seq(self) -> int:

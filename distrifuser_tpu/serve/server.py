@@ -19,6 +19,18 @@ interleave compiles with execution.  Concurrency lives in the *queue*
 (callers block on futures, not on the mesh) and in the batcher that turns
 queue depth into batch width.
 
+Failures are policy, not luck (serve/resilience.py, configured by
+`ServeConfig.resilience`): build/execute errors are typed
+(serve/errors.py), retried with exponential backoff under a global retry
+budget; a hung batch is bounded by the watchdog and fails without killing
+the scheduler; a key that keeps failing trips its circuit breaker and
+sheds fast with `CircuitOpenError`; OOM/compile failures walk the
+graceful-degradation ladder (split the coalesced batch — bit-identical
+outputs, per-request seeds — then recompile without the step cache, then
+the stepwise loop, then a smaller bucket).  `health()` snapshots the
+whole picture.  A `FaultPlan` (serve/faults.py) can inject any of these
+failures deterministically at the named sites ``"build"``/``"execute"``.
+
 The executor contract (what `executor_factory(key)` must return):
   * ``batch_size`` attribute — the compiled batch width to pad to;
   * ``__call__(prompts, negative_prompts, guidance_scale, seeds) -> list``
@@ -38,16 +50,26 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.config import ServeConfig
 from ..utils.metrics import Counter, LatencyHistogram
-from .batcher import BatchKey, BucketTable, MicroBatcher, NoBucketError
+from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
-from .queue import (
+from .errors import (
+    BuildFailedError,
+    CircuitOpenError,
     DeadlineExceededError,
+    ExecuteFailedError,
+    FatalError,
+    NoBucketError,
     QueueFullError,
-    Request,
-    RequestQueue,
-    ServeResult,
+    ResourceExhaustedError,
+    RetryableError,
+    ServeError,
     ServerClosedError,
+    WatchdogTimeoutError,
+    is_oom,
 )
+from .faults import FaultPlan
+from .queue import Request, RequestQueue, ServeResult
+from .resilience import RUNG_SPLIT, ResilienceEngine, failure_kind
 
 
 class InferenceServer:
@@ -57,6 +79,9 @@ class InferenceServer:
     for a bucket; ``model_id``/``scheduler``/``mesh_plan`` identify the
     served model in cache keys — pass ``distri_config.mesh_plan`` when
     wrapping real pipelines so a mesh change invalidates the cache keys.
+    ``fault_plan`` (chaos/testing) injects failures at sites ``"build"``
+    (around the factory) and ``"execute"`` (inside the watchdog-bounded
+    dispatch).
     """
 
     def __init__(
@@ -68,15 +93,25 @@ class InferenceServer:
         scheduler: str = "ddim",
         mesh_plan: str = "dp1.cfg1.sp1",
         clock: Callable[[], float] = time.monotonic,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         self.config = config or ServeConfig()
         self.model_id = model_id
         self.scheduler = scheduler
         self.mesh_plan = mesh_plan
         self.clock = clock
+        self.fault_plan = fault_plan
         self.queue = RequestQueue(self.config.max_queue_depth)
+        if fault_plan is not None:
+            # the "build" site wraps WHATEVER factory was passed, so fake
+            # and real executors get build faults through one code path
+            def _factory(key, _inner=executor_factory):
+                fault_plan.check("build", key=key)
+                return _inner(key)
+        else:
+            _factory = executor_factory
         self.cache = ExecutorCache(
-            executor_factory, capacity=self.config.cache_capacity
+            _factory, capacity=self.config.cache_capacity
         )
         self.counters = Counter()
         self.hist_queue_wait = LatencyHistogram()
@@ -92,9 +127,18 @@ class InferenceServer:
             batch_window_s=self.config.batch_window_s,
             on_reject=self._reject,
             clock=clock,
+            batch_cap=self._batch_cap_for,
+        )
+        self._stop = threading.Event()
+        self.resilience = ResilienceEngine(
+            self.config.resilience,
+            buckets=self.batcher.table.buckets,
+            clock=clock,
+            # backoff sleeps become stop-interruptible waits: stop() never
+            # waits out a backoff schedule
+            sleep=self._stop.wait,
         )
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -104,8 +148,16 @@ class InferenceServer:
         the configured hot buckets so their compiles happen before the
         first request is admitted."""
         assert self._thread is None, "server already started"
+        if self.queue.closed:
+            # stop() closed the queue for good: a "restarted" server
+            # would be a zombie — scheduler alive, every submit rejected
+            # by the closed queue.  Refuse loudly instead.
+            raise ServerClosedError(
+                "this server was stopped (its queue is closed); build a "
+                "new InferenceServer to serve again"
+            )
         if warmup and self.config.warmup_buckets:
-            self.cache.warmup(self._warmup_keys())
+            self._warmup()
         self._stop.clear()
         self._started = True
         self._thread = threading.Thread(
@@ -115,21 +167,51 @@ class InferenceServer:
         return self
 
     def stop(self, timeout: float = 30.0) -> None:
-        """Graceful shutdown: stop admitting, finish nothing further, fail
-        still-queued futures with `ServerClosedError`."""
+        """Graceful, deterministic shutdown: stop admitting, fail EVERY
+        still-queued future with `ServerClosedError` (including batches
+        the batcher pops after the stop flag is set), interrupt any
+        backoff sleep, and join the scheduler.  The one batch possibly
+        in flight on the mesh completes normally (its wall-time is
+        bounded by the watchdog), so `stop()` returns within roughly
+        ``max(timeout, one batch)`` with no future left unresolved."""
         self._stop.set()
         for req in self.queue.close():
             self.counters.inc("rejected_server_closed")
             self._resolve(req.future, exc=ServerClosedError("server stopped"))
         if self._thread is not None:
             self._thread.join(timeout)
-            self._thread = None
+            if self._thread.is_alive():
+                # still draining a long dispatch: KEEP the handle —
+                # health() must keep reporting scheduler_alive truthfully,
+                # and start()'s assert must refuse to spawn a second
+                # scheduler over the one still owning the mesh
+                self.counters.inc("stop_join_timeouts")
+            else:
+                self._thread = None
+        self._started = False
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def _warmup(self) -> None:
+        """Best-effort warmup prefetch: a failed warmup build must not
+        abort startup ("failures are policy, not luck" applies to minute
+        zero too).  The failure is recorded in metrics and the key's
+        resilience state — the first request for the bucket rebuilds
+        through the full retry/degradation machinery — and the remaining
+        warmup keys still prefetch."""
+        for key in self._warmup_keys():
+            try:
+                self.cache.get(key)
+            except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+                self.counters.inc("warmup_build_failures")
+                self.resilience.on_failure(key, BuildFailedError(
+                    f"warmup build failed for {key.short()}: "
+                    f"{type(exc).__name__}: {exc}"
+                ))
 
     def _warmup_keys(self) -> List[ExecKey]:
         keys = []
@@ -155,6 +237,13 @@ class InferenceServer:
             step_cache_depth=self.config.step_cache_depth,
         )
 
+    def _batch_cap_for(self, key: BatchKey) -> Optional[int]:
+        """Batcher hook: the sticky batch-size ceiling the split_batch
+        degradation learned for this key (None = no cap)."""
+        return self.resilience.batch_cap(
+            self._exec_key_for(key.height, key.width, key.steps, key.cfg)
+        )
+
     # -- submission (any thread) ------------------------------------------
 
     def submit(
@@ -172,9 +261,11 @@ class InferenceServer:
         """Admit one request; returns a Future of `ServeResult`.
 
         Raises `QueueFullError` (backpressure — retry against another
-        replica or later) or `ServerClosedError` immediately; deadline and
-        bucket rejections fail the *future* instead, since they are decided
-        at scheduling time."""
+        replica or later) or `ServerClosedError` immediately; deadline,
+        bucket, circuit-breaker, and execution failures fail the *future*
+        instead, since they are decided at scheduling time.  Every error
+        is a `ServeError`: `RetryableError` means the same request may
+        succeed later/elsewhere, `FatalError` means it cannot."""
         if not self._started or self._stop.is_set():
             raise ServerClosedError("server is not running")
         steps = (self.config.default_steps if num_inference_steps is None
@@ -214,6 +305,10 @@ class InferenceServer:
         except Exception:
             pass  # cancelled/raced future: the caller gave up on it
 
+    def _fail_batch(self, batch: List[Request], exc: Exception) -> None:
+        for req in batch:
+            self._resolve(req.future, exc=exc)
+
     def _reject(self, req: Request, exc: Exception) -> None:
         if isinstance(exc, DeadlineExceededError):
             self.counters.inc("rejected_deadline")
@@ -239,75 +334,241 @@ class InferenceServer:
             if got is None:
                 continue
             key, batch = got
+            if self._stop.is_set():
+                # popped concurrently with stop(): fail deterministically,
+                # exactly like the still-queued futures close() drained
+                self.counters.inc("rejected_server_closed", len(batch))
+                self._fail_batch(batch, ServerClosedError("server stopped"))
+                continue
             try:
                 self._execute(key, batch)
             except Exception as exc:  # noqa: BLE001
                 self.counters.inc("scheduler_errors")
                 traceback.print_exc()
-                for req in batch:
-                    self._resolve(req.future, exc=exc)
+                self._fail_batch(batch, exc)
+
+    # -- the resilient execute path ---------------------------------------
 
     def _execute(self, key: BatchKey, batch: List[Request]) -> None:
         dispatch_ts = self.clock()
-        ekey = self._exec_key_for(key.height, key.width, key.steps, key.cfg)
-        try:
-            executor, hit = self.cache.get(ekey)
-        except Exception as exc:  # build failed: fail the batch, keep serving
-            self.counters.inc("failed_build", len(batch))
-            for req in batch:
-                self._resolve(req.future, exc=exc)
+        base_key = self._exec_key_for(key.height, key.width, key.steps,
+                                      key.cfg)
+        if not self.resilience.allow(base_key):
+            self._shed(base_key, batch)
             return
-        self.counters.inc("batches")
-        self.counters.inc("requests_compile_hit" if hit
-                          else "requests_compile_miss", len(batch))
-        self._batch_sizes.inc(f"size_{len(batch)}")
+        self._execute_resilient(key, base_key, batch, dispatch_ts)
 
+    def _shed(self, ekey: ExecKey, batch: List[Request]) -> None:
+        """Circuit open: fail fast with the 503-style typed error — the
+        whole point is spending O(dispatch) time, not queue/retry time,
+        on a key that keeps failing."""
+        self.counters.inc("shed_circuit_open", len(batch))
+        self._fail_batch(batch, CircuitOpenError(
+            f"circuit open for {ekey.short()}: shedding fast; retry after "
+            f"the {self.config.resilience.breaker_cooldown_s:.1f}s cooldown "
+            "or against another replica"
+        ))
+
+    def _get_executor(self, ekey: ExecKey):
+        """Cache fetch with build failures wrapped into the typed
+        hierarchy (`BuildFailedError`; message keeps the OOM shape
+        visible when the compile itself exhausted memory)."""
+        try:
+            return self.cache.get(ekey)
+        except ServeError:
+            raise
+        except Exception as exc:
+            raise BuildFailedError(
+                f"executor build failed for {ekey.short()}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+
+    def _dispatch(self, ekey: ExecKey, key: BatchKey, executor,
+                  batch: List[Request]):
+        """One watchdog-bounded batched executor invocation; execute
+        failures come back typed (`ResourceExhaustedError` for OOM shapes,
+        `ExecuteFailedError` otherwise, `WatchdogTimeoutError` on hang)."""
         prompts = [r.prompt for r in batch]
         negs = [r.negative_prompt for r in batch]
         seeds = [r.seed for r in batch]
         t0 = self.clock()
+
+        def call():
+            if self.fault_plan is not None:
+                self.fault_plan.check("execute", key=ekey,
+                                      batch_size=len(batch))
+            return executor(prompts, negs, key.guidance_scale, seeds)
+
         try:
-            outputs = executor(prompts, negs, key.guidance_scale, seeds)
+            outputs = self.resilience.watchdog.run(call)
+        except WatchdogTimeoutError:
+            self.counters.inc("watchdog_timeouts")
+            raise
+        except ServeError:
+            raise
         except Exception as exc:
-            self.counters.inc("failed_execute", len(batch))
-            for req in batch:
-                self._resolve(req.future, exc=exc)
-            return
+            if is_oom(exc):
+                raise ResourceExhaustedError(
+                    f"batched execute OOM for {ekey.short()} at batch "
+                    f"{len(batch)}: {exc}"
+                ) from exc
+            raise ExecuteFailedError(
+                f"batched execute failed for {ekey.short()}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
         t1 = self.clock()
         if len(outputs) != len(batch):
-            # contract violation; surfaces via the _loop guard, which fails
-            # the batch's futures and counts a scheduler_error
+            # contract violation, NOT a transient fault: bubbles past the
+            # retry loop to the _loop guard, which fails the batch and
+            # counts a scheduler_error
             raise RuntimeError(
                 f"executor returned {len(outputs)} outputs for a batch of "
                 f"{len(batch)}"
             )
-        exec_s = t1 - t0
-        # shallow-step share: how much of the mesh time the step cache
-        # saved from full network evaluations (0 when the cache is off)
-        self.counters.inc("denoise_steps_total", key.steps * len(batch))
-        shallow = int(getattr(executor, "shallow_steps", 0))
-        if shallow:
-            self.counters.inc("denoise_steps_shallow", shallow * len(batch))
-        for req, out in zip(batch, outputs):
-            queue_wait = dispatch_ts - req.enqueue_ts
-            e2e = t1 - req.enqueue_ts
-            self.hist_queue_wait.observe(queue_wait)
-            self.hist_execute.observe(exec_s)
-            self.hist_e2e.observe(e2e)
-            self.counters.inc("completed")
-            self._resolve(req.future, result=ServeResult(
-                request_id=req.request_id,
-                output=out,
-                bucket=(key.height, key.width),
-                requested_size=(req.height, req.width),
-                queue_wait_s=queue_wait,
-                execute_s=exec_s,
-                e2e_s=e2e,
-                batch_size=len(batch),
-                compile_hit=hit,
-            ))
+        return outputs, t0, t1
+
+    def _execute_resilient(self, key: BatchKey, base_key: ExecKey,
+                           batch: List[Request], dispatch_ts: float) -> None:
+        """Bounded retry loop around (build -> dispatch) with the
+        degradation ladder on OOM/compile failures.  Splitting recurses
+        with fresh attempt budgets (depth is bounded by log2(batch));
+        every retry anywhere draws from the global retry budget."""
+        res = self.resilience
+        rcfg = self.config.resilience
+        attempts = 0
+        while True:
+            if self._stop.is_set():
+                self.counters.inc("rejected_server_closed", len(batch))
+                self._fail_batch(batch, ServerClosedError("server stopped"))
+                return
+            ekey = res.degraded_key(base_key)
+            try:
+                executor, hit = self._get_executor(ekey)
+                outputs, t0, t1 = self._dispatch(ekey, key, executor, batch)
+            except FatalError as exc:
+                res.on_failure(base_key, exc)
+                self.counters.inc("failed_fatal", len(batch))
+                self._fail_batch(batch, exc)
+                return
+            except RetryableError as exc:
+                # attempt-level: observability only — the breaker counts
+                # TERMINAL dispatch failures (below), so exhausting
+                # max_retries and tripping the circuit stay separately
+                # tuned policies
+                res.note_error(base_key, exc)
+                kind = failure_kind(exc)
+                failed_counter = ("failed_build"
+                                  if isinstance(exc, BuildFailedError)
+                                  else "failed_execute")
+                if kind in ("oom", "compile"):
+                    rung = res.degrade(base_key, kind, len(batch))
+                    if rung == RUNG_SPLIT:
+                        if not res.acquire_retry():
+                            self.counters.inc("retry_budget_exhausted")
+                            self.counters.inc(failed_counter, len(batch))
+                            res.record_terminal_failure(base_key)
+                            self._fail_batch(batch, exc)
+                            return
+                        self.counters.inc("retries")
+                        self.counters.inc("degraded_split_batch")
+                        mid = (len(batch) + 1) // 2
+                        self._execute_resilient(key, base_key, batch[:mid],
+                                                dispatch_ts)
+                        self._execute_resilient(key, base_key, batch[mid:],
+                                                dispatch_ts)
+                        return
+                    if rung is not None:
+                        self.counters.inc("degraded_" + rung)
+                        # the poisoned program must not satisfy the retry
+                        self.cache.invalidate(ekey)
+                attempts += 1
+                if attempts > rcfg.max_retries:
+                    self.counters.inc(failed_counter, len(batch))
+                    res.record_terminal_failure(base_key)
+                    self._fail_batch(batch, exc)
+                    return
+                if not res.acquire_retry():
+                    self.counters.inc("retry_budget_exhausted")
+                    self.counters.inc(failed_counter, len(batch))
+                    res.record_terminal_failure(base_key)
+                    self._fail_batch(batch, exc)
+                    return
+                self.counters.inc("retries")
+                res.sleep(res.backoff_delay(attempts))
+                continue
+            except Exception as exc:
+                # non-ServeError escape (executor contract violation):
+                # destined for the _loop guard, but the breaker must still
+                # see it — a HALF_OPEN probe that dies this way would
+                # otherwise leave the probe-inflight latch set forever,
+                # permanently shedding the key with no healing path
+                res.on_failure(base_key, exc)
+                raise
+            # ---- success ------------------------------------------------
+            res.on_success(base_key)
+            self.counters.inc("batches")
+            self.counters.inc("requests_compile_hit" if hit
+                              else "requests_compile_miss", len(batch))
+            self._batch_sizes.inc(f"size_{len(batch)}")
+            exec_s = t1 - t0
+            # shallow-step share: how much of the mesh time the step cache
+            # saved from full network evaluations (0 when the cache is off)
+            self.counters.inc("denoise_steps_total", key.steps * len(batch))
+            shallow = int(getattr(executor, "shallow_steps", 0))
+            if shallow:
+                self.counters.inc("denoise_steps_shallow",
+                                  shallow * len(batch))
+            degradations = tuple(res.key_state(base_key).rungs)
+            for req, out in zip(batch, outputs):
+                queue_wait = dispatch_ts - req.enqueue_ts
+                e2e = t1 - req.enqueue_ts
+                self.hist_queue_wait.observe(queue_wait)
+                self.hist_execute.observe(exec_s)
+                self.hist_e2e.observe(e2e)
+                self.counters.inc("completed")
+                if req.expired(t1):
+                    # deadline lapsed while IN FLIGHT: deadlines gate
+                    # scheduling, never abandon mesh work — the caller
+                    # still gets the result, and the lateness is counted
+                    self.counters.inc("completed_late")
+                self._resolve(req.future, result=ServeResult(
+                    request_id=req.request_id,
+                    output=out,
+                    bucket=(ekey.height, ekey.width),
+                    requested_size=(req.height, req.width),
+                    queue_wait_s=queue_wait,
+                    execute_s=exec_s,
+                    e2e_s=e2e,
+                    batch_size=len(batch),
+                    compile_hit=hit,
+                    retries=attempts,
+                    degradations=degradations,
+                ))
+            return
 
     # -- observability -----------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness/readiness snapshot (docs/SERVING.md schema): queue
+        depth, scheduler liveness, per-key circuit states, active
+        degradations, retry budget, and the most recent errors."""
+        res = self.resilience.snapshot()
+        c = self.counters.snapshot()
+        degraded = bool(res["open_circuits"] or res["degradations"])
+        return {
+            "status": "degraded" if degraded else "ok",
+            "queue_depth": len(self.queue),
+            "scheduler_alive": bool(self._thread is not None
+                                    and self._thread.is_alive()),
+            "requests": {
+                k: c.get(k, 0)
+                for k in ("submitted", "completed", "completed_late",
+                          "retries", "shed_circuit_open",
+                          "watchdog_timeouts", "failed_build",
+                          "failed_execute", "scheduler_errors")
+            },
+            **res,
+        }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """JSON-friendly service metrics — the serve artifact schema
@@ -348,6 +609,7 @@ class InferenceServer:
                 "mean": (n_reqs / n_batches) if n_batches else 0.0,
             },
             "cache": self.cache.stats(),
+            "resilience": self.resilience.snapshot(),
         }
 
     def export_metrics(self, path: str) -> Dict[str, Any]:

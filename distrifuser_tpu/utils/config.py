@@ -382,6 +382,123 @@ DEFAULT_BUCKETS = (
 
 
 @dataclasses.dataclass
+class ResilienceConfig:
+    """Failure-handling policy for the serve layer (serve/resilience.py);
+    lives beside ServeConfig so one module owns every run-shaping knob.
+
+    Retry/backoff:
+      * ``max_retries`` — extra attempts per batch dispatch beyond the
+        first (0 disables in-server retries).
+      * ``retry_budget`` — GLOBAL retry token bucket across all requests;
+        when a correlated failure storm empties it, failures surface
+        immediately instead of amplifying load.
+        ``retry_budget_refill_per_s`` trickles tokens back (up to the
+        bucket size) so routine transient blips over days of uptime never
+        permanently strip a long-lived server of retries; 0 makes the
+        budget a strict lifetime cap.
+      * ``backoff_*`` — exponential schedule between attempts:
+        ``min(base * multiplier**n, max)`` with ± ``jitter`` fraction of
+        seeded randomness (``seed``).
+
+    Circuit breaking (per compiled-executor key):
+      * ``breaker_failure_threshold`` consecutive TERMINAL dispatch
+        failures (a batch whose retries were exhausted, a fatal error, a
+        contract violation — never an individual retried attempt) trip
+        the key's breaker OPEN; requests for it shed fast with
+        `CircuitOpenError` (503-style) instead of burning queue time.
+      * ``breaker_cooldown_s`` later the breaker goes HALF_OPEN and lets
+        one probe batch through; success closes it, failure re-opens.
+
+    Watchdog:
+      * ``watchdog_timeout_s`` — wall-time bound on one batch execution;
+        a hung batch fails with `WatchdogTimeoutError` (and is retried)
+        while the scheduler thread keeps serving.  0 disables.
+
+    Degradation ladder (OOM / compile failure, serve/resilience.py):
+      * ``allow_batch_split`` — halve an OOM'd coalesced batch and retry
+        the halves (bit-identical outputs: per-request seeded latents).
+      * ``allow_step_cache_off`` — recompile the bucket without the
+        temporal step-cache cadence.
+      * ``allow_stepwise_fallback`` — swap the fused scan for the
+        host-driven stepwise loop (same numerics, far smaller program).
+      * ``allow_bucket_fallback`` — serve at the next smaller bucket;
+        OFF by default because it changes the output-resolution contract.
+      * ``max_degradations`` — cap on sticky per-key rungs.
+    """
+
+    max_retries: int = 2
+    retry_budget: int = 10_000
+    retry_budget_refill_per_s: float = 1.0
+    backoff_base_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.1
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
+    watchdog_timeout_s: float = 120.0
+    max_degradations: int = 3
+    # LRU bound on per-key resilience state (breakers, degradation rungs):
+    # ExecKey space is request-controlled, so tracked keys — and the
+    # health payload serializing them — must not grow one entry per
+    # distinct key ever seen.  Eviction prefers closed/undegraded state.
+    max_tracked_keys: int = 256
+    allow_batch_split: bool = True
+    allow_step_cache_off: bool = True
+    allow_stepwise_fallback: bool = True
+    allow_bucket_fallback: bool = False
+    last_errors_capacity: int = 16
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.retry_budget < 0:
+            raise ValueError(
+                f"retry_budget must be >= 0, got {self.retry_budget}"
+            )
+        if self.retry_budget_refill_per_s < 0:
+            raise ValueError(
+                "retry_budget_refill_per_s must be >= 0, got "
+                f"{self.retry_budget_refill_per_s}"
+            )
+        if self.backoff_base_s < 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError(
+                "need 0 <= backoff_base_s <= backoff_max_s, got "
+                f"base={self.backoff_base_s}, max={self.backoff_max_s}"
+            )
+        if self.backoff_multiplier < 1.0:
+            raise ValueError(
+                f"backoff_multiplier must be >= 1, got {self.backoff_multiplier}"
+            )
+        if not (0.0 <= self.backoff_jitter < 1.0):
+            raise ValueError(
+                f"backoff_jitter must be in [0, 1), got {self.backoff_jitter}"
+            )
+        if self.breaker_failure_threshold < 1:
+            raise ValueError(
+                "breaker_failure_threshold must be >= 1, got "
+                f"{self.breaker_failure_threshold}"
+            )
+        if self.breaker_cooldown_s < 0:
+            raise ValueError(
+                f"breaker_cooldown_s must be >= 0, got {self.breaker_cooldown_s}"
+            )
+        if self.max_degradations < 0:
+            raise ValueError(
+                f"max_degradations must be >= 0, got {self.max_degradations}"
+            )
+        if self.max_tracked_keys < 1:
+            raise ValueError(
+                f"max_tracked_keys must be >= 1, got {self.max_tracked_keys}"
+            )
+        if self.last_errors_capacity < 1:
+            raise ValueError(
+                "last_errors_capacity must be >= 1, got "
+                f"{self.last_errors_capacity}"
+            )
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Configuration block for ``distrifuser_tpu.serve`` (the long-lived
     inference service).  Kept here, beside DistriConfig, so one module owns
@@ -429,6 +546,12 @@ class ServeConfig:
     # DistriConfig with the same knobs.
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    # Failure handling: retries/backoff, per-key circuit breakers, the
+    # execution watchdog, and the graceful-degradation ladder — see
+    # ResilienceConfig above and docs/SERVING.md "Failure modes & tuning".
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -468,3 +591,8 @@ class ServeConfig:
                 )
             warm.append(tuple(int(x) for x in b))
         self.warmup_buckets = tuple(warm)
+        if not isinstance(self.resilience, ResilienceConfig):
+            raise ValueError(
+                "resilience must be a ResilienceConfig, got "
+                f"{type(self.resilience).__name__}"
+            )

@@ -362,6 +362,47 @@ class Counter:
             return dict(sorted(self._c.items()))
 
 
+class RingLog:
+    """Bounded ring of recent event strings (newest last).
+
+    Backs the serve layer's ``last_errors`` health field: a service that
+    has failed a million times must still answer "what went wrong
+    *lately*" in O(capacity) memory.  Entries carry a monotonically
+    increasing sequence number so a reader can tell two snapshots apart
+    even when the ring content looks identical.  Locked for the same
+    reason as `Counter` (scheduler + watchdog + snapshot threads)."""
+
+    def __init__(self, capacity: int = 16):
+        import threading
+        from collections import deque
+
+        assert capacity >= 1, capacity
+        self.capacity = capacity
+        self._items = deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def add(self, message: str) -> None:
+        with self._lock:
+            self._seq += 1
+            self._items.append((self._seq, str(message)))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def total(self) -> int:
+        """How many events were EVER added (>= len, which is bounded)."""
+        with self._lock:
+            return self._seq
+
+    def snapshot(self) -> list:
+        """JSON-friendly ``[{"seq": n, "message": s}, ...]``, oldest first."""
+        with self._lock:
+            return [{"seq": n, "message": m} for n, m in self._items]
+
+
 def fid_between_dirs(
     root0: str,
     root1: str,

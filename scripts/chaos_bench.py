@@ -1,0 +1,236 @@
+"""Chaos benchmark: serve_bench-style load under a seeded fault plan.
+
+Drives the real `InferenceServer` scheduler (weightless fake executors —
+scheduler + resilience behavior only, runs anywhere in seconds) through
+two phases and emits ONE parseable JSON line (bench.py convention; full
+artifact via --out):
+
+1. **Mixed-fault load** — the reference fault plan: seeded
+   ``compile_error`` (build site), ``execute_error`` and ``hang``
+   (execute site) at ``--fault-p`` each (default 10%).  Reports
+   availability over admitted requests, e2e p99, retry/shed/watchdog
+   counts, and whether the scheduler thread survived.
+2. **Poisoned-key shed** — a bucket whose executes ALWAYS fail.  After
+   the circuit breaker trips, every further request for that bucket must
+   shed fast (`CircuitOpenError`); the phase reports how long post-trip
+   requests spent before resolution (the "< 1s of queue time" bound).
+
+Exit code 0 iff the scheduler survived both phases, phase-1 availability
+met ``--min-availability``, and post-trip poisoned requests resolved
+within ``--max-shed-s``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from distrifuser_tpu.serve import (  # noqa: E402
+    CircuitOpenError,
+    FaultPlan,
+    FaultRule,
+    InferenceServer,
+    ResilienceConfig,
+    ServeConfig,
+)
+from distrifuser_tpu.serve.testing import FakeExecutorFactory  # noqa: E402
+
+import serve_bench  # noqa: E402  (shared load driver — 1:1 comparable runs)
+
+
+def _serve_config(args, *, breaker_threshold: int) -> ServeConfig:
+    return ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=0.01,
+        buckets=((512, 512), (1024, 1024), (1024, 2048), (2048, 2048)),
+        warmup_buckets=((512, 512, args.steps),),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        resilience=ResilienceConfig(
+            max_retries=args.max_retries,
+            backoff_base_s=0.01,
+            backoff_max_s=0.1,
+            backoff_jitter=0.1,
+            breaker_failure_threshold=breaker_threshold,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            watchdog_timeout_s=args.watchdog_s,
+            seed=args.seed,
+        ),
+    )
+
+
+def run_mixed_phase(args) -> dict:
+    plan = FaultPlan([
+        FaultRule(site="build", kind="compile_error", p=args.fault_p),
+        FaultRule(site="execute", kind="execute_error", p=args.fault_p),
+        FaultRule(site="execute", kind="hang", p=args.fault_p,
+                  hang_s=args.hang_s),
+    ], seed=args.seed)
+    # the breaker counts TERMINAL dispatch failures (retries exhausted),
+    # not attempts, so a plain threshold of 3 is already storm-safe here
+    config = _serve_config(args, breaker_threshold=3)
+    factory = FakeExecutorFactory(batch_size=args.max_batch_size,
+                                  step_time_s=0.002)
+    load_args = argparse.Namespace(
+        mode="closed", requests=args.requests, concurrency=args.concurrency,
+        ttl_s=args.ttl_s, steps=args.steps, seed=args.seed,
+    )
+    server = InferenceServer(factory, config, model_id="chaos",
+                             scheduler="ddim", mesh_plan="dp1.cfg1.sp1",
+                             fault_plan=plan)
+    with server:
+        load = serve_bench.run_load(server, load_args)
+        metrics = server.metrics_snapshot()
+        health = server.health()
+    return {
+        "load": load,
+        "metrics": metrics,
+        "health": health,
+        "faults_fired": plan.fired(),
+    }
+
+
+def run_poison_phase(args) -> dict:
+    """A permanently-poisoned bucket: every execute for 1024x1024 fails.
+    Measures how quickly requests resolve once the breaker is open."""
+    plan = FaultPlan([
+        FaultRule(site="execute", kind="execute_error", p=1.0,
+                  key_substr="1024x1024"),
+    ], seed=args.seed)
+    # two terminally-failed requests trip the poisoned bucket; the
+    # remaining six must shed fast
+    config = _serve_config(args, breaker_threshold=2)
+    factory = FakeExecutorFactory(batch_size=args.max_batch_size,
+                                  step_time_s=0.002)
+    server = InferenceServer(factory, config, model_id="chaos",
+                             scheduler="ddim", mesh_plan="dp1.cfg1.sp1",
+                             fault_plan=plan)
+    timings, outcomes = [], []
+    n_poison = 8
+    with server:
+        # healthy bucket sanity request
+        server.submit("healthy", height=512, width=512).result(timeout=30)
+        for i in range(n_poison):
+            t0 = time.monotonic()
+            f = server.submit(f"poisoned #{i}", height=1024, width=1024,
+                              seed=i)
+            try:
+                f.result(timeout=30)
+                outcomes.append("completed")
+            except CircuitOpenError:
+                outcomes.append("shed")
+            except Exception as exc:  # noqa: BLE001
+                outcomes.append(type(exc).__name__)
+            timings.append(time.monotonic() - t0)
+        # the healthy bucket must be unaffected by the poisoned one
+        healthy_after = server.submit(
+            "healthy again", height=512, width=512).result(timeout=30)
+        health = server.health()
+    shed_times = [t for t, o in zip(timings, outcomes) if o == "shed"]
+    return {
+        "outcomes": outcomes,
+        "per_request_s": [round(t, 4) for t in timings],
+        "shed_count": outcomes.count("shed"),
+        "shed_max_s": max(shed_times) if shed_times else None,
+        "healthy_bucket_survived": healthy_after.output is not None,
+        "open_circuits": health["open_circuits"],
+        "scheduler_alive": health["scheduler_alive"],
+        "faults_fired": plan.fired(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--max_batch_size", type=int, default=4)
+    ap.add_argument("--max_queue_depth", type=int, default=64)
+    ap.add_argument("--ttl_s", type=float, default=30.0)
+    ap.add_argument("--fault-p", type=float, default=0.1,
+                    help="per-call fire probability of each fault rule")
+    # hang ~2.7x the watchdog: the hung dispatch is abandoned at one
+    # watchdog period, the retry serializes behind it for another, and
+    # the third attempt finds the mesh drained with margin to spare
+    ap.add_argument("--hang-s", type=float, default=0.8,
+                    help="how long an injected hang stalls")
+    ap.add_argument("--watchdog-s", type=float, default=0.3,
+                    help="batch execution wall-time bound")
+    # a hang consumes ~2 attempts (the abandonment + the serialize-behind-
+    # abandoned shed) before the drained mesh can even be retried, so the
+    # per-batch attempt budget must absorb a hang FOLLOWED by more faults
+    # without failing the batch: at 10% fault rates, 8 retries puts a
+    # batch's residual failure probability well under the 1% gate
+    ap.add_argument("--max-retries", type=int, default=8)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-availability", type=float, default=0.99,
+                    help="phase-1 gate (0 disables)")
+    ap.add_argument("--max-shed-s", type=float, default=1.0,
+                    help="phase-2 gate: slowest post-trip poisoned request")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the full JSON artifact here")
+    args = ap.parse_args(argv)
+
+    mixed = run_mixed_phase(args)
+    poison = run_poison_phase(args)
+
+    load = mixed["load"]
+    reqs = mixed["metrics"]["requests"]
+    health = mixed["health"]
+    availability = load["availability"]
+    shed_ok = (poison["shed_count"] > 0
+               and (poison["shed_max_s"] or 0) <= args.max_shed_s)
+    ok = (health["scheduler_alive"] and poison["scheduler_alive"]
+          and poison["healthy_bucket_survived"]
+          and availability >= args.min_availability
+          and shed_ok)
+
+    artifact = {
+        "bench": {
+            "fault_p": args.fault_p,
+            "hang_s": args.hang_s,
+            "watchdog_s": args.watchdog_s,
+            "max_retries": args.max_retries,
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "seed": args.seed,
+        },
+        "mixed": mixed,
+        "poison": poison,
+        "ok": ok,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    # bench.py contract: one parseable summary line on stdout
+    print(json.dumps({
+        "metric": "chaos_availability",
+        "value": round(availability, 4),
+        "unit": "fraction",
+        "completed": load["completed"],
+        "failed": load["failed_or_rejected_late"],
+        "p99_e2e_s": mixed["metrics"]["latency_s"]["e2e"].get("p99"),
+        "retries": reqs.get("retries", 0),
+        "shed_circuit_open": reqs.get("shed_circuit_open", 0)
+        + poison["shed_count"],
+        "watchdog_timeouts": reqs.get("watchdog_timeouts", 0),
+        "scheduler_alive": bool(health["scheduler_alive"]
+                                and poison["scheduler_alive"]),
+        "poison_shed_max_s": poison["shed_max_s"],
+        "faults_fired": mixed["faults_fired"],
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,19 +170,28 @@ def run_load(server: InferenceServer, args) -> dict:
             time.sleep(interval)
 
     completed, failed = 0, 0
+    failures_by_type = {}
     for f in futures:
         try:
             f.result(timeout=args.ttl_s + 60)
             completed += 1
-        except Exception:
+        except Exception as exc:
             failed += 1
+            t = type(exc).__name__
+            failures_by_type[t] = failures_by_type.get(t, 0) + 1
     wall = time.monotonic() - t_start
+    admitted = len(futures)
     return {
         "wall_s": wall,
-        "submitted": len(futures) + rejected["queue_full"],
+        "submitted": admitted + rejected["queue_full"],
         "completed": completed,
         "failed_or_rejected_late": failed,
+        "failures_by_type": dict(sorted(failures_by_type.items())),
         "rejected_queue_full": rejected["queue_full"],
+        # availability over ADMITTED requests: 429 backpressure is the load
+        # balancer's signal, not a service failure — chaos and clean runs
+        # compare on the same denominator
+        "availability": (completed / admitted) if admitted else 1.0,
         "throughput_rps": completed / wall if wall > 0 else 0.0,
     }
 
@@ -275,12 +284,20 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)
             f.write("\n")
-    # bench.py contract: one parseable summary line on stdout
+    # bench.py contract: one parseable summary line on stdout.  Failure,
+    # retry, and shed counts ride along so chaos_bench.py runs (same load
+    # driver, a fault plan underneath) compare 1:1 with clean runs.
+    reqs = metrics["requests"]
     print(json.dumps({
         "metric": f"serve_{args.mode}_loop_throughput",
         "value": round(load["throughput_rps"], 3),
         "unit": "requests/s",
         "completed": load["completed"],
+        "failed": load["failed_or_rejected_late"],
+        "availability": round(load["availability"], 4),
+        "retries": reqs.get("retries", 0),
+        "shed_circuit_open": reqs.get("shed_circuit_open", 0),
+        "watchdog_timeouts": reqs.get("watchdog_timeouts", 0),
         "rejected_queue_full": load["rejected_queue_full"],
         "cache_hit_rate": round(metrics["cache"]["hit_rate"], 3),
         "mean_batch_size": round(metrics["batch_size"]["mean"], 3),

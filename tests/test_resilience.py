@@ -1,0 +1,842 @@
+"""Resilience-layer tests (serve/errors.py, serve/faults.py,
+serve/resilience.py + their server integration): typed errors, backoff
+schedule math (injected clock/seed — no sleeps), circuit transitions,
+watchdog, deterministic fault injection, batch-split bit-identity, and
+degradation-ladder ordering.  Weightless fakes only — no devices, no
+compiles; the real-pipeline adapter path is covered by
+test_serve_pipeline.py."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.serve import (
+    BackoffPolicy,
+    BuildFailedError,
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceededError,
+    DegradationLadder,
+    ExecKey,
+    ExecuteFailedError,
+    ExecutorCache,
+    FatalError,
+    FaultPlan,
+    FaultRule,
+    InferenceServer,
+    NoBucketError,
+    QueueFullError,
+    ResilienceConfig,
+    ResourceExhaustedError,
+    RetryBudget,
+    RetryableError,
+    ServeConfig,
+    ServeError,
+    ServerClosedError,
+    Watchdog,
+    WatchdogTimeoutError,
+)
+from distrifuser_tpu.serve.faults import (
+    InjectedCompileError,
+    InjectedExecuteError,
+    InjectedFault,
+    InjectedResourceExhausted,
+)
+from distrifuser_tpu.serve.resilience import (
+    RUNG_BUCKET,
+    RUNG_SPLIT,
+    RUNG_STEP_CACHE_OFF,
+    RUNG_STEPWISE,
+    KeyResilience,
+    failure_kind,
+)
+from distrifuser_tpu.serve.testing import FakeExecutor, FakeExecutorFactory, fake_image
+from distrifuser_tpu.utils.metrics import RingLog
+
+
+def key_for(h=512, w=512, steps=4, **kw):
+    kw.setdefault("model_id", "m")
+    kw.setdefault("scheduler", "ddim")
+    kw.setdefault("cfg", True)
+    kw.setdefault("mesh_plan", "dp1.cfg1.sp1")
+    return ExecKey(height=h, width=w, steps=steps, **kw)
+
+
+def serve_config(**kw):
+    kw.setdefault("max_queue_depth", 16)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_window_s", 0.2)
+    kw.setdefault("buckets", ((512, 512), (1024, 1024)))
+    kw.setdefault("default_steps", 4)
+    kw.setdefault("resilience", fast_resilience())
+    return ServeConfig(**kw)
+
+
+def fast_resilience(**kw):
+    kw.setdefault("max_retries", 2)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.002)
+    kw.setdefault("backoff_jitter", 0.0)
+    kw.setdefault("breaker_failure_threshold", 3)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    kw.setdefault("watchdog_timeout_s", 5.0)
+    return ResilienceConfig(**kw)
+
+
+# --------------------------------------------------------------------------
+# typed error hierarchy
+# --------------------------------------------------------------------------
+
+
+def test_error_hierarchy_retryable_vs_fatal():
+    for cls in (QueueFullError, CircuitOpenError, WatchdogTimeoutError,
+                BuildFailedError, ExecuteFailedError, ResourceExhaustedError):
+        assert issubclass(cls, RetryableError), cls
+        assert not issubclass(cls, FatalError), cls
+    for cls in (DeadlineExceededError, ServerClosedError, NoBucketError):
+        assert issubclass(cls, FatalError), cls
+        assert not issubclass(cls, RetryableError), cls
+    assert issubclass(ResourceExhaustedError, ExecuteFailedError)
+    for cls in (RetryableError, FatalError):
+        assert issubclass(cls, ServeError)
+
+
+def test_failure_kind_classification():
+    assert failure_kind(ResourceExhaustedError("RESOURCE_EXHAUSTED")) == "oom"
+    assert failure_kind(
+        ExecuteFailedError("RESOURCE_EXHAUSTED: oom-shaped message")) == "oom"
+    # build failures are "compile" even when memory-shaped: the remedy is
+    # a cheaper program, not a narrower batch
+    assert failure_kind(
+        BuildFailedError("RESOURCE_EXHAUSTED during compile")) == "compile"
+    assert failure_kind(ExecuteFailedError("boom")) == "transient"
+    assert failure_kind(WatchdogTimeoutError("hung")) == "transient"
+    assert failure_kind(DeadlineExceededError("late")) == "fatal"
+
+
+# --------------------------------------------------------------------------
+# backoff schedule math (no sleeps)
+# --------------------------------------------------------------------------
+
+
+def test_backoff_schedule_exponential_and_capped():
+    p = BackoffPolicy(base_s=0.1, multiplier=2.0, max_s=0.5, jitter=0.0)
+    assert p.schedule(5) == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_jitter_bounded_and_deterministic():
+    a = BackoffPolicy(0.1, 2.0, 10.0, jitter=0.25, seed=7)
+    b = BackoffPolicy(0.1, 2.0, 10.0, jitter=0.25, seed=7)
+    sa, sb = a.schedule(50), b.schedule(50)
+    assert sa == sb  # seeded: identical streams
+    for i, d in enumerate(sa):
+        nominal = min(0.1 * 2.0 ** i, 10.0)
+        assert nominal * 0.75 <= d <= nominal * 1.25
+    c = BackoffPolicy(0.1, 2.0, 10.0, jitter=0.25, seed=8)
+    assert c.schedule(50) != sa  # different seed, different jitter
+
+
+def test_retry_budget_exhausts():
+    b = RetryBudget(2)  # refill_per_s=0: strict lifetime cap
+    assert b.acquire() and b.acquire()
+    assert not b.acquire()
+    assert b.remaining == 0
+
+
+def test_retry_budget_refills_on_injected_clock():
+    t = [0.0]
+    b = RetryBudget(2, refill_per_s=0.5, clock=lambda: t[0])
+    assert b.acquire() and b.acquire() and not b.acquire()
+    t[0] = 1.0  # 0.5 tokens accrued: still under one whole token
+    assert not b.acquire()
+    t[0] = 2.0  # 1.0 token
+    assert b.acquire() and not b.acquire()
+    t[0] = 100.0  # refill clamps at the bucket size
+    assert b.remaining == 2
+    assert b.acquire() and b.acquire() and not b.acquire()
+
+
+# --------------------------------------------------------------------------
+# circuit breaker (injected clock — no sleeps)
+# --------------------------------------------------------------------------
+
+
+def test_circuit_closed_open_half_open_close():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                        clock=lambda: t[0])
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "closed" and br.allow()  # below threshold
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    t[0] = 9.9
+    assert not br.allow()  # cooldown not elapsed
+    t[0] = 10.0
+    assert br.state() == "half_open"
+    assert br.allow()  # the single probe
+    assert not br.allow()  # second caller sheds while probe in flight
+    br.record_success()
+    assert br.state() == "closed" and br.allow()
+    assert br.times_opened == 1
+
+
+def test_circuit_failed_probe_reopens():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    assert br.state() == "open"
+    t[0] = 5.0
+    assert br.allow()  # probe
+    br.record_failure()  # probe failed
+    assert br.state() == "open" and not br.allow()
+    t[0] = 9.9  # cooldown re-armed at t=5
+    assert not br.allow()
+    t[0] = 10.0
+    assert br.allow()
+    br.record_success()
+    assert br.state() == "closed"
+    assert br.snapshot()["times_opened"] == 2
+
+
+def test_success_resets_consecutive_failures():
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=1.0)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state() == "closed"  # never 3 consecutive
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+
+
+def test_watchdog_passes_result_and_exceptions_through():
+    wd = Watchdog(timeout_s=5.0)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(ValueError, match="inner"):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("inner")))
+    assert wd.timeouts == 0
+
+
+def test_watchdog_fires_on_hang_without_blocking():
+    wd = Watchdog(timeout_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeoutError):
+        wd.run(lambda: time.sleep(0.5))
+    assert time.monotonic() - t0 < 0.4  # did NOT wait out the hang
+    assert wd.timeouts == 1
+
+
+def test_watchdog_disabled_runs_inline():
+    wd = Watchdog(timeout_s=0.0)
+    tid = wd.run(lambda: threading.get_ident())
+    assert tid == threading.get_ident()
+
+
+def test_watchdog_serializes_behind_abandoned_worker():
+    """A retry after an abandonment must never overlap the stuck call's
+    work: the next run() waits for the abandoned worker to drain (and
+    sheds if it doesn't), so the mesh sees one dispatch at a time."""
+    wd = Watchdog(timeout_s=0.15)
+    active = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def tracked(extra_s):
+        def fn():
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(extra_s)
+            with lock:
+                active[0] -= 1
+            return "done"
+        return fn
+
+    with pytest.raises(WatchdogTimeoutError):
+        wd.run(tracked(0.25))  # abandoned at 0.15, drains at 0.25 — well
+        # inside the retry's 0.15s grace window (not at its boundary)
+    # retry while the abandoned worker still runs: waits for it, then
+    # executes — never concurrently (peak stays 1)
+    assert wd.run(tracked(0.0)) == "done"
+    assert peak[0] == 1
+    # a still-stuck abandoned worker sheds the next dispatch instead
+    wd2 = Watchdog(timeout_s=0.05)
+    with pytest.raises(WatchdogTimeoutError):
+        wd2.run(tracked(10.0))
+    with pytest.raises(WatchdogTimeoutError, match="abandoned"):
+        wd2.run(tracked(0.0))
+    assert wd2.timeouts == 2
+
+
+# --------------------------------------------------------------------------
+# fault plan: determinism and filters
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_at_calls_exact():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                at_calls=(1, 3))])
+    fired = []
+    for i in range(5):
+        try:
+            plan.check("execute")
+            fired.append(False)
+        except InjectedExecuteError:
+            fired.append(True)
+    assert fired == [False, True, False, True, False]
+    assert plan.fired() == {"execute/execute_error": 2}
+
+
+def test_fault_plan_seeded_probability_is_deterministic():
+    def pattern(seed):
+        plan = FaultPlan([FaultRule(site="execute", kind="oom", p=0.3)],
+                         seed=seed)
+        out = []
+        for _ in range(100):
+            try:
+                plan.check("execute")
+                out.append(0)
+            except InjectedResourceExhausted:
+                out.append(1)
+        return out
+
+    a, b, c = pattern(0), pattern(0), pattern(1)
+    assert a == b
+    assert a != c
+    assert 10 < sum(a) < 60  # p=0.3 over 100 calls, loose bounds
+
+
+def test_fault_plan_min_batch_and_max_fires():
+    plan = FaultPlan([FaultRule(site="execute", kind="oom", p=1.0,
+                                min_batch=3, max_fires=2)])
+    plan.check("execute", batch_size=2)  # below min_batch: no fire
+    for _ in range(2):
+        with pytest.raises(InjectedResourceExhausted):
+            plan.check("execute", batch_size=4)
+    plan.check("execute", batch_size=4)  # max_fires exhausted
+    assert plan.fired() == {"execute/oom": 2}
+
+
+def test_fault_plan_key_substr_filter():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error", p=1.0,
+                                key_substr="1024x1024")])
+    plan.check("execute", key=key_for(512, 512))  # no match, no fire
+    with pytest.raises(InjectedExecuteError):
+        plan.check("execute", key=key_for(1024, 1024))
+
+
+def test_injected_oom_is_oom_shaped():
+    from distrifuser_tpu.serve.errors import is_oom
+
+    exc = pytest.raises(InjectedResourceExhausted, FaultPlan(
+        [FaultRule(site="s", kind="oom", p=1.0)]).check, "s").value
+    assert is_oom(exc)
+    assert isinstance(exc, InjectedFault)
+
+
+def test_fault_rule_validation():
+    with pytest.raises(ValueError, match="fault kind"):
+        FaultRule(site="s", kind="nope", p=0.5)
+    with pytest.raises(ValueError, match="probability"):
+        FaultRule(site="s", kind="oom", p=1.5)
+    with pytest.raises(ValueError, match="never fire"):
+        FaultRule(site="s", kind="oom")
+
+
+# --------------------------------------------------------------------------
+# degradation ladder: ordering (pure math)
+# --------------------------------------------------------------------------
+
+
+def ladder(**kw):
+    buckets = kw.pop("buckets", ((512, 512), (1024, 1024)))
+    return DegradationLadder(fast_resilience(**kw), buckets)
+
+
+def test_ladder_oom_splits_first():
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    lad = ladder()
+    assert lad.next_rung(st, "oom", key_for(), batch_size=4) == RUNG_SPLIT
+    # singletons cannot split: first key rung instead
+    k = key_for(step_cache_interval=2, step_cache_depth=1)
+    assert lad.next_rung(st, "oom", k, batch_size=1) == RUNG_STEP_CACHE_OFF
+
+
+def test_ladder_compile_never_splits():
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    k = key_for(step_cache_interval=2, step_cache_depth=1)
+    assert ladder().next_rung(st, "compile", k,
+                              batch_size=4) == RUNG_STEP_CACHE_OFF
+
+
+def test_ladder_ordering_cache_off_then_stepwise_then_bucket():
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    lad = ladder(allow_bucket_fallback=True)
+    k = key_for(1024, 1024, step_cache_interval=2, step_cache_depth=1)
+    order = []
+    for _ in range(5):
+        rung = lad.next_rung(st, "compile", k, batch_size=1)
+        if rung is None:
+            break
+        st.rungs.append(rung)
+        order.append(rung)
+    assert order == [RUNG_STEP_CACHE_OFF, RUNG_STEPWISE, RUNG_BUCKET]
+    dk = lad.apply(k, st.rungs)
+    assert (dk.step_cache_interval, dk.step_cache_depth) == (1, 0)
+    assert dk.exec_mode == "stepwise"
+    assert (dk.height, dk.width) == (512, 512)  # next smaller bucket
+
+
+def test_ladder_respects_config_gates_and_cap():
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    k = key_for(1024, 1024, step_cache_interval=2, step_cache_depth=1)
+    # everything gated off: ladder exhausted immediately
+    lad = ladder(allow_batch_split=False, allow_step_cache_off=False,
+                 allow_stepwise_fallback=False)
+    assert lad.next_rung(st, "oom", k, batch_size=4) is None
+    # max_degradations caps the rung count
+    lad2 = ladder(max_degradations=1)
+    st2 = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    st2.rungs.append(RUNG_STEP_CACHE_OFF)
+    assert lad2.next_rung(st2, "compile", k, batch_size=1) is None
+    # no smaller bucket for the smallest key
+    lad3 = ladder(allow_bucket_fallback=True, allow_step_cache_off=False,
+                  allow_stepwise_fallback=False)
+    assert lad3.next_rung(
+        KeyResilience(breaker=CircuitBreaker(3, 1.0)), "compile",
+        key_for(512, 512), batch_size=1) is None
+
+
+def test_exec_key_stepwise_mode_and_short():
+    k = key_for(exec_mode="stepwise")
+    assert "stepwise" in k.short()
+    assert "stepwise" not in key_for().short()
+    with pytest.raises(ValueError, match="exec_mode"):
+        key_for(exec_mode="warp")
+
+
+# --------------------------------------------------------------------------
+# cache invalidation + ring log
+# --------------------------------------------------------------------------
+
+
+def test_cache_invalidate_drops_and_rebuilds():
+    evicted = []
+    cache = ExecutorCache(lambda k: object(), capacity=4,
+                          on_evict=lambda k, e: evicted.append(k))
+    k = key_for()
+    ex1, hit = cache.get(k)
+    assert not hit
+    assert cache.invalidate(k)
+    assert evicted == [k]
+    assert not cache.invalidate(k)  # already gone
+    ex2, hit = cache.get(k)
+    assert not hit and ex2 is not ex1  # rebuilt, not resurrected
+
+
+def test_ring_log_bounded():
+    log = RingLog(capacity=3)
+    for i in range(7):
+        log.add(f"e{i}")
+    snap = log.snapshot()
+    assert [e["message"] for e in snap] == ["e4", "e5", "e6"]
+    assert [e["seq"] for e in snap] == [5, 6, 7]
+    assert len(log) == 3 and log.total == 7
+
+
+# --------------------------------------------------------------------------
+# server integration: retry, watchdog, breaker, split, ladder, health
+# --------------------------------------------------------------------------
+
+
+def test_server_retries_transient_execute_error():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                at_calls=(0,))])
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config(), fault_plan=plan) as server:
+        r = server.submit("p", height=512, width=512, seed=3).result(timeout=30)
+    assert (r.output == fake_image("p", 3, factory.built[0])).all()
+    assert r.retries == 1 and r.degradations == ()
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["retries"] == 1
+    assert snap["requests"]["completed"] == 1
+    assert snap["requests"].get("scheduler_errors", 0) == 0
+    # a retried-then-successful dispatch is NOT a breaker failure: the
+    # breaker counts terminal outcomes, and this batch's outcome was good
+    (circuit,) = snap["resilience"]["circuits"].values()
+    assert circuit["consecutive_failures"] == 0
+    assert circuit["state"] == "closed"
+
+
+def test_server_watchdog_bounds_injected_hang():
+    # hang 0.35s vs 0.2s watchdog: the first dispatch is abandoned at
+    # 0.2s; the retry serializes behind the abandoned worker (drains at
+    # 0.35s, inside its 0.2s grace) and then succeeds
+    plan = FaultPlan([FaultRule(site="execute", kind="hang", at_calls=(0,),
+                                hang_s=0.35)])
+    cfg = serve_config(resilience=fast_resilience(watchdog_timeout_s=0.2))
+    factory = FakeExecutorFactory(batch_size=4)
+    t0 = time.monotonic()
+    with InferenceServer(factory, cfg, fault_plan=plan) as server:
+        r = server.submit("p", height=512, width=512).result(timeout=30)
+    assert time.monotonic() - t0 < 3.0  # nowhere near the 5s hang
+    assert r.retries == 1
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["watchdog_timeouts"] == 1
+    assert snap["resilience"]["watchdog_timeouts"] == 1
+    health = server.health()
+    # the scheduler survived the hang (it is stopped now, but it was
+    # never killed: the stop() join succeeded and all work completed)
+    assert snap["requests"].get("scheduler_errors", 0) == 0
+
+
+def test_server_circuit_opens_sheds_fast_then_recovers():
+    # the breaker counts TERMINAL dispatch failures: request 1 exhausts
+    # its retries (2 attempts, rule max_fires=2) = one terminal failure =
+    # threshold, tripping the breaker; the key is healthy afterwards, so
+    # the half-open probe after the cooldown heals it.
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error", p=1.0,
+                                max_fires=2)])
+    # batch_window_s=0: the breaker is consulted at DISPATCH time, so a
+    # linger window longer than the cooldown would let the breaker go
+    # half-open before the shed check ever runs
+    cfg = serve_config(batch_window_s=0.0, resilience=fast_resilience(
+        max_retries=1, breaker_failure_threshold=1, breaker_cooldown_s=0.2))
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, cfg, fault_plan=plan) as server:
+        with pytest.raises(ExecuteFailedError):
+            server.submit("poisoned", height=512, width=512).result(timeout=30)
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            server.submit("shed-me", height=512, width=512).result(timeout=30)
+        shed_elapsed = time.monotonic() - t0
+        assert shed_elapsed < 1.0  # the acceptance bound: no queue burn
+        assert server.health()["status"] == "degraded"
+        assert server.health()["open_circuits"]
+        time.sleep(0.3)  # past the cooldown: half-open
+        r = server.submit("probe", height=512, width=512).result(timeout=30)
+        assert r.output is not None
+        health = server.health()
+    assert health["status"] == "ok"  # breaker closed by the probe
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["shed_circuit_open"] == 1
+    assert snap["requests"]["failed_execute"] == 1
+
+
+def test_server_batch_split_retry_bit_identical():
+    # OOM whenever the coalesced batch reaches 3+: the 4-wide batch must
+    # split into halves and every request's image must equal the
+    # weightless fake's pure function of (prompt, seed, key) — i.e. be
+    # bit-identical to what the unsplit batch would have produced.
+    plan = FaultPlan([FaultRule(site="execute", kind="oom", p=1.0,
+                                min_batch=3)])
+    factory = FakeExecutorFactory(batch_size=4)
+    cfg = serve_config(batch_window_s=0.3)
+    with InferenceServer(factory, cfg, fault_plan=plan) as server:
+        futs = []
+        lock = threading.Lock()
+
+        def client(i):
+            f = server.submit(f"p{i}", height=512, width=512, seed=i)
+            with lock:
+                futs.append((i, f))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = {i: f.result(timeout=30) for i, f in futs}
+        # sticky cap: the next wave must coalesce to at most 2 directly
+        wave2 = [server.submit(f"w{i}", height=512, width=512, seed=10 + i)
+                 for i in range(4)]
+        for f in wave2:
+            f.result(timeout=30)
+    key = factory.built[0]
+    for i, r in results.items():
+        np.testing.assert_array_equal(r.output, fake_image(f"p{i}", i, key))
+        assert r.batch_size <= 2  # executed in a split half
+    assert max(factory.batch_sizes()) <= 2  # OOM width never executed
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["degraded_split_batch"] >= 1
+    caps = snap["resilience"]["degradations"]
+    assert [d["batch_cap"] for d in caps.values()] == [2]
+
+
+def test_server_degradation_ladder_walk_on_build_failures():
+    # the cadence program OOMs at build, the fused cache-off program
+    # fails to compile, the stepwise program builds: the ladder must walk
+    # step_cache_off -> stepwise_fallback IN ORDER within one request.
+    built = []
+
+    def factory(key):
+        built.append(key)
+        if key.step_cache_interval > 1:
+            raise InjectedResourceExhausted(
+                "RESOURCE_EXHAUSTED: no HBM for the cadence program")
+        if key.exec_mode == "fused":
+            raise InjectedCompileError("fused compile failed")
+        return FakeExecutor(key, batch_size=4)
+
+    cfg = serve_config(
+        step_cache_interval=2, step_cache_depth=1,
+        resilience=fast_resilience(max_retries=3),
+    )
+    with InferenceServer(factory, cfg) as server:
+        r = server.submit("p", height=512, width=512).result(timeout=30)
+        # second request goes straight to the degraded key: no retries
+        r2 = server.submit("q", height=512, width=512).result(timeout=30)
+        health = server.health()
+    assert r.degradations == (RUNG_STEP_CACHE_OFF, RUNG_STEPWISE)
+    assert r.retries == 2 and r2.retries == 0
+    assert [k.exec_mode for k in built] == ["fused", "fused", "stepwise"]
+    assert built[1].step_cache_interval == 1  # cache off before stepwise
+    assert built[2].step_cache_interval == 1
+    (entry,) = health["degradations"].values()
+    assert entry["rungs"] == [RUNG_STEP_CACHE_OFF, RUNG_STEPWISE]
+    assert health["status"] == "degraded"
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["degraded_step_cache_off"] == 1
+    assert snap["requests"]["degraded_stepwise_fallback"] == 1
+
+
+def test_warmup_build_failure_does_not_abort_startup():
+    """A failed warmup compile is recorded, not fatal: the server comes
+    up, and the first request rebuilds the bucket through the retry
+    machinery."""
+    plan = FaultPlan([FaultRule(site="build", kind="compile_error",
+                                at_calls=(0,))])
+    factory = FakeExecutorFactory(batch_size=4)
+    cfg = serve_config(warmup_buckets=((512, 512, 4),))
+    with InferenceServer(factory, cfg, fault_plan=plan) as server:
+        health = server.health()
+        assert health["scheduler_alive"]
+        r = server.submit("p", height=512, width=512).result(timeout=30)
+    assert r.output is not None and not r.compile_hit
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["warmup_build_failures"] == 1
+    assert snap["requests"]["completed"] == 1
+    assert len(snap["resilience"]["last_errors"]) == 1  # the warmup failure
+
+
+def test_server_build_failure_exhausts_retries_with_typed_error():
+    def factory(key):
+        raise RuntimeError("flaky compile service")
+
+    cfg = serve_config(resilience=fast_resilience(max_retries=1))
+    with InferenceServer(factory, cfg) as server:
+        fut = server.submit("p", height=512, width=512)
+        with pytest.raises(BuildFailedError, match="flaky compile service"):
+            fut.result(timeout=30)
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["failed_build"] == 1
+    assert snap["requests"]["retries"] == 1  # one retry, then typed failure
+
+
+def test_server_retry_budget_bounds_total_retries():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error", p=1.0)])
+    cfg = serve_config(resilience=fast_resilience(
+        max_retries=5, retry_budget=2, breaker_failure_threshold=100))
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, cfg, fault_plan=plan) as server:
+        with pytest.raises(ExecuteFailedError):
+            server.submit("p", height=512, width=512).result(timeout=30)
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["retries"] == 2  # budget, not max_retries, bound
+    assert snap["requests"]["retry_budget_exhausted"] == 1
+    assert snap["resilience"]["retry_budget_remaining"] == 0
+
+
+def test_server_stop_interrupts_backoff_sleep():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error", p=1.0)])
+    cfg = serve_config(resilience=fast_resilience(
+        max_retries=5, backoff_base_s=30.0, backoff_max_s=30.0))
+    factory = FakeExecutorFactory(batch_size=4)
+    server = InferenceServer(factory, cfg, fault_plan=plan).start(warmup=False)
+    fut = server.submit("p", height=512, width=512)
+    time.sleep(0.3)  # scheduler is now asleep in a 30s backoff
+    t0 = time.monotonic()
+    server.stop(timeout=10.0)
+    assert time.monotonic() - t0 < 5.0  # did NOT wait out the backoff
+    with pytest.raises(ServerClosedError):
+        fut.result(timeout=5)
+
+
+def test_engine_key_state_is_lru_bounded_and_keeps_interesting_keys():
+    from distrifuser_tpu.serve.resilience import ResilienceEngine
+
+    engine = ResilienceEngine(fast_resilience(max_tracked_keys=2))
+    k1, k2, k3 = key_for(steps=1), key_for(steps=2), key_for(steps=3)
+    engine.key_state(k1).rungs.append(RUNG_STEPWISE)  # interesting
+    engine.key_state(k2)  # boring
+    engine.key_state(k3)  # exceeds the cap: the boring k2 is evicted
+    snap = engine.snapshot()
+    assert len(snap["circuits"]) == 2
+    assert k1.short() in snap["circuits"] and k3.short() in snap["circuits"]
+    assert engine.key_state(k1).rungs == [RUNG_STEPWISE]  # state survived
+
+
+def test_engine_eviction_never_victimizes_the_new_key():
+    """When every OLDER tracked key is interesting, the oldest other key
+    is evicted — never the just-inserted one, whose state must survive
+    within (and across) its own dispatch so its circuit can still trip."""
+    from distrifuser_tpu.serve.resilience import ResilienceEngine
+
+    engine = ResilienceEngine(fast_resilience(max_tracked_keys=2))
+    k1, k2, k3 = key_for(steps=1), key_for(steps=2), key_for(steps=3)
+    engine.key_state(k1).rungs.append(RUNG_STEPWISE)
+    engine.key_state(k2).rungs.append(RUNG_STEP_CACHE_OFF)
+    st3 = engine.key_state(k3)  # all older keys interesting: k1 (oldest
+    st3.breaker.record_failure()  # other) goes, NOT the fresh k3
+    assert engine.key_state(k3) is st3
+    assert engine.key_state(k3).breaker.snapshot()["consecutive_failures"] == 1
+    snap = engine.snapshot()
+    assert k3.short() in snap["circuits"] and k2.short() in snap["circuits"]
+    assert k1.short() not in snap["circuits"]
+
+
+def test_stop_join_timeout_refuses_second_scheduler():
+    """When stop()'s join times out (scheduler still draining a long
+    dispatch), the thread handle must be kept: health() stays truthful
+    and start() refuses to spawn a second scheduler over the mesh."""
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.2)  # 0.8s run
+    server = InferenceServer(factory, serve_config(batch_window_s=0.0)).start()
+    fut = server.submit("long", height=512, width=512)
+    time.sleep(0.2)  # scheduler is now mid-dispatch
+    server.stop(timeout=0.05)  # far shorter than the dispatch
+    assert server.metrics_snapshot()["requests"]["stop_join_timeouts"] == 1
+    assert server.health()["scheduler_alive"]  # truthfully still draining
+    with pytest.raises(AssertionError, match="already started"):
+        server.start()
+    fut.result(timeout=10)  # the in-flight batch still completes
+    server.stop(timeout=10.0)  # drained now: joins cleanly
+    assert not server.health()["scheduler_alive"]
+    # restart-after-stop is refused loudly: the queue is closed for good,
+    # so a "restarted" server would reject 100% of traffic while
+    # reporting a live scheduler
+    with pytest.raises(ServerClosedError, match="build a new"):
+        server.start()
+
+
+def test_contract_violation_counts_as_breaker_failure():
+    """A non-ServeError escape (executor contract violation) must still
+    reach the breaker: a HALF_OPEN probe dying this way would otherwise
+    leave the probe latch set forever, permanently shedding the key."""
+    class Broken:
+        batch_size = 4
+
+        def __call__(self, prompts, negs, gs, seeds):
+            return []  # violates the length contract
+
+    with InferenceServer(lambda key: Broken(), serve_config()) as server:
+        with pytest.raises(RuntimeError, match="outputs for a batch"):
+            server.submit("p", height=512, width=512).result(timeout=30)
+        health = server.health()
+    (circuit,) = health["circuits"].values()
+    assert circuit["consecutive_failures"] == 1
+    assert server.counters.get("scheduler_errors") == 1
+
+
+def test_set_stepwise_rejects_pipefusion():
+    """The stepwise rung must fail LOUDLY for PipeFusion pipelines (no
+    host-driven stepwise loop exists) instead of silently burning a
+    degradation rung that changes nothing."""
+    import types
+
+    from distrifuser_tpu.pipelines import DistriPixArtPipeline
+
+    class Shell(DistriPixArtPipeline):
+        def __init__(self):  # the guard only reads distri_config
+            self.distri_config = types.SimpleNamespace(
+                parallelism="pipefusion", use_cuda_graph=True)
+
+    with pytest.raises(ValueError, match="PipeFusion"):
+        Shell().set_stepwise(True)
+    patch = Shell()
+    patch.distri_config.parallelism = "patch"
+    patch.set_stepwise(True)
+    assert patch.distri_config.use_cuda_graph is False
+
+
+def test_health_snapshot_schema_and_json():
+    import json
+
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config()) as server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        health = server.health()
+        assert health["scheduler_alive"]
+    for section in ("status", "queue_depth", "scheduler_alive", "requests",
+                    "circuits", "open_circuits", "degradations",
+                    "retry_budget_remaining", "watchdog_timeouts",
+                    "last_errors"):
+        assert section in health, section
+    assert health["status"] == "ok"
+    json.dumps(health)  # JSON-serializable end to end
+    snap = server.metrics_snapshot()
+    assert "resilience" in snap
+    json.dumps(snap)
+
+
+def test_last_errors_recorded_in_health():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                at_calls=(0,))])
+    factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory, serve_config(), fault_plan=plan) as server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        health = server.health()
+    assert len(health["last_errors"]) == 1
+    assert "ExecuteFailedError" in health["last_errors"][0]["message"]
+
+
+def test_resilience_config_validation():
+    with pytest.raises(ValueError, match="max_retries"):
+        ResilienceConfig(max_retries=-1)
+    with pytest.raises(ValueError, match="backoff_multiplier"):
+        ResilienceConfig(backoff_multiplier=0.5)
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        ResilienceConfig(backoff_jitter=1.0)
+    with pytest.raises(ValueError, match="breaker_failure_threshold"):
+        ResilienceConfig(breaker_failure_threshold=0)
+    with pytest.raises(ValueError, match="resilience"):
+        ServeConfig(resilience={"max_retries": 2})
+
+
+# --------------------------------------------------------------------------
+# chaos bench contract
+# --------------------------------------------------------------------------
+
+
+def test_chaos_bench_contract(tmp_path, capsys):
+    import json
+    import sys
+
+    sys.path.insert(0, "scripts")
+    import chaos_bench
+
+    out = tmp_path / "chaos.json"
+    rc = chaos_bench.main([
+        "--requests", "16", "--concurrency", "4", "--fault-p", "0.15",
+        "--hang-s", "0.3", "--watchdog-s", "0.1", "--max-retries", "3",
+        "--min-availability", "0", "--out", str(out),
+    ])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "chaos_availability"
+    assert rec["scheduler_alive"] is True
+    assert rec["poison_shed_max_s"] is not None
+    assert rec["poison_shed_max_s"] < 1.0
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["poison"]["shed_count"] > 0
+    assert art["poison"]["healthy_bucket_survived"]
+    assert art["mixed"]["health"]["scheduler_alive"]

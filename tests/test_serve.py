@@ -13,12 +13,14 @@ from distrifuser_tpu.serve import (
     DeadlineExceededError,
     ExecKey,
     ExecutorCache,
+    FatalError,
     InferenceServer,
     MicroBatcher,
     NoBucketError,
     QueueFullError,
     Request,
     RequestQueue,
+    RetryableError,
     ServeConfig,
     ServerClosedError,
 )
@@ -81,8 +83,15 @@ def test_queue_full_rejection():
     q = RequestQueue(max_depth=2)
     q.put(mk_request())
     q.put(mk_request())
+    # typed hierarchy (serve/errors.py): a full queue is RETRYABLE (429
+    # analog — try another replica), unlike a lapsed deadline
+    with pytest.raises(RetryableError):
+        q.put(mk_request())
     with pytest.raises(QueueFullError):
         q.put(mk_request())
+    assert issubclass(DeadlineExceededError, FatalError)
+    assert issubclass(NoBucketError, FatalError)
+    assert issubclass(ServerClosedError, FatalError)
 
 
 def test_queue_closed_rejection():
@@ -371,6 +380,65 @@ def test_broken_executor_fails_batch_not_server():
         ok = server.submit("p", height=1024, width=1024).result(timeout=30)
     assert ok.output is not None
     assert server.counters.get("scheduler_errors") == 1
+
+
+def test_cancel_while_queued_batchmates_unaffected():
+    """Cancel/deadline race (server.py _resolve): a future cancelled
+    while its request is queued must stay cancelled — the scheduler's
+    later set_result is swallowed — and the other requests of the SAME
+    batch must complete normally."""
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.05)
+    with InferenceServer(factory, serve_config(batch_window_s=0.0)) as server:
+        blocker = server.submit("blocker", height=512, width=512)
+        time.sleep(0.1)  # scheduler busy: the next submissions stay queued
+        doomed = server.submit("doomed", height=512, width=512)
+        mate = server.submit("mate", height=512, width=512)
+        assert doomed.cancel()  # still queued -> cancellable
+        blocker.result(timeout=30)
+        r = mate.result(timeout=30)
+    assert doomed.cancelled()
+    with pytest.raises(Exception):  # CancelledError, never a ServeResult
+        doomed.result(timeout=0)
+    assert r.output is not None
+    assert server.counters.get("scheduler_errors") == 0
+
+
+def test_deadline_expiry_while_inflight_still_completes():
+    """Deadlines gate SCHEDULING, not mesh work: a request whose deadline
+    lapses after dispatch (while executing) completes normally — and the
+    lateness is observable via the completed_late counter."""
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.15)  # 0.6s run
+    with InferenceServer(factory, serve_config(batch_window_s=0.0)) as server:
+        fut = server.submit("in-flight", height=512, width=512, ttl_s=0.3)
+        r = fut.result(timeout=30)  # NOT DeadlineExceededError
+    assert r.output is not None
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["completed"] == 1
+    assert snap["requests"]["completed_late"] == 1
+    assert snap["requests"].get("rejected_deadline", 0) == 0
+
+
+def test_stop_deterministically_fails_queued_futures():
+    """stop() must resolve EVERY queued future with ServerClosedError —
+    including ones the batcher pops concurrently with the stop — while
+    the in-flight batch completes normally."""
+    factory = FakeExecutorFactory(batch_size=4, step_time_s=0.1)  # 0.4s run
+    server = InferenceServer(factory, serve_config(batch_window_s=0.0)).start()
+    inflight = server.submit("in-flight", height=512, width=512)
+    time.sleep(0.15)  # scheduler now executing "in-flight"
+    queued = [server.submit(f"queued{i}", height=512, width=512)
+              for i in range(3)]
+    server.stop(timeout=10.0)
+    r = inflight.result(timeout=5)  # in-flight work is never abandoned
+    assert r.output is not None
+    for f in queued:
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=5)
+    with pytest.raises(ServerClosedError):
+        server.submit("after-stop", height=512, width=512)
+    assert server.metrics_snapshot()["requests"]["rejected_server_closed"] == 3
+    # idempotent: a second stop is a no-op, not an error
+    server.stop(timeout=1.0)
 
 
 def test_server_metrics_snapshot_schema():

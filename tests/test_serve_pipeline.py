@@ -59,6 +59,53 @@ def test_pipeline_executor_honors_per_request_seeds(devices8):
     assert np.abs(np.asarray(batched[0]) - np.asarray(batched[1])).max() > 0
 
 
+def test_stepwise_fallback_key_matches_fused(devices8):
+    """The degradation ladder's ``exec_mode='stepwise'`` key
+    (serve/resilience.py, applied by executors.apply_key_policy via
+    pipelines.set_stepwise) is the fused scan's numerics within the
+    repo's fused-vs-stepwise parity tolerance (test_stepwise.py) — the
+    fallback degrades dispatch granularity, never image quality."""
+    import dataclasses
+
+    def build(key: ExecKey):
+        pipe, _ = build_sd_pipeline(
+            devices8, 1, height=key.height, width=key.width, batch_size=2,
+            do_classifier_free_guidance=key.cfg,
+        )
+        return pipe
+
+    factory = pipeline_executor_factory(build)
+    key = ExecKey(model_id="t", scheduler="ddim", height=128, width=128,
+                  steps=2, cfg=True, mesh_plan="dp1.cfg1.sp1")
+    fused = factory(key)
+    stepwise = factory(dataclasses.replace(key, exec_mode="stepwise"))
+    assert fused.pipeline.distri_config.use_compiled_step
+    assert not stepwise.pipeline.distri_config.use_compiled_step
+    a = fused(["a cat"], [""], 5.0, seeds=[3])
+    b = stepwise(["a cat"], [""], 5.0, seeds=[3])
+    np.testing.assert_allclose(np.asarray(a[0]), np.asarray(b[0]), atol=2e-4)
+
+
+def test_runner_compile_global_fault_site(devices8):
+    """The process-global chaos hook (utils/chaos.py, re-exported by
+    serve.faults) fires under DenoiseRunner.compiled_handle: prepare()
+    fails deterministically once, then builds clean."""
+    from distrifuser_tpu.serve import FaultPlan, FaultRule, install_fault_plan
+
+    pipe, _ = build_sd_pipeline(devices8, 1, batch_size=2)
+    install_fault_plan(FaultPlan([FaultRule(
+        site="runner.compile", kind="compile_error", at_calls=(0,))]))
+    try:
+        with pytest.raises(Exception, match="injected compile_error"):
+            pipe.prepare(2)
+        pipe.prepare(2)  # the rule fired once; the rebuild succeeds
+    finally:
+        install_fault_plan(None)
+    out = pipe(["a cat", "a dog"], num_inference_steps=2, seed=1,
+               output_type="latent")
+    assert len(out.images) == 2
+
+
 def test_server_over_real_pipeline(devices8):
     """Full stack: submit -> bucket snap -> cache build (prepare) ->
     batched execution -> per-request results."""
