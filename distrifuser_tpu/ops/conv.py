@@ -25,7 +25,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.collectives import halo_exchange
 from ..parallel.context import PatchContext
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")
@@ -99,9 +98,10 @@ def patch_conv2d(p, x, ctx: PatchContext, name: str, *, stride: int = 1):
         return conv2d(p, x, stride=stride, padding=(ph, pw))
 
     if ctx.is_sync:
-        top, bottom = halo_exchange(x, ph, ctx.n, ctx.axis)
-        # Fresh halos double as the seed state for the stale phase.
-        ctx.emit(name, jnp.stack([top, bottom]), kind="conv2d")
+        # Fresh halos double as the seed state for the stale phase; the
+        # context hook also seeds the own-rows carry residual compression
+        # delta-codes against (parallel/compress.py).
+        top, bottom = ctx.emit_sync_halos(name, x, ph)
     else:
         halos = ctx.stale(name)  # [2, B, ph, W, C] from the previous step
         top, bottom = halos[0], halos[1]

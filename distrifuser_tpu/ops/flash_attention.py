@@ -176,6 +176,27 @@ def flash_sdpa(q, k, v, *, heads: int, block_q: int = DEFAULT_BLOCK_Q,
     return out.reshape(b, heads, lq, d).transpose(0, 2, 1, 3).reshape(b, lq, c)
 
 
+def padding_segment_ids(b: int, lq: int, lq_pad: int, lk: int, lk_pad: int):
+    """Upstream-kernel ``SegmentIds`` encoding the alignment-pad mask.
+
+    Real tokens are segment 0, pad tokens segment 1; the upstream kernel
+    masks cross-segment attention, so a real query row attends exactly the
+    first ``lk`` KV positions — the same statement as the in-repo kernel's
+    static ``kv_len`` mask (pad query rows attend pad KV, compute garbage,
+    and are the caller's to slice off).  Split out of ``padded_flash_sdpa``
+    so the mask semantics are testable on CI without a Mosaic compile
+    (tests/test_flash_attention.py).
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import SegmentIds
+
+    seg_q = (jnp.arange(lq_pad) >= lq).astype(jnp.int32)
+    seg_kv = (jnp.arange(lk_pad) >= lk).astype(jnp.int32)
+    return SegmentIds(
+        q=jnp.broadcast_to(seg_q, (b, lq_pad)),
+        kv=jnp.broadcast_to(seg_kv, (b, lk_pad)),
+    )
+
+
 def padded_flash_sdpa(q, k, v, *, heads: int, align: int = 128,
                       interpret: bool = False, impl: str = None):
     """Flash attention for UNALIGNED sequence lengths via pad-and-mask.
@@ -187,19 +208,29 @@ def padded_flash_sdpa(q, k, v, *, heads: int, align: int = 128,
     get -inf logits (zero softmax weight), pad query rows compute garbage
     and are sliced off.
 
-    ``impl``: "upstream" (segment-ids mask: real tokens segment 0, pad
-    segment 1 — cross-segment attention is masked, which is the same
-    statement) or "inrepo" (static kv_len mask).  Defaults to the
-    DISTRIFUSER_TPU_PADDED_IMPL env var, else "upstream" — the model-level
-    A/B at SD3-medium 1024²: upstream 8.32 s vs inrepo 13.54 s vs chunked
-    XLA 20.17 s (the two kernels agree to 5e-4 on chip); a failed
-    upstream trace falls through to the in-repo kernel.
+    ``impl``: "upstream" (segment-ids mask, ``padding_segment_ids``) or
+    "inrepo" (static kv_len mask).  Resolution: the ``impl`` argument,
+    else DISTRIFUSER_TPU_PADDED_IMPL, else — honoring the operator's
+    kernel-wide DISTRIFUSER_TPU_FLASH_IMPL=inrepo pin — "inrepo", else
+    "upstream" (the model-level A/B at SD3-medium 1024²: upstream 8.32 s
+    vs inrepo 13.54 s vs chunked XLA 20.17 s; the two kernels agree to
+    5e-4 on chip).  The default upstream route additionally requires the
+    probe compile (`attention._upstream_flash_available`) to have passed:
+    the except below only catches TRACE-time failures, while a Mosaic
+    backend-compile failure would surface when the enclosing jitted
+    denoise step compiles — past any fallback — and kill generate()
+    instead of degrading.  An explicit upstream pin (arg or PADDED_IMPL
+    env) is honored past the probe.
     """
     # lazy import avoids a cycle: attention.py only imports this module
     # inside function bodies
-    from .attention import _largest_dividing_tile
+    from .attention import _largest_dividing_tile, _upstream_flash_available
 
-    impl = impl or os.environ.get("DISTRIFUSER_TPU_PADDED_IMPL", "upstream")
+    explicit = impl or os.environ.get("DISTRIFUSER_TPU_PADDED_IMPL")
+    impl = explicit
+    if impl is None and os.environ.get("DISTRIFUSER_TPU_FLASH_IMPL") == "inrepo":
+        impl = "inrepo"
+    impl = impl or "upstream"
     if impl not in ("upstream", "inrepo"):
         # loud: a typo here would silently cost SD3 its 39% (8.3 vs 13.5 s)
         raise ValueError(
@@ -213,18 +244,10 @@ def padded_flash_sdpa(q, k, v, *, heads: int, align: int = 128,
     kp = jnp.pad(k, ((0, 0), (0, lk_pad - lk), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, lk_pad - lk), (0, 0)))
 
-    if impl == "upstream" and not interpret:
+    if impl == "upstream" and not interpret and (
+            explicit == "upstream" or _upstream_flash_available()):
         try:
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                SegmentIds,
-            )
-
-            seg_q = (jnp.arange(lq_pad) >= lq).astype(jnp.int32)
-            seg_kv = (jnp.arange(lk_pad) >= lk).astype(jnp.int32)
-            seg = SegmentIds(
-                q=jnp.broadcast_to(seg_q, (b, lq_pad)),
-                kv=jnp.broadcast_to(seg_kv, (b, lk_pad)),
-            )
+            seg = padding_segment_ids(b, lq, lq_pad, lk, lk_pad)
             out = upstream_flash_sdpa(
                 qp, kp, vp, seg, heads=heads,
                 block_q=_largest_dividing_tile(256, lq_pad),

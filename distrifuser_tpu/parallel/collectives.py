@@ -50,6 +50,18 @@ def neighbor_perms(n: int):
     return down, up
 
 
+def exchange_boundary_rows(bottom, top, n: int, axis: str = SP_AXIS):
+    """ppermute already-extracted boundary tensors to spatial neighbors:
+    ``(from_prev, from_next)`` = (previous device's ``bottom``, next
+    device's ``top``).  Edge devices receive zeros.  Factored out of
+    ``halo_exchange`` so the compressed refresh path (parallel/compress.py
+    payload + fp32 scale pairs) rides the exact same edge convention."""
+    down, up = neighbor_perms(n)
+    from_prev = lax.ppermute(bottom, axis, perm=down)
+    from_next = lax.ppermute(top, axis, perm=up)
+    return from_prev, from_next
+
+
 def halo_exchange(x, halo: int, n: int, axis: str = SP_AXIS):
     """Exchange boundary rows with spatial neighbors along the patch axis.
 
@@ -63,10 +75,7 @@ def halo_exchange(x, halo: int, n: int, axis: str = SP_AXIS):
     if halo == 0 or n == 1:
         zeros = jnp.zeros(x.shape[:1] + (halo,) + x.shape[2:], x.dtype)
         return zeros, zeros
-    down, up = neighbor_perms(n)
-    from_prev = lax.ppermute(x[:, -halo:], axis, perm=down)
-    from_next = lax.ppermute(x[:, :halo], axis, perm=up)
-    return from_prev, from_next
+    return exchange_boundary_rows(x[:, -halo:], x[:, :halo], n, axis)
 
 
 def gather_rows(patch, axis: str = SP_AXIS):

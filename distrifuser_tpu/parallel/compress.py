@@ -1,0 +1,166 @@
+"""Lossy compression for the stale-refresh exchanges (comm_compress).
+
+DistriFusion's displaced-patch protocol is communication-bound at scale:
+every stale step ships full-precision halo rows and KV slabs whose *only*
+consumer is the next step's already-approximate stale read (tolerance-tested
+at 2e-4 across the repo).  The async overlap hides that volume but does not
+shrink it — so this module shrinks it: refresh payloads are quantized to 8
+bits before they touch the wire and dequantized right after the collective,
+with one fp32 scale per tile (the last axis: a channel vector of a halo row,
+a token row of a KV slab).  The carry pytree keeps full-precision leaves —
+the quantize -> collective -> dequantize round trip lives entirely on the
+deferred (latency-hidden) refresh path, so the full/shallow/sync step bodies
+keep identical carry structures and the step-cache / fused-scan composition
+in parallel/{runner,stepcache}.py is untouched.
+
+Modes (DistriConfig.comm_compress):
+
+* ``"none"``          — full-precision exchange (default; bit-identical).
+* ``"int8"``          — symmetric per-tile int8: ``q = round(x / s)`` with
+  ``s = amax(|x|) / 127`` per tile.  Error is bounded by ``s / 2``.
+* ``"fp8"``           — float8_e4m3fn payload with per-tile scaling to the
+  e4m3 dynamic range (amax -> 448).  Relative error ~2^-3 of the value;
+  better than int8 for heavy-tailed tiles.  Requires a jax/ml_dtypes with
+  ``float8_e4m3fn`` (``fp8_supported()``).
+* ``"int8_residual"`` — int8 over the *delta* against the previous stale
+  value already carried in the patch state.  Adjacent denoising steps are
+  near-identical, so the residual's dynamic range (and thus the per-tile
+  scale, and thus the absolute error) is far smaller than the activation's.
+  Closed-loop (DPCM) coding: the delta is taken against the *reconstructed*
+  previous value, so quantization error does not accumulate across steps.
+
+Only stale-phase refresh traffic compresses; warmup/sync collectives stay
+full-precision and bit-exact (reference-faithful).  GroupNorm moment
+exchanges are never compressed: they are O(groups) — noise against the KV
+slabs — and the ``var = E[x^2] - E[x]^2`` cancellation amplifies payload
+error catastrophically.  Wire accounting for all of this lives in
+``wire_nbytes`` + context.WIRE_REGISTRY, surfaced by
+``DenoiseRunner.comm_volume_report(per_phase=True)["bytes"]``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.config import SP_AXIS
+
+COMPRESS_MODES = ("none", "int8", "fp8", "int8_residual")
+
+# Layer kinds (context.KIND_REGISTRY) whose stale refresh compresses.  "gn"
+# is deliberately absent (see module docstring); "stepcache" is a local
+# carry with no collective.
+COMPRESS_KINDS = ("attn", "conv2d")
+
+# int8 symmetric range and float8_e4m3fn max normal.
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0
+# Floor on per-tile scales: an all-zero tile (edge halos) must dequantize to
+# exact zeros, not NaNs from a 0/0.
+_SCALE_FLOOR = 1e-12
+
+
+def fp8_dtype():
+    """The fp8 payload dtype, or None when this jax build lacks it."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_supported() -> bool:
+    return fp8_dtype() is not None
+
+
+def validate_mode(mode: str) -> None:
+    """Config-time validation shared by DistriConfig and ServeConfig."""
+    if mode not in COMPRESS_MODES:
+        raise ValueError(
+            f"comm_compress must be one of {COMPRESS_MODES}, got {mode!r}"
+        )
+    if mode == "fp8" and not fp8_supported():
+        raise ValueError(
+            "comm_compress='fp8' needs jax.numpy.float8_e4m3fn, which this "
+            "jax build lacks — use 'int8' or 'int8_residual'"
+        )
+
+
+def quantize(x, mode: str):
+    """Per-tile symmetric quantization over the LAST axis.
+
+    Returns ``(payload, scale)``: payload is int8 (or float8_e4m3fn for
+    "fp8") with x's shape; scale is fp32 with shape ``x.shape[:-1]`` — one
+    scale per tile, the "halo-row / KV-row" granularity.  Exact zeros map to
+    exact zeros (edge-device halo semantics depend on it).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    if mode in ("int8", "int8_residual"):
+        scale = jnp.maximum(amax, _SCALE_FLOOR) / _INT8_MAX
+        q = jnp.clip(
+            jnp.round(xf / scale[..., None]), -_INT8_MAX, _INT8_MAX
+        ).astype(jnp.int8)
+    elif mode == "fp8":
+        dt = fp8_dtype()
+        if dt is None:
+            raise ValueError("fp8 payloads unsupported by this jax build")
+        scale = jnp.maximum(amax, _SCALE_FLOOR) / _FP8_MAX
+        q = (xf / scale[..., None]).astype(dt)
+    else:
+        raise ValueError(f"not a quantizing mode: {mode!r}")
+    return q, scale
+
+
+def dequantize(payload, scale, dtype):
+    """Inverse of ``quantize`` (up to the per-tile rounding error)."""
+    return (payload.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def wire_nbytes(shape: Sequence[int], itemsize: int, mode: str) -> int:
+    """Bytes one exchange of a ``shape``-shaped tensor puts on the wire.
+
+    ``"none"`` moves the raw payload; the quantizing modes move a 1-byte
+    payload per element plus one fp32 scale per tile (last-axis vector).
+    The comm accounting's single source of truth — context.WIRE_REGISTRY
+    entries and the closed-form DiT/MMDiT reports both come from here.
+    """
+    n = int(math.prod(shape))
+    if mode == "none":
+        return n * itemsize
+    tiles = int(math.prod(shape[:-1])) if len(shape) else 1
+    return n + tiles * 4
+
+
+def refresh_gather_seq(
+    local,
+    prev,
+    mode: str,
+    offset,
+    axis: str = SP_AXIS,
+):
+    """Compressed sequence-sharded refresh all-gather (DiT/MMDiT KV path).
+
+    ``local`` is this device's fresh stacked KV rows ``[2, B, chunk, hid]``;
+    ``prev`` the previous step's gathered state ``[2, B, N, hid]`` (the scan
+    carry).  Returns the refreshed full ``[2, B, N, hid]`` in prev's dtype:
+    a plain tiled all-gather for "none", a quantized payload + per-row fp32
+    scale pair of gathers otherwise, with "int8_residual" delta-coding
+    against this device's own slice of ``prev`` at token offset ``offset``.
+    The result is consumed only next step, so every op here stays on the
+    deferred path.
+    """
+    tok = local.ndim - 2  # token axis of the [..., chunk, hid] layout
+    if mode == "none":
+        return lax.all_gather(local, axis, axis=tok, tiled=True)
+    src = local.astype(jnp.float32)
+    if mode == "int8_residual":
+        start = (0,) * tok + (offset, 0)
+        my_prev = lax.dynamic_slice(prev, start, local.shape)
+        src = src - my_prev.astype(jnp.float32)
+    q, s = quantize(src, mode)
+    gq = lax.all_gather(q, axis, axis=tok, tiled=True)
+    gs = lax.all_gather(s, axis, axis=tok, tiled=True)
+    new = gq.astype(jnp.float32) * gs[..., None]
+    if mode == "int8_residual":
+        new = prev.astype(jnp.float32) + new
+    return new.astype(prev.dtype)

@@ -40,8 +40,8 @@ from jax import lax
 from ..utils.config import SP_AXIS
 
 # Trace-time registry of state-name -> layer kind ("attn" | "gn" | "conv2d"
-# | "stepcache"), filled by the emitting op itself (the only party that KNOWS
-# its kind) so reports never classify by name heuristics.  Populated as a
+# | "stepcache" | "local"), filled by the emitting op itself (the only party
+# that KNOWS its kind) so reports never classify by name heuristics.  Populated as a
 # Python side effect during tracing; names are unique per architecture, so a
 # flat map is safe across models.
 KIND_REGISTRY: Dict[str, str] = {}
@@ -52,6 +52,21 @@ KIND_REGISTRY: Dict[str, str] = {}
 # Same trace-time side-effect convention as KIND_REGISTRY; callers that need
 # it clear it before tracing one step.
 CARRIED_REGISTRY: set = set()
+
+# Trace-time wire accounting: state-name -> bytes the emitting exchange put
+# on the wire (per device, gathered-buffer convention — the byte analog of
+# the element counts comm_volume_report derives from the carry shapes).
+# Only EXCEPTIONS register here: compressed refresh payloads (int8/fp8 +
+# fp32 scales, parallel/compress.py) and wire-free local carries (own-rows
+# residual seeds).  Entries absent from the registry default to the carried
+# buffer's full elements x itemsize.  Cleared per trace, like
+# CARRIED_REGISTRY.
+WIRE_REGISTRY: Dict[str, int] = {}
+
+# Suffix for the sender-side own-boundary-rows carry that "int8_residual"
+# halos delta-code against: the receiver's stale halos hold the NEIGHBORS'
+# previous rows, so the sender must carry its own (wire-free, kind "local").
+OWN_SUFFIX = "#own"
 
 # Static phases of the denoising loop. ``SYNC`` is the warmup / full_sync
 # path (all collectives blocking-fresh, reference counter <= warmup_steps,
@@ -82,9 +97,16 @@ class PatchContext:
     # collective launches on ICI vs a narrower overlap window (the batched
     # exchange can only start once the last layer has produced its rows).
     batch_comm: bool = False
+    # Stale-refresh payload compression (parallel/compress.py): "none",
+    # "int8", "fp8", or "int8_residual".  Applies ONLY to the refresh
+    # emissions below — sync-phase exchanges (ctx.emit paths) stay
+    # full-precision and bit-exact.
+    compress: str = "none"
     state_in: Optional[Dict[str, Any]] = None
     state_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
-    # deferred refresh emissions (batch_comm): name -> local tensor / rows
+    # deferred refresh emissions (batch_comm): name -> record dict with
+    # either {"raw": <tensor(s)>} or the quantized parts
+    # {"q": ..., "s": ..., "prev": ..., "dtype": ...}
     _def_gather: Dict[str, Any] = dataclasses.field(default_factory=dict)
     _def_halo: Dict[str, Tuple[Any, Any]] = dataclasses.field(default_factory=dict)
     # Precomputed text-encoder KV per cross-attention layer. The reference
@@ -131,36 +153,180 @@ class PatchContext:
     # refresh emissions (stale phase): immediate or deferred-batched
     # ------------------------------------------------------------------
 
+    def _compress_for(self, kind: Optional[str]) -> Optional[str]:
+        """Active compression mode for a refresh emission of this kind, or
+        None when the payload goes out full-precision."""
+        from .compress import COMPRESS_KINDS
+
+        if self.compress == "none" or kind not in COMPRESS_KINDS:
+            return None
+        return self.compress
+
     def emit_refresh_gather(self, name: str, local: Any, kind: str = None) -> None:
         """Record `local` as this layer's next-step gathered state
         ([n, *local.shape] after the all-gather) — immediately, or deferred
-        into the step-end batched exchange under ``batch_comm``."""
+        into the step-end batched exchange under ``batch_comm``.  With
+        ``compress`` active for this kind, the wire carries an int8/fp8
+        payload plus per-tile fp32 scales instead of the raw tensor
+        (residual mode delta-codes against this device's own slot of the
+        stale buffer); the emitted carry value is the dequantized
+        full-precision gather either way, so the carry pytree structure is
+        mode-independent."""
         if kind is not None:
             KIND_REGISTRY[name] = kind
+        mode = self._compress_for(kind or KIND_REGISTRY.get(name))
         if self.batch_comm:
             if name in self._def_gather or name in self.state_out:
                 raise ValueError(f"duplicate state emission for layer {name!r}")
-            self._def_gather[name] = local
-        else:
+            self._def_gather[name] = self._gather_record(name, local, mode)
+            return
+        if mode is None:
             self.emit(name, lax.all_gather(local, self.axis))
+            return
+        from .compress import dequantize
+
+        rec = self._gather_record(name, local, mode)
+        gq = lax.all_gather(rec["q"], self.axis)
+        gs = lax.all_gather(rec["s"], self.axis)
+        new = dequantize(gq, gs, jnp.float32)
+        if rec["prev"] is not None:
+            new = rec["prev"].astype(jnp.float32) + new
+        self.emit(name, new.astype(rec["dtype"]))
+
+    def _gather_record(self, name: str, local: Any, mode: Optional[str]):
+        """Build the deferred-emission record for one gather refresh and
+        register its wire bytes (gathered-buffer convention: n x the local
+        payload, matching the element counts)."""
+        from .compress import quantize, wire_nbytes
+
+        itemsize = jnp.dtype(local.dtype).itemsize
+        WIRE_REGISTRY[name] = self.n * wire_nbytes(
+            local.shape, itemsize, mode or "none"
+        )
+        if mode is None:
+            return {"raw": local}
+        src = local.astype(jnp.float32)
+        prev = None
+        if mode == "int8_residual":
+            # delta against this device's own previous emission — its slot
+            # in the stale gathered buffer (identical content on every peer,
+            # so the reconstruction below is replicated-consistent)
+            prev = self.stale(name)
+            src = src - jnp.take(prev, self.split_idx(), axis=0).astype(
+                jnp.float32
+            )
+        q, s = quantize(src, mode)
+        return {"q": q, "s": s, "prev": prev, "dtype": local.dtype}
 
     def emit_refresh_halos(self, name: str, x: Any, halo: int) -> None:
         """Record the fresh boundary rows of ``x`` [B, h, W, C] as this
         layer's next-step halo state [2, B, halo, W, C] (stacked
-        from-prev/from-next, matching the sync-phase emission in
-        ops/conv.py)."""
+        from-prev/from-next, matching the sync-phase emission via
+        ``emit_sync_halos``).  With ``compress`` active the neighbor
+        permutes move int8/fp8 rows + fp32 scales; residual mode
+        delta-codes against the sender's own previous rows (the
+        ``OWN_SUFFIX`` carry this method also refreshes)."""
         KIND_REGISTRY[name] = "conv2d"
+        mode = self._compress_for("conv2d")
+        if halo == 0 or self.n == 1:
+            mode = None  # nothing real moves; keep the zero-halo semantics
+        top, bottom = x[:, :halo], x[:, x.shape[1] - halo :]
         if self.batch_comm:
             if name in self._def_halo or name in self.state_out:
                 raise ValueError(f"duplicate state emission for layer {name!r}")
-            # x.shape[1]-halo (not -halo) so halo == 0 defers zero rows, the
-            # same empty halos halo_exchange returns on the unbatched path
-            self._def_halo[name] = (x[:, :halo], x[:, x.shape[1] - halo :])
-        else:
+            # halo == 0 defers zero rows, the same empty halos halo_exchange
+            # returns on the unbatched path
+            self._def_halo[name] = self._halo_record(name, top, bottom, mode)
+            return
+        if mode is None:
             from .collectives import halo_exchange
 
-            top, bottom = halo_exchange(x, halo, self.n, self.axis)
-            self.emit(name, jnp.stack([top, bottom]))
+            t, b = halo_exchange(x, halo, self.n, self.axis)
+            self.emit(name, jnp.stack([t, b]))
+            return
+        from .collectives import exchange_boundary_rows
+        from .compress import dequantize
+
+        rec = self._halo_record(name, top, bottom, mode)
+        q_prev, q_next = exchange_boundary_rows(
+            rec["q"][1], rec["q"][0], self.n, self.axis
+        )
+        s_prev, s_next = exchange_boundary_rows(
+            rec["s"][1], rec["s"][0], self.n, self.axis
+        )
+        from_prev = dequantize(q_prev, s_prev, jnp.float32)
+        from_next = dequantize(q_next, s_next, jnp.float32)
+        if rec["prev"] is not None:
+            from_prev = rec["prev"][0].astype(jnp.float32) + from_prev
+            from_next = rec["prev"][1].astype(jnp.float32) + from_next
+        self.emit(
+            name, jnp.stack([from_prev, from_next]).astype(rec["dtype"])
+        )
+
+    def _halo_record(self, name: str, top: Any, bottom: Any,
+                     mode: Optional[str]):
+        """Deferred-emission record for one halo refresh + wire accounting
+        (both boundary rows move).  In residual mode this also refreshes
+        the own-rows predictor carry — with the RECONSTRUCTION (previous
+        own + dequantized delta), never the raw rows: the predictor must
+        equal the base each receiver accumulates onto, or the coding goes
+        open-loop and quantization error grows with step count instead of
+        cancelling (the closed-loop DPCM invariant; the gather path gets
+        the same property from delta-coding against the stale buffer)."""
+        from .compress import dequantize, quantize, wire_nbytes
+
+        itemsize = jnp.dtype(top.dtype).itemsize
+        WIRE_REGISTRY[name] = 2 * wire_nbytes(
+            top.shape, itemsize, mode or "none"
+        )
+        if mode is None:
+            return {"raw": (top, bottom)}
+        t, b = top.astype(jnp.float32), bottom.astype(jnp.float32)
+        prev = None
+        if mode == "int8_residual":
+            own = self.stale(name + OWN_SUFFIX)  # my previous [top, bottom]
+            t = t - own[0].astype(jnp.float32)
+            b = b - own[1].astype(jnp.float32)
+            prev = self.stale(name)  # receiver-side base [from_prev, from_next]
+        qt, st = quantize(t, mode)
+        qb, sb = quantize(b, mode)
+        if mode == "int8_residual":
+            self._emit_own_halos(
+                name,
+                (own[0].astype(jnp.float32)
+                 + dequantize(qt, st, jnp.float32)).astype(top.dtype),
+                (own[1].astype(jnp.float32)
+                 + dequantize(qb, sb, jnp.float32)).astype(top.dtype),
+            )
+        return {"q": (qt, qb), "s": (st, sb), "prev": prev,
+                "dtype": top.dtype}
+
+    def _emit_own_halos(self, name: str, top: Any, bottom: Any) -> None:
+        """Refresh the sender-side own-rows predictor carry for residual
+        halo coding.  Wire-free (kind "local", 0 registered bytes); no-op
+        outside ``int8_residual``.  Stale steps pass the RECONSTRUCTED rows
+        (see ``_halo_record``); the sync seed is the exact fresh rows,
+        which equal what receivers hold after an exact exchange."""
+        if self.compress != "int8_residual":
+            return
+        own = name + OWN_SUFFIX
+        KIND_REGISTRY[own] = "local"
+        WIRE_REGISTRY[own] = 0
+        self.emit(own, jnp.stack([top, bottom]))
+
+    def emit_sync_halos(self, name: str, x: Any, halo: int):
+        """Sync-phase halo exchange + emission (ops/conv.py's warmup path):
+        exchanges FRESH halos (blocking, full-precision — the reference
+        warmup all_gather), emits them as the stale phase's seed state, and
+        in residual mode also seeds the own-rows carry the stale deltas
+        code against.  Returns ``(from_prev, from_next)`` for the conv."""
+        from .collectives import halo_exchange
+
+        top, bottom = halo_exchange(x, halo, self.n, self.axis)
+        self.emit(name, jnp.stack([top, bottom]), kind="conv2d")
+        if self._compress_for("conv2d") is not None and halo and self.n > 1:
+            self._emit_own_halos(name, x[:, :halo], x[:, x.shape[1] - halo:])
+        return top, bottom
 
     def carry_unconsumed(self) -> None:
         """Pass every ``state_in`` entry this step did not re-emit through to
@@ -189,51 +355,105 @@ class PatchContext:
 
         One `lax.all_gather` per participating dtype carries every layer's
         flattened KV/moment tensor; one non-wrapping `lax.ppermute` pair
-        carries every conv's boundary rows.  Results are split back to the
-        per-layer shapes the unbatched path would have produced, so the carry
-        pytree (and therefore numerics) is identical either way.  No-op when
-        nothing was deferred.
+        carries every conv's boundary rows.  Compressed layers contribute
+        their int8/fp8 payload to the payload-dtype batch and their fp32
+        scales to the fp32 batch (scales share a flat gather with any raw
+        fp32 traffic), and dequantize after the split.  Results match the
+        per-layer shapes and values the unbatched path would have produced,
+        so the carry pytree is identical either way.  No-op when nothing
+        was deferred.
         """
+        from .compress import dequantize
+
         if self._def_gather:
-            by_dtype: Dict[Any, list] = {}
-            for name, t in self._def_gather.items():
-                by_dtype.setdefault(jnp.dtype(t.dtype), []).append((name, t))
-            for items in by_dtype.values():
-                flat = jnp.concatenate([t.reshape(-1) for _, t in items])
-                gathered = lax.all_gather(flat, self.axis)  # [n, total]
-                off = 0
-                for name, t in items:
-                    size = t.size
-                    self.state_out[name] = gathered[:, off : off + size].reshape(
-                        (gathered.shape[0],) + t.shape
-                    )
-                    off += size
+            parts = []  # (name, part key, tensor)
+            for name, rec in self._def_gather.items():
+                if "raw" in rec:
+                    parts.append((name, "raw", rec["raw"]))
+                else:
+                    parts.append((name, "q", rec["q"]))
+                    parts.append((name, "s", rec["s"]))
+            gathered = self._batched_gather(parts)
+            for name, rec in self._def_gather.items():
+                if "raw" in rec:
+                    self.state_out[name] = gathered[(name, "raw")]
+                    continue
+                new = dequantize(
+                    gathered[(name, "q")], gathered[(name, "s")], jnp.float32
+                )
+                if rec["prev"] is not None:
+                    new = rec["prev"].astype(jnp.float32) + new
+                self.state_out[name] = new.astype(rec["dtype"])
             self._def_gather.clear()
         if self._def_halo:
-            from .collectives import neighbor_perms
-
-            down, up = neighbor_perms(self.n)
-            by_dtype = {}
-            for name, (top_rows, bottom_rows) in self._def_halo.items():
-                by_dtype.setdefault(jnp.dtype(top_rows.dtype), []).append(
-                    (name, top_rows, bottom_rows)
-                )
-            for items in by_dtype.values():
-                # my bottom rows -> next device's from-prev (top) halo;
-                # my top rows -> previous device's from-next (bottom) halo.
-                bottoms = jnp.concatenate([b.reshape(-1) for _, _, b in items])
-                tops = jnp.concatenate([t.reshape(-1) for _, t, _ in items])
-                from_prev = lax.ppermute(bottoms, self.axis, perm=down)
-                from_next = lax.ppermute(tops, self.axis, perm=up)
-                off = 0
-                for name, top_rows, _ in items:
-                    size = top_rows.size
-                    shape = top_rows.shape
-                    self.state_out[name] = jnp.stack(
-                        [
-                            from_prev[off : off + size].reshape(shape),
-                            from_next[off : off + size].reshape(shape),
-                        ]
-                    )
-                    off += size
+            parts = []  # (name, part key, (top, bottom))
+            for name, rec in self._def_halo.items():
+                if "raw" in rec:
+                    parts.append((name, "raw", rec["raw"]))
+                else:
+                    parts.append((name, "q", rec["q"]))
+                    parts.append((name, "s", rec["s"]))
+            exchanged = self._batched_halo_exchange(parts)
+            for name, rec in self._def_halo.items():
+                if "raw" in rec:
+                    self.state_out[name] = jnp.stack(exchanged[(name, "raw")])
+                    continue
+                q_prev, q_next = exchanged[(name, "q")]
+                s_prev, s_next = exchanged[(name, "s")]
+                from_prev = dequantize(q_prev, s_prev, jnp.float32)
+                from_next = dequantize(q_next, s_next, jnp.float32)
+                if rec["prev"] is not None:
+                    from_prev = rec["prev"][0].astype(jnp.float32) + from_prev
+                    from_next = rec["prev"][1].astype(jnp.float32) + from_next
+                self.state_out[name] = jnp.stack(
+                    [from_prev, from_next]
+                ).astype(rec["dtype"])
             self._def_halo.clear()
+
+    def _batched_gather(self, parts) -> Dict[Tuple[str, str], Any]:
+        """One flat all_gather per dtype over ``(name, part, tensor)``
+        entries; returns {(name, part): [n, *tensor.shape]}."""
+        by_dtype: Dict[Any, list] = {}
+        for name, part, t in parts:
+            by_dtype.setdefault(jnp.dtype(t.dtype), []).append((name, part, t))
+        out: Dict[Tuple[str, str], Any] = {}
+        for items in by_dtype.values():
+            flat = jnp.concatenate([t.reshape(-1) for _, _, t in items])
+            gathered = lax.all_gather(flat, self.axis)  # [n, total]
+            off = 0
+            for name, part, t in items:
+                out[(name, part)] = gathered[:, off : off + t.size].reshape(
+                    (gathered.shape[0],) + t.shape
+                )
+                off += t.size
+        return out
+
+    def _batched_halo_exchange(self, parts) -> Dict[Tuple[str, str], Any]:
+        """One flat non-wrapping ppermute pair per dtype over
+        ``(name, part, (top, bottom))`` entries; returns
+        {(name, part): (from_prev, from_next)}.  My bottom rows become the
+        next device's from-prev halo; my top rows the previous device's
+        from-next halo."""
+        from .collectives import exchange_boundary_rows
+
+        by_dtype: Dict[Any, list] = {}
+        for name, part, (top, bottom) in parts:
+            by_dtype.setdefault(jnp.dtype(top.dtype), []).append(
+                (name, part, top, bottom)
+            )
+        out: Dict[Tuple[str, str], Any] = {}
+        for items in by_dtype.values():
+            bottoms = jnp.concatenate([b.reshape(-1) for _, _, _, b in items])
+            tops = jnp.concatenate([t.reshape(-1) for _, _, t, _ in items])
+            from_prev, from_next = exchange_boundary_rows(
+                bottoms, tops, self.n, self.axis
+            )
+            off = 0
+            for name, part, top, _ in items:
+                size, shape = top.size, top.shape
+                out[(name, part)] = (
+                    from_prev[off : off + size].reshape(shape),
+                    from_next[off : off + size].reshape(shape),
+                )
+                off += size
+        return out

@@ -59,6 +59,7 @@ from ..utils.config import (
     DistriConfig,
 )
 from .collectives import all_gather_seq
+from .compress import refresh_gather_seq, wire_nbytes
 from .guidance import branch_select, combine_guidance
 from .stepcache import is_shallow_at, run_cadence
 
@@ -88,6 +89,14 @@ class DiTDenoiseRunner:
             raise ValueError(
                 "comm_batch applies to the UNet's per-layer halo/moment "
                 "exchanges; the DiT path has one collective kind already"
+            )
+        if (distri_config.comm_compress != "none"
+                and distri_config.attn_impl != "gather"):
+            raise ValueError(
+                f"comm_compress compresses the displaced KV refresh gathers "
+                f"of attn_impl='gather'; {distri_config.attn_impl!r} has no "
+                "refresh collective to compress (ring carries the local "
+                "chunk; ulysses/usp are exact and stateless)"
             )
         n = distri_config.n_device_per_batch
         if (
@@ -294,13 +303,18 @@ class DiTDenoiseRunner:
             # refresh for the NEXT step: fresh gathered K/V flow only into
             # the carry (deferred consumption = overlappable collective).
             # Sync phase reuses the already-assembled gather; no_sync keeps
-            # the carried state untouched after warmup.
+            # the carried state untouched after warmup.  Stale refreshes
+            # route through the compression layer (parallel/compress.py) —
+            # a plain tiled gather at comm_compress="none", an int8/fp8
+            # payload + fp32-scale pair of gathers otherwise.
             if phase_sync:
                 fresh = jnp.stack(list(assembled["kv"]))
             elif no_refresh:
                 fresh = kv_blk
             else:
-                fresh = jnp.stack([all_gather_seq(k), all_gather_seq(v)])
+                fresh = refresh_gather_seq(
+                    jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset
+                )
             return h_out, fresh
 
         def block_body_ring(carry, xs):
@@ -769,6 +783,21 @@ class DiTDenoiseRunner:
             per_step = a2a + ring_hops + eps_gather
         report = {"layout": cfg.attn_impl, "kv_state_elems": int(state),
                   "per_step_collective_elems": int(per_step)}
+        # wire bytes: sync steps always move full precision; stale steps
+        # move the compressed payload + fp32 scales when comm_compress is on
+        # (gather layout only — the other layouts reject the knob)
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        report["comm_compress"] = cfg.comm_compress
+        report["sync_step_collective_bytes"] = int(per_step) * itemsize
+        if cfg.attn_impl == "gather" and cfg.comm_compress != "none":
+            refresh = depth * n * wire_nbytes(
+                (2, b, chunk, hid), itemsize, cfg.comm_compress
+            )
+            report["per_step_collective_bytes"] = int(
+                refresh + eps_gather * itemsize
+            )
+        else:
+            report["per_step_collective_bytes"] = int(per_step) * itemsize
         if cfg.step_cache_enabled:
             # shallow steps run only d_keep of depth blocks, so the
             # per-block exchange volume scales down proportionally; the

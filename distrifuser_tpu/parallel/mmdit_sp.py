@@ -55,6 +55,7 @@ from ..ops.linear import linear
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import all_gather_seq
+from .compress import refresh_gather_seq, wire_nbytes
 from .guidance import branch_select, combine_guidance
 from .stepcache import is_shallow_at, run_cadence
 
@@ -91,6 +92,13 @@ class MMDiTDenoiseRunner:
             raise ValueError(
                 "comm_batch applies to the UNet's per-layer halo/moment "
                 "exchanges; the MMDiT path has one collective kind already"
+            )
+        if (distri_config.comm_compress != "none"
+                and distri_config.attn_impl != "gather"):
+            raise ValueError(
+                "comm_compress compresses the displaced image-KV refresh "
+                "gathers of attn_impl='gather'; 'ring' carries only the "
+                "local chunk and has no refresh collective to compress"
             )
         n = distri_config.n_device_per_batch
         if mmdit_config.num_tokens % n != 0:
@@ -190,12 +198,17 @@ class MMDiTDenoiseRunner:
 
         def _gather_refresh(box, kv_blk, k, v):
             # refresh for the NEXT step: deferred consumption lets XLA
-            # overlap the gather with the remaining blocks' compute
+            # overlap the gather with the remaining blocks' compute.  Stale
+            # refreshes route through the compression layer
+            # (parallel/compress.py): a plain tiled gather at
+            # comm_compress="none", int8/fp8 payload + fp32 scales otherwise
             if phase_sync:
                 return jnp.stack(list(box["kv"]))
             if no_refresh:
                 return kv_blk
-            return jnp.stack([all_gather_seq(k), all_gather_seq(v)])
+            return refresh_gather_seq(
+                jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset
+            )
 
         def block_body_gather(carry, xs):
             hx, hc = carry
@@ -765,6 +778,20 @@ class MMDiTDenoiseRunner:
             per_step = n_attn * 2 * b * n_tok * hid + out_gather
         report = {"layout": layout, "kv_state_elems": int(state),
                   "per_step_collective_elems": int(per_step)}
+        # wire bytes: sync full-precision always; stale compressed when
+        # comm_compress is on (gather layout only — ring rejects the knob)
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        report["comm_compress"] = cfg.comm_compress
+        report["sync_step_collective_bytes"] = int(per_step) * itemsize
+        if layout == "gather" and cfg.comm_compress != "none":
+            refresh = n_attn * n * wire_nbytes(
+                (2, b, chunk, hid), itemsize, cfg.comm_compress
+            )
+            report["per_step_collective_bytes"] = int(
+                refresh + out_gather * itemsize
+            )
+        else:
+            report["per_step_collective_bytes"] = int(per_step) * itemsize
         if cfg.step_cache_enabled:
             # shallow steps run d_keep of depth joint blocks (the dual
             # prefix always runs — the cut sits past it); the output gather

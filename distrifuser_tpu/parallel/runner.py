@@ -52,6 +52,7 @@ from .context import (
     KIND_REGISTRY,
     PHASE_STALE,
     PHASE_SYNC,
+    WIRE_REGISTRY,
     PatchContext,
 )
 from .guidance import branch_select, combine_guidance
@@ -162,6 +163,7 @@ class DenoiseRunner:
                 phase=phase,
                 attn_impl=cfg.attn_impl,
                 batch_comm=cfg.comm_batch,
+                compress=cfg.comm_compress,
                 state_in=pstate,
                 text_kv=text_kv,
             )
@@ -748,12 +750,19 @@ class DenoiseRunner:
 
         ``per_phase=True`` returns the step-cache-aware breakdown instead:
         ``{"phases": {"sync"|"stale"|"shallow": {kind: fresh-exchange
-        elements}}, "flops": {...}}`` — per phase, only the state a step
-        FRESHLY exchanges is counted (carried-through deep buffers are
-        excluded via CARRIED_REGISTRY), and ``flops`` estimates the
-        full-vs-shallow step cost via XLA cost analysis
-        (``_flop_estimate``), so the cache's compute and comm savings are
-        inspectable without a chip.
+        elements}}, "bytes": {phase: {kind: wire bytes}}, "flops": {...}}``
+        — per phase, only the state a step FRESHLY exchanges is counted
+        (carried-through deep buffers are excluded via CARRIED_REGISTRY).
+        ``bytes`` is wire-accurate: compressed refresh payloads count their
+        int8/fp8 elements + fp32 scales (context.WIRE_REGISTRY, populated
+        at emit time by the exchanging op itself), wire-free local carries
+        (the step-cache deep feature, residual own-rows) count zero, and
+        everything else defaults to elements x dtype itemsize — so
+        warmup/sync bytes are identical across comm_compress modes by
+        construction, and the stale-phase reduction is a checked number.
+        ``flops`` estimates the full-vs-shallow step cost via XLA cost
+        analysis (``_flop_estimate``), so the cache's compute and comm
+        savings are inspectable without a chip.
         """
         cfg = self.cfg
         if per_phase:
@@ -815,11 +824,13 @@ class DenoiseRunner:
         CARRIED_REGISTRY)."""
         cfg = self.cfg
         if cfg.parallelism != "patch":
-            return {"phases": {}, "flops": None}
+            return {"phases": {}, "bytes": {}, "flops": None}
         self.scheduler.set_timesteps(2)
         lat, enc, added, gs = self._abstract_inputs(
             batch_size, text_len, per_group=True
         )
+        # kinds that live in the carry without ever touching the wire
+        wire_free = ("stepcache", "local")
 
         def trace(step, pstate_in):
             has_state = pstate_in is not None
@@ -841,6 +852,7 @@ class DenoiseRunner:
                 args += (pstate_in,)
                 specs += (P(),)
             CARRIED_REGISTRY.clear()
+            WIRE_REGISTRY.clear()
             shapes = jax.eval_shape(
                 lambda *a: shard_map(
                     one_step, mesh=cfg.mesh, in_specs=specs,
@@ -849,30 +861,43 @@ class DenoiseRunner:
                 *args,
             )
             carried = set(CARRIED_REGISTRY)
+            wire = dict(WIRE_REGISTRY)
             if shapes is None:  # stateless step (single device, cache off)
                 shapes = {}
             report: Dict[str, int] = {}
+            nbytes: Dict[str, int] = {}
             for name, s in shapes.items():
                 if name in carried:
                     continue
                 t = KIND_REGISTRY.get(name, "other")
-                report[t] = report.get(t, 0) + int(np.prod(s.shape))
-            return shapes, report
+                numel = int(np.prod(s.shape))
+                report[t] = report.get(t, 0) + numel
+                if name in wire:
+                    b = wire[name]
+                elif t in wire_free:
+                    b = 0
+                else:
+                    b = numel * jnp.dtype(s.dtype).itemsize
+                nbytes[t] = nbytes.get(t, 0) + b
+            return shapes, report, nbytes
 
         phases: Dict[str, Dict[str, int]] = {}
-        sync_shapes, phases["sync"] = trace(self._make_step(PHASE_SYNC), None)
+        bytes_: Dict[str, Dict[str, int]] = {}
+        sync_shapes, phases["sync"], bytes_["sync"] = trace(
+            self._make_step(PHASE_SYNC), None
+        )
         one_phase = cfg.mode == "full_sync" or not cfg.is_sp
         if not one_phase:
-            _, phases["stale"] = trace(
+            _, phases["stale"], bytes_["stale"] = trace(
                 self._make_step(PHASE_STALE), sync_shapes
             )
         if cfg.step_cache_enabled:
             steady = PHASE_SYNC if one_phase else PHASE_STALE
-            _, phases["shallow"] = trace(
+            _, phases["shallow"], bytes_["shallow"] = trace(
                 self._make_step(steady, shallow=True), sync_shapes
             )
-        return {"phases": phases, "flops": self._flop_estimate(batch_size,
-                                                               text_len)}
+        return {"phases": phases, "bytes": bytes_,
+                "flops": self._flop_estimate(batch_size, text_len)}
 
     def _flop_estimate(self, batch_size: int = None,
                        text_len: int = 77) -> Optional[Dict[str, float]]:

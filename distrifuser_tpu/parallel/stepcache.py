@@ -106,6 +106,21 @@ def run_cadence(
     return carry
 
 
+def phase_step_counts(num_steps: int, warmup_steps: int, interval: int):
+    """How a run of ``num_steps`` splits across the static phases:
+    ``{"sync": warmup steps, "stale": full steady steps, "shallow":
+    shallow steady steps}``.  With the cache off (interval <= 1) every
+    post-warmup step is a full stale step.  The bridge between
+    ``comm_volume_report(per_phase=True)``'s per-STEP numbers and a whole
+    run's traffic — scripts/bench_compress.py multiplies the two."""
+    if num_steps <= 0:
+        return {"sync": 0, "stale": 0, "shallow": 0}
+    n_sync = min(warmup_steps + 1, num_steps)
+    rest = num_steps - n_sync
+    shallow = (rest - rest // interval) if interval > 1 else 0
+    return {"sync": n_sync, "stale": rest - shallow, "shallow": shallow}
+
+
 def shallow_step_count(num_steps: int, warmup_steps: int, interval: int) -> int:
     """How many of ``num_steps`` denoise steps run shallow under the cadence
     (0 when the cache is off, i.e. interval <= 1).
@@ -113,9 +128,6 @@ def shallow_step_count(num_steps: int, warmup_steps: int, interval: int) -> int:
     Steps 0..min(warmup_steps, num_steps-1) are synchronous full runs; the
     remaining ``rest`` follow the shallow-first cadence, so
     ``rest - rest // interval`` of them are shallow.  Used by the serve
-    layer's shallow-step-share metrics and the bench report."""
-    if interval <= 1 or num_steps <= 0:
-        return 0
-    n_sync = min(warmup_steps + 1, num_steps)
-    rest = num_steps - n_sync
-    return rest - rest // interval
+    layer's shallow-step-share metrics and the bench report.  Delegates to
+    ``phase_step_counts`` so the cadence arithmetic has one home."""
+    return phase_step_counts(num_steps, warmup_steps, interval)["shallow"]

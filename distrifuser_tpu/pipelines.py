@@ -418,6 +418,55 @@ class _GenerationMixin:
             "shallow_steps": shallow,
         }
 
+    def comm_plan(self, num_inference_steps: int) -> dict:
+        """What one generation will put on the wire: per-phase bytes per
+        step (from the runner's comm report, compression-aware) times the
+        phase step counts — the byte-level companion of step_cache_plan.
+        ``total_bytes`` is per device, gathered-buffer convention; DiT/MMDiT
+        shallow steps are scaled from the closed-form element ratio."""
+        from .parallel.stepcache import phase_step_counts
+
+        cfg = self.distri_config
+        counts = phase_step_counts(
+            num_inference_steps, cfg.warmup_steps,
+            cfg.step_cache_interval if cfg.step_cache_enabled else 1,
+        )
+        per_step = {}
+        runner = self.runner
+        if hasattr(runner, "comm_volume_report"):  # UNet families
+            rep = runner.comm_volume_report(per_phase=True)
+            per_step = {ph: sum(kinds.values())
+                        for ph, kinds in rep.get("bytes", {}).items()}
+            if per_step and "stale" not in per_step:  # one-phase configs
+                per_step["stale"] = per_step.get("sync", 0)
+        elif hasattr(runner, "comm_report"):  # DiT/MMDiT closed forms
+            rep = runner.comm_report()
+            if "per_step_collective_bytes" in rep:
+                per_step = {
+                    "sync": rep.get("sync_step_collective_bytes", 0),
+                    "stale": rep["per_step_collective_bytes"],
+                }
+                sc = rep.get("step_cache")
+                elems = rep.get("per_step_collective_elems", 0)
+                if sc and elems:
+                    per_step["shallow"] = (
+                        per_step["stale"]
+                        * sc["shallow_per_step_collective_elems"] // elems
+                    )
+        if not per_step:
+            # no byte-modeled report for this runner (PipeFusion's ring
+            # micro-pipeline, non-sp early returns): say so rather than
+            # returning a confident-looking zero
+            return {"comm_compress": cfg.comm_compress, "steps": counts,
+                    "bytes_per_step": {}, "total_bytes": None}
+        total = sum(per_step.get(ph, 0) * n for ph, n in counts.items())
+        return {
+            "comm_compress": cfg.comm_compress,
+            "steps": counts,
+            "bytes_per_step": per_step,
+            "total_bytes": int(total),
+        }
+
     def set_stepwise(self, enabled: bool = True) -> None:
         """Switch the denoise loop between the fused compiled scan and
         the host-driven stepwise loop (the reference's --no_cuda_graph

@@ -38,7 +38,10 @@ class ExecKey:
     a different XLA program.  The step-cache cadence knobs
     (``step_cache_interval``/``step_cache_depth``, DistriConfig) are compile
     fields too: the cadence is static per compilation, so two requests
-    differing only in cadence must not share an executor.  ``exec_mode``
+    differing only in cadence must not share an executor — and so is
+    ``comm_compress`` (DistriConfig semantics): the stale-refresh
+    quantize/dequantize ops are traced into the program, so a mode change
+    is a different executable.  ``exec_mode``
     ("fused" | "stepwise") selects the denoise-loop dispatch: the fused
     compiled scan, or the host-driven stepwise loop — same numerics, a
     much smaller program; the resilience layer's degradation ladder
@@ -54,6 +57,7 @@ class ExecKey:
     mesh_plan: str
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    comm_compress: str = "none"
     exec_mode: str = "fused"
 
     def __post_init__(self):
@@ -62,14 +66,23 @@ class ExecKey:
                 f"exec_mode must be 'fused' or 'stepwise', got "
                 f"{self.exec_mode!r}"
             )
+        from ..parallel.compress import COMPRESS_MODES
+
+        if self.comm_compress not in COMPRESS_MODES:
+            raise ValueError(
+                f"comm_compress must be one of {COMPRESS_MODES}, got "
+                f"{self.comm_compress!r}"
+            )
 
     def short(self) -> str:
         g = "cfg" if self.cfg else "nocfg"
         sc = (f":sc{self.step_cache_interval}x{self.step_cache_depth}"
               if self.step_cache_interval > 1 else "")
+        cc = ("" if self.comm_compress == "none"
+              else f":{self.comm_compress}")
         em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
         return (f"{self.model_id}:{self.height}x{self.width}"
-                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}{em}")
+                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}{cc}{em}")
 
 
 class ExecutorCache:

@@ -127,6 +127,12 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
             and (dcfg.step_cache_interval, dcfg.step_cache_depth) != (1, 0)):
         dcfg.step_cache_interval = 1
         dcfg.step_cache_depth = 0
+    # same convention for stale-refresh compression: forcing the exact
+    # "none" direction is always safe (the uncompressed exchange has no
+    # support requirements); a key *requesting* a mode the builder didn't
+    # configure is the builder's job, like the cadence above
+    if key.comm_compress == "none" and dcfg.comm_compress != "none":
+        dcfg.comm_compress = "none"
     if key.exec_mode == "stepwise":
         pipeline.set_stepwise(True)
 

@@ -235,6 +235,7 @@ class InferenceServer:
             mesh_plan=self.mesh_plan,
             step_cache_interval=self.config.step_cache_interval,
             step_cache_depth=self.config.step_cache_depth,
+            comm_compress=self.config.comm_compress,
         )
 
     def _batch_cap_for(self, key: BatchKey) -> Optional[int]:

@@ -135,6 +135,17 @@ class DistriConfig:
     # wider overlap window; turn on if an ICI profile shows per-collective
     # launch overhead dominating (~60 small collectives/step at 8-way).
     comm_batch: bool = False
+    # Lossy compression of the stale-phase refresh payloads
+    # (parallel/compress.py): "none" (default, bit-identical), "int8"
+    # (symmetric per-tile int8 + fp32 scales, ~2x bf16 / ~4x fp32 byte
+    # reduction), "fp8" (float8_e4m3fn payload where the jax build has it),
+    # or "int8_residual" (int8 over the delta against the previous stale
+    # value carried in the patch state — adjacent denoising steps are
+    # near-identical, so the residual's dynamic range and hence the error
+    # is far smaller).  Warmup/sync exchanges always stay full-precision;
+    # GroupNorm moment exchanges never compress (tiny, cancellation-
+    # sensitive).  Composes with comm_batch and the step cache.
+    comm_compress: str = "none"
     # Sequence-parallel VAE decode over the sp axis (exact: fresh halo convs,
     # psum'd GroupNorm, ring mid attention — models/vae.py decode_sp).  The
     # reference decodes the full latent replicated on every rank; this is n x
@@ -203,6 +214,16 @@ class DistriConfig:
         if self.height % 8 != 0 or self.width % 8 != 0:
             # Same constraint as the reference pipelines (pipelines.py:71).
             raise ValueError("height and width must be multiples of 8")
+        # lazy import: parallel.compress imports SP_AXIS from this module
+        from ..parallel.compress import validate_mode
+
+        validate_mode(self.comm_compress)
+        if self.comm_compress != "none" and self.parallelism != "patch":
+            raise ValueError(
+                "comm_compress targets the displaced-patch refresh "
+                f"exchanges (parallelism='patch'); {self.parallelism!r} has "
+                "no stale refresh traffic to compress"
+            )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
         if self.step_cache_enabled:
@@ -546,6 +567,12 @@ class ServeConfig:
     # DistriConfig with the same knobs.
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    # Service-wide stale-refresh compression (DistriConfig.comm_compress
+    # semantics): threaded into every ExecKey — a mode change invalidates
+    # compiled executors, the same contract as the cadence knobs.  The
+    # pipeline builder behind executor_factory must construct its
+    # DistriConfig with the same mode.
+    comm_compress: str = "none"
     # Failure handling: retries/backoff, per-key circuit breakers, the
     # execution watchdog, and the graceful-degradation ladder — see
     # ResilienceConfig above and docs/SERVING.md "Failure modes & tuning".
@@ -576,6 +603,9 @@ class ServeConfig:
             )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
+        from ..parallel.compress import validate_mode
+
+        validate_mode(self.comm_compress)
         # BucketTable owns bucket validation and the area-major ordering
         # invariant ("smallest covering bucket" scans front-to-back) — one
         # normalization, not a copy here that could drift.  Lazy import:

@@ -52,6 +52,19 @@ _DM_OPS = frozenset({
 })
 # ops that may appear in a data-movement fusion without consuming anything
 _DM_SOURCES = frozenset({"parameter", "constant", "iota"})
+# cheap elementwise arithmetic a *carry-only* chain may traverse and still
+# count as latency-hidden (``elementwise_carry=True``): the compressed
+# refresh path's dequantize (convert x scale-multiply [+ residual add],
+# parallel/compress.py) lands here — the scheduler can sink these past all
+# of the iteration's real compute exactly like a copy, since nothing this
+# iteration reads their result.  Deliberately excludes dot/convolution/
+# reduce and every collective opcode: traversing those means real compute
+# (or another exchange) consumed the value this iteration.
+_EW_OPS = frozenset({
+    "add", "subtract", "multiply", "divide", "negate", "abs", "sign",
+    "maximum", "minimum", "clamp", "compare", "select",
+    "round-nearest-even", "round-nearest-afz",
+})
 
 _ATTR_REF = re.compile(r"(?:condition|body)=%[\w.\-]+")
 _CALLS = re.compile(r"calls=%?([\w.\-]+)")
@@ -90,6 +103,12 @@ class LoopReport:
     body: str
     deferred: Dict[str, str]  # instruction name -> opcode
     inline: Dict[str, str]
+    # collectives whose value reaches only the carry but through cheap
+    # elementwise arithmetic (the compressed-refresh dequantize chain);
+    # populated only under ``elementwise_carry=True`` — the default
+    # classification keeps them in ``inline``, preserving the strict
+    # pure-data-movement invariant of the uncompressed program.
+    deferred_compute: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def n_deferred(self) -> int:
@@ -99,34 +118,49 @@ class LoopReport:
     def n_inline(self) -> int:
         return len(self.inline)
 
+    @property
+    def n_deferred_compute(self) -> int:
+        return len(self.deferred_compute)
+
 
 class _Analyzer:
     def __init__(self, hlo_text: str):
         self.blocks = parse_computations(hlo_text)
         self._dm_comp: Dict[str, bool] = {}
+        self._ew_comp: Dict[str, bool] = {}
 
     def _computation_is_dm(self, name: str) -> bool:
         """True if a (fusion) computation contains no arithmetic at all."""
-        if name in self._dm_comp:
-            return self._dm_comp[name]
-        self._dm_comp[name] = False  # cycle guard
+        return self._computation_ok(name, self._dm_comp, _DM_OPS)
+
+    def _computation_is_ew(self, name: str) -> bool:
+        """True if a (fusion) computation contains at most data movement
+        and the cheap elementwise arithmetic of ``_EW_OPS``."""
+        return self._computation_ok(name, self._ew_comp, _DM_OPS | _EW_OPS)
+
+    def _computation_ok(self, name: str, cache: Dict[str, bool],
+                        allowed) -> bool:
+        if name in cache:
+            return cache[name]
+        cache[name] = False  # cycle guard
         ok = True
         for ln in self.blocks.get(name, ()):
             if " = " not in ln:
                 continue
             op = _opcode(ln)
-            if op in _DM_OPS or op in _DM_SOURCES:
+            if op in allowed or op in _DM_SOURCES:
                 continue
             if op == "fusion":
                 m = _CALLS.search(ln)
-                if m and self._computation_is_dm(m.group(1)):
+                if m and self._computation_ok(m.group(1), cache, allowed):
                     continue
             ok = False
             break
-        self._dm_comp[name] = ok
+        cache[name] = ok
         return ok
 
-    def analyze_body(self, body: str) -> LoopReport | None:
+    def analyze_body(self, body: str,
+                     elementwise_carry: bool = False) -> LoopReport | None:
         lines = self.blocks.get(body, [])
         defs: Dict[str, str] = {}
         root = None
@@ -146,19 +180,24 @@ class _Analyzer:
                 if op in defs and op != n:
                     consumers[op].append(n)
 
-        def dm_consumer(name: str) -> bool:
-            """Consuming instruction is pure data movement?"""
+        def passthrough_consumer(name: str, allow_ew: bool) -> bool:
+            """Consuming instruction is pure data movement (or, with
+            ``allow_ew``, cheap elementwise arithmetic)?"""
             ln = defs[name]
             op = _opcode(ln)
-            if op in _DM_OPS:
+            if op in _DM_OPS or (allow_ew and op in _EW_OPS):
                 return True
             if op == "fusion":
                 m = _CALLS.search(ln)
-                return bool(m) and self._computation_is_dm(m.group(1))
+                if not m:
+                    return False
+                if allow_ew:
+                    return self._computation_is_ew(m.group(1))
+                return self._computation_is_dm(m.group(1))
             return False
 
-        def deferred(coll: str) -> bool:
-            """Value reaches only the carry, via data movement only."""
+        def deferred(coll: str, allow_ew: bool = False) -> bool:
+            """Value reaches only the carry, via passthrough ops only."""
             seen, frontier = set(), [coll]
             while frontier:
                 n = frontier.pop()
@@ -170,29 +209,43 @@ class _Analyzer:
                 for u in consumers[n]:
                     if u == root and _opcode(defs[u]) == "tuple":
                         continue
-                    if dm_consumer(u):
+                    if passthrough_consumer(u, allow_ew):
                         frontier.append(u)
                     else:
                         return False
             return True
 
-        d, i = {}, {}
+        d, dc, i = {}, {}, {}
         for n, ln in defs.items():
             if any(c in ln for c in _COLLECTIVES):
-                (d if deferred(n) else i)[n] = _opcode(ln)
-        if d or i:
-            return LoopReport(body, d, i)
+                if deferred(n):
+                    d[n] = _opcode(ln)
+                elif elementwise_carry and deferred(n, allow_ew=True):
+                    dc[n] = _opcode(ln)
+                else:
+                    i[n] = _opcode(ln)
+        if d or dc or i:
+            return LoopReport(body, d, i, dc)
         return None
 
 
-def analyze_loop_collectives(hlo_text: str) -> List[LoopReport]:
+def analyze_loop_collectives(
+    hlo_text: str, elementwise_carry: bool = False
+) -> List[LoopReport]:
     """Classify every while-body collective as deferred (carry-only through
-    data movement) or inline (computed with this iteration)."""
+    data movement) or inline (computed with this iteration).
+
+    ``elementwise_carry=True`` adds a third bucket, ``deferred_compute``:
+    carry-only through data movement PLUS cheap elementwise arithmetic —
+    where the compressed refresh path's quantize/dequantize converts land
+    (comm_compress, parallel/compress.py).  Off by default so the strict
+    invariant of uncompressed programs (pure data movement to the carry)
+    keeps being checked as-is."""
     analyzer = _Analyzer(hlo_text)
     bodies = set(re.findall(r"body=%?([\w.\-]+)", hlo_text))
     reports = []
     for body in sorted(bodies):
-        r = analyzer.analyze_body(body)
+        r = analyzer.analyze_body(body, elementwise_carry)
         if r is not None:
             reports.append(r)
     return reports
@@ -204,10 +257,18 @@ def format_report(reports: List[LoopReport]) -> str:
     out = []
     for r in reports:
         out.append(
-            f"loop body {r.body}: {r.n_deferred} deferred / {r.n_inline} inline"
+            f"loop body {r.body}: {r.n_deferred} deferred"
+            + (f" / {r.n_deferred_compute} deferred-compute"
+               if r.deferred_compute else "")
+            + f" / {r.n_inline} inline"
         )
         if r.deferred:
             out.append(f"  deferred (overlappable): {dict(Counter(r.deferred.values()))}")
+        if r.deferred_compute:
+            out.append(
+                "  deferred-compute (dequant chains): "
+                f"{dict(Counter(r.deferred_compute.values()))}"
+            )
         if r.inline:
             out.append(f"  inline (serializing):    {dict(Counter(r.inline.values()))}")
     return "\n".join(out) if out else "no while-loop collectives found"

@@ -1,0 +1,163 @@
+"""Stale-refresh compression micro-bench: bytes/step + steps/sec per mode.
+
+Tiny-config CPU-runnable probe of the comm_compress knob
+(parallel/compress.py): build otherwise-identical displaced-patch UNet
+runners — one per requested mode — report each mode's per-phase wire bytes
+from ``comm_volume_report(per_phase=True)["bytes"]`` (the byte-accurate
+accounting: int8/fp8 payloads + fp32 scales vs raw elements), multiply by
+the phase step counts (``stepcache.phase_step_counts``) for whole-run
+traffic, and time the fused denoise loop for steps/sec.  Emits ONE JSON
+line.
+
+On the CPU mesh the steps/sec numbers mostly show the quantize/dequantize
+overhead is small — the latency WIN needs real ICI (the collectives here
+are memcpys); the byte reduction column is the number the knob exists for,
+and it is exact on any backend.  The script gates on the acceptance
+criterion: >= 1.9x stale-phase byte reduction at int8 and sync bytes
+identical to "none".
+
+Timing discipline matches bench_stepcache.py: compile outside the timed
+window, every repeat ends in a `jax.device_get` data dependency.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_compress.py \
+        [--steps 12] [--devices 2] [--modes none,int8,int8_residual] \
+        [--repeats 3] [--out FILE]
+
+The tier-1 workflow runs this and uploads the line as an artifact, next to
+the step-cache and chaos benches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--devices", type=int, default=2,
+                    help="sp-axis width; >1 so the refresh exchange exists")
+    ap.add_argument("--height", type=int, default=128)
+    ap.add_argument("--width", type=int, default=128)
+    ap.add_argument("--warmup_steps", type=int, default=1)
+    ap.add_argument("--modes", type=str,
+                    default="none,int8,fp8,int8_residual")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also append the JSON line to this file")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(8, args.devices)}"
+            ).strip()
+    import jax
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.parallel.compress import fp8_supported
+    from distrifuser_tpu.parallel.runner import DenoiseRunner
+    from distrifuser_tpu.parallel.stepcache import phase_step_counts
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    modes = [m for m in args.modes.split(",") if m]
+    if not fp8_supported() and "fp8" in modes:
+        modes.remove("fp8")
+
+    ucfg = tiny_config(sdxl=False)
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    # cfg-split OFF keeps all devices on the sp axis, so the refresh
+    # exchange spans exactly --devices peers
+    common = dict(
+        devices=jax.devices()[: args.devices], height=args.height,
+        width=args.width, warmup_steps=args.warmup_steps,
+        parallelism="patch", do_classifier_free_guidance=False,
+    )
+    counts = phase_step_counts(args.steps, args.warmup_steps, 1)
+
+    k = jax.random.PRNGKey(7)
+    cfg0 = DistriConfig(**common)
+    lat = jax.random.normal(
+        k, (1, cfg0.latent_height, cfg0.latent_width, ucfg.in_channels)
+    )
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (1, 1, 77, ucfg.cross_attention_dim)
+    )
+
+    per_mode = {}
+    for mode in modes:
+        cfg = DistriConfig(comm_compress=mode, **common)
+        runner = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+        rep = runner.comm_volume_report(per_phase=True)
+        bps = {ph: sum(kinds.values()) for ph, kinds in rep["bytes"].items()}
+        bps.setdefault("stale", bps.get("sync", 0))
+        total = sum(bps.get(ph, 0) * n for ph, n in counts.items())
+
+        gen = lambda: jax.device_get(  # noqa: E731 — data dep ends the clock
+            runner.generate(lat, enc, num_inference_steps=args.steps,
+                            guidance_scale=1.0)
+        )
+        gen()  # compile outside the timed window
+        best = min(
+            (lambda t0: (gen(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            )
+            for _ in range(args.repeats)
+        )
+        per_mode[mode] = {
+            "bytes_per_step": bps,
+            "run_bytes": int(total),
+            "steps_per_s": round(args.steps / best, 3),
+        }
+
+    base = per_mode.get("none")
+    line = {
+        "bench": "compress",
+        "backend": jax.default_backend(),
+        "steps": args.steps,
+        "devices": args.devices,
+        "warmup_steps": args.warmup_steps,
+        "height": args.height,
+        "width": args.width,
+        "phase_steps": counts,
+        "modes": per_mode,
+    }
+    ok = True
+    if base is not None:
+        for mode, rec in per_mode.items():
+            if mode == "none":
+                continue
+            stale_off = base["bytes_per_step"].get("stale", 0)
+            stale_on = rec["bytes_per_step"].get("stale", 0)
+            rec["stale_byte_reduction"] = (
+                round(stale_off / stale_on, 3) if stale_on else None
+            )
+            rec["sync_bytes_identical"] = (
+                rec["bytes_per_step"].get("sync")
+                == base["bytes_per_step"].get("sync")
+            )
+            ok &= rec["sync_bytes_identical"]
+            if mode == "int8":
+                ok &= (rec["stale_byte_reduction"] or 0) >= 1.9
+    line["ok"] = bool(ok)
+    print(json.dumps(line), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -208,7 +208,7 @@ def main():
                     res[name] = round(timed(fn, q, k, v) * 1e3, 3)
                 except Exception as e:
                     res[name] = f"failed:{type(e).__name__}"
-            emit("attn", L=L, heads=H, head_dim=d, ms=res)
+            emit("attn", L=L, heads=H, head_dim=d, batch=2, ms=res)
 
     # ---------------- tune: flash-kernel tile sweeps -----------------------
     if "tune" in phases and left() > 600:
@@ -248,7 +248,8 @@ def main():
                         ) * 1e3, 3)
                     except Exception as e:
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
-                emit(phase_name, L=L, heads=H, head_dim=C // H, ms=res)
+                emit(phase_name, L=L, heads=H, head_dim=C // H, batch=2,
+                     ms=res)
 
     # ---------------- full-model latencies --------------------------------
     def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather",

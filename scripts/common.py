@@ -79,6 +79,12 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--comm_batch", action="store_true",
                         help="batch stale-refresh collectives into one flat "
                         "exchange per step (analog of comm_checkpoint batching)")
+    parser.add_argument("--comm_compress", type=str, default="none",
+                        choices=["none", "int8", "fp8", "int8_residual"],
+                        help="quantize stale-refresh halo/KV payloads on the "
+                        "wire (int8/fp8 + per-tile fp32 scales; "
+                        "int8_residual delta-codes against the carried "
+                        "stale value — docs/PERF.md)")
     parser.add_argument("--no_vae_sp", action="store_true",
                         help="disable the sequence-parallel VAE decode "
                         "(replicate the dense decode on every device instead)")
@@ -125,6 +131,7 @@ def config_from_args(args) -> DistriConfig:
         attn_impl=args.attn_impl,
         ulysses_degree=args.ulysses_degree,
         comm_batch=args.comm_batch,
+        comm_compress=args.comm_compress,
         hybrid_loop=args.hybrid_loop,
         vae_sp=not args.no_vae_sp,
         dtype=None if args.dtype is None else getattr(jnp, args.dtype),

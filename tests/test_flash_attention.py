@@ -159,3 +159,77 @@ def test_padded_flash_matches_reference():
     ).reshape(b, 256, c)
     np.testing.assert_allclose(np.asarray(out128), np.asarray(ref128),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_padding_segment_ids_match_kv_len_semantics():
+    """ADVICE r5: the upstream SegmentIds pad mask, built for an unaligned
+    shape, must encode exactly the in-repo kernel's static kv_len mask —
+    real query rows attend the first lk KV positions and nothing else.
+    Pure mask math, CI-exercisable without a Mosaic compile."""
+    from distrifuser_tpu.ops.flash_attention import padding_segment_ids
+
+    b, lq, lk = 2, 330, 215  # both unaligned; pad to 384 / 256
+    lq_pad, lk_pad = 384, 256
+    seg = padding_segment_ids(b, lq, lq_pad, lk, lk_pad)
+    assert seg.q.shape == (b, lq_pad) and seg.kv.shape == (b, lk_pad)
+    # the upstream kernel masks cross-segment pairs: allowed = equal ids
+    allowed = np.asarray(seg.q)[:, :, None] == np.asarray(seg.kv)[:, None, :]
+    col = np.arange(lk_pad)
+    for i in range(lq):  # real rows: exactly the kv_len mask col < lk
+        np.testing.assert_array_equal(allowed[0, i], col < lk)
+    # pad rows attend only pad KV (garbage rows the caller slices off) —
+    # never real tokens, so they cannot perturb the normalizer of real rows
+    for i in range(lq, lq_pad):
+        np.testing.assert_array_equal(allowed[0, i], col >= lk)
+
+
+def test_padded_flash_honors_inrepo_pin_and_probe(monkeypatch):
+    """ADVICE r5: DISTRIFUSER_TPU_FLASH_IMPL=inrepo must keep
+    padded_flash_sdpa off the upstream segment-ids path, and the DEFAULT
+    upstream route must consult the probe verdict
+    (attention._upstream_flash_available) so a Mosaic backend-compile
+    failure degrades instead of killing generate()."""
+    import importlib
+
+    attn_mod = importlib.import_module("distrifuser_tpu.ops.attention")
+    fa = importlib.import_module("distrifuser_tpu.ops.flash_attention")
+
+    b, heads, d = 1, 2, 16
+    c = heads * d
+    lq = lk = 200  # unaligned -> pads to 256
+    keys = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(keys[0], (b, lq, c))
+    k = jax.random.normal(keys[1], (b, lk, c))
+    v = jax.random.normal(keys[2], (b, lk, c))
+
+    calls = []
+
+    def spy_upstream(*a, **kw):
+        calls.append("upstream")
+        raise RuntimeError("should not be reached in these scenarios")
+
+    monkeypatch.setattr(fa, "upstream_flash_sdpa", spy_upstream)
+    monkeypatch.delenv("DISTRIFUSER_TPU_PADDED_IMPL", raising=False)
+
+    # 1) the kernel-wide inrepo pin routes the padded path in-repo too
+    monkeypatch.setenv("DISTRIFUSER_TPU_FLASH_IMPL", "inrepo")
+    out = fa.padded_flash_sdpa(q, k, v, heads=heads, interpret=True)
+    assert out.shape == (b, lq, c) and not calls
+
+    # 2) default route + failed probe: upstream is never attempted
+    monkeypatch.delenv("DISTRIFUSER_TPU_FLASH_IMPL", raising=False)
+    monkeypatch.setattr(attn_mod, "_UPSTREAM_PROBE_OK", False)
+    # interpret=False exercises the gate itself; the in-repo fallback then
+    # runs the real (non-interpret) kernel, which on CPU only works in
+    # interpret mode — so stub flash_sdpa to observe the routing only
+    monkeypatch.setattr(
+        fa, "flash_sdpa", lambda *a, **kw: jnp.zeros((b, 256, c))
+    )
+    out = fa.padded_flash_sdpa(q, k, v, heads=heads, interpret=False)
+    assert not calls, "probe said no, but upstream path was chosen"
+
+    # 3) an explicit upstream pin is honored past the probe (and its
+    # trace-time failure falls through to the in-repo kernel)
+    monkeypatch.setenv("DISTRIFUSER_TPU_PADDED_IMPL", "upstream")
+    out = fa.padded_flash_sdpa(q, k, v, heads=heads, interpret=False)
+    assert calls == ["upstream"]
