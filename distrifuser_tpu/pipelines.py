@@ -246,6 +246,37 @@ _WEIGHTLESS_WARNING = (
 )
 
 
+@dataclasses.dataclass
+class PipelineStages:
+    """Stage programs for one prepared (pipeline, steps) pair — the split
+    request path the staged serving executor (serve/staging.py) pipelines
+    across micro-batches:
+
+    * ``encode(prompts, negs) -> embeddings`` — tokenize + text-encode one
+      compiled-batch-width chunk; the returned pytree is family-opaque
+      (UNet: (embeds, added_cond); DiT: (embeds, caption_mask); MMDiT:
+      (embeds, pooled)) and is exactly what ``denoise`` consumes;
+    * ``denoise(embeddings, latents, guidance_scale) -> latent`` — the
+      compiled denoise-loop program (the mesh bottleneck resource);
+    * ``decode(latent) -> np images`` — chunked VAE decode plus the
+      device->host conversion, float RGB [N,H,W,3] in [0,1].
+
+    Every callable is the SAME code the monolithic ``__call__`` path runs
+    (``_stage_encode`` / ``_denoise_chunk`` / ``_decode_to_np``), so staged
+    and monolithic execution produce bit-identical images for identical
+    (prompt, seed, steps) — pipelining changes WHEN stages run, never what
+    they compute.  ``steps`` and the guidance mode are baked in: a stage
+    set serves exactly one compiled executor identity (serve ExecKey).
+    """
+
+    steps: int
+    batch_size: int
+    encode: Any
+    denoise: Any
+    decode: Any
+    init_noise_sigma: float
+
+
 def _mk_output(images, tokenizers) -> PipelineOutput:
     weightless = any(isinstance(t, SimpleTokenizer) for t in tokenizers)
     return PipelineOutput(
@@ -393,9 +424,16 @@ def _decode_chunked(decode, vae_params, latent, bs, scaling, shift=0.0):
 
 class _GenerationMixin:
     """Machinery shared by EVERY pipeline family (UNet, DiT, MMDiT): the
-    output packaging tail of __call__ and the serve layer's pre-bucketed
-    batched entry.  Requires ``distri_config``, ``vae_config``,
-    ``vae_params``, and ``_decode`` on the instance."""
+    output packaging tail of __call__, the staged-execution surface
+    (`prepare_stages`), and the serve layer's pre-bucketed batched entry.
+    Requires ``distri_config``, ``vae_config``, ``vae_params``, and
+    ``_decode`` on the instance, plus the family hooks ``_stage_encode``
+    (prompts, negs -> embeddings pytree) and ``_denoise_chunk``
+    (embeddings, latents, ... -> latent)."""
+
+    # SD3-family VAE latent re-centering (VAEConfig.shift_factor); zero for
+    # the legacy families.  Instance attribute on DistriSD3Pipeline.
+    _vae_shift: float = 0.0
 
     def step_cache_plan(self, num_inference_steps: int) -> dict:
         """How the temporal step-cache cadence (docs/PERF.md) plays out over
@@ -495,20 +533,58 @@ class _GenerationMixin:
             )
         self.distri_config.use_cuda_graph = not enabled
 
-    def _finalize(self, latent, output_type, tokenizers,
-                  shift: float = 0.0) -> "PipelineOutput":
-        """latent -> PipelineOutput for 'latent' | 'np' | 'pil'.  ``shift``
-        is the SD3-family VAE re-centering (zero for legacy families)."""
-        if output_type == "latent":
-            # one entry per image, matching the 'np'/'pil' contract
-            return _mk_output(list(np.asarray(latent)), tokenizers)
+    def _decode_to_np(self, latent) -> np.ndarray:
+        """latent -> float RGB [N,H,W,3] in [0,1]: the chunked VAE decode
+        plus device->host conversion tail — ONE code path shared by
+        `_finalize` (the monolithic __call__) and the staged executor's
+        decode stage, so the two execution modes decode identically."""
         image = _decode_chunked(
             self._decode, self.vae_params, latent,
             self.distri_config.batch_size, self.vae_config.scaling_factor,
-            shift,
+            self._vae_shift,
         )
         image = np.asarray(image, np.float32)
-        image = np.clip(image / 2 + 0.5, 0.0, 1.0)
+        return np.clip(image / 2 + 0.5, 0.0, 1.0)
+
+    def prepare_stages(self, num_inference_steps: int) -> "PipelineStages":
+        """Pre-build the request path as three separately-dispatchable
+        stage programs (text-encode / denoise / VAE-decode) for a staged
+        serving executor to overlap across micro-batches — batch k+1
+        encodes and batch k-1 decodes in the shadow of batch k's denoise
+        (serve/staging.py; docs/SERVING.md "Staged pipelining").
+
+        Compiles the denoise loop ahead of time (the same `prepare()` the
+        monolithic path uses) and fixes the scheduler's timestep table
+        here, OFF the dispatch path — stage invocations never mutate
+        shared scheduler state.  The returned callables are the exact
+        functions `__call__` runs, so staged and monolithic execution are
+        bit-identical (see `PipelineStages`)."""
+        self.scheduler.set_timesteps(num_inference_steps)
+        self.runner.prepare(num_inference_steps)
+        steps = num_inference_steps
+        # __call__ forces guidance_scale to 1 when CFG is off; the staged
+        # denoise program must apply the same normalization for identity
+        cfg_on = self.distri_config.do_classifier_free_guidance
+
+        def denoise(enc, latents, guidance_scale):
+            return self._denoise_chunk(
+                enc, latents, guidance_scale if cfg_on else 1.0, steps)
+
+        return PipelineStages(
+            steps=steps,
+            batch_size=self.distri_config.batch_size,
+            encode=self._stage_encode,
+            denoise=denoise,
+            decode=self._decode_to_np,
+            init_noise_sigma=float(self.scheduler.init_noise_sigma),
+        )
+
+    def _finalize(self, latent, output_type, tokenizers) -> "PipelineOutput":
+        """latent -> PipelineOutput for 'latent' | 'np' | 'pil'."""
+        if output_type == "latent":
+            # one entry per image, matching the 'np'/'pil' contract
+            return _mk_output(list(np.asarray(latent)), tokenizers)
+        image = self._decode_to_np(latent)
         if output_type == "np":
             return _mk_output(list(image), tokenizers)
         from PIL import Image
@@ -699,16 +775,11 @@ class _DistriPipelineBase(_GenerationMixin):
         }
 
         def run_chunk(cp, cn, cl, n_real):
-            embeds, added = self._encode(cp, cn, micro_cond)
+            enc = self._encode(cp, cn, micro_cond)
             cb = _wrap_chunk_callback(callback, n_real)
-            return self.runner.generate(
-                cl, embeds,
-                guidance_scale=guidance_scale,
-                num_inference_steps=num_inference_steps,
-                added_cond=added,
-                start_step=start_step,
-                end_step=end_step,
-                callback=cb,
+            return self._denoise_chunk(
+                enc, cl, guidance_scale, num_inference_steps,
+                start_step=start_step, end_step=end_step, callback=cb,
             )
 
         # seeded noise for the whole expanded batch (diffusers passes a torch
@@ -727,6 +798,27 @@ class _DistriPipelineBase(_GenerationMixin):
 
     def _encode(self, prompts, negs, micro_cond=None):
         raise NotImplementedError
+
+    # -- stage hooks (prepare_stages / __call__ share these) ---------------
+    def _stage_encode(self, prompts, negs):
+        """Encode-stage program: no micro-conditioning (the serve surface
+        has none), which `_encode` resolves to the same defaults __call__
+        passes — identical embeddings either way."""
+        return self._encode(prompts, negs, None)
+
+    def _denoise_chunk(self, enc, latents, guidance_scale,
+                       num_inference_steps, *, start_step=0, end_step=None,
+                       callback=None):
+        embeds, added = enc
+        return self.runner.generate(
+            latents, embeds,
+            guidance_scale=guidance_scale,
+            num_inference_steps=num_inference_steps,
+            added_cond=added,
+            start_step=start_step,
+            end_step=end_step,
+            callback=callback,
+        )
 
 
 class DistriSDXLPipeline(_DistriPipelineBase):
@@ -1174,19 +1266,29 @@ class DistriPixArtPipeline(_GenerationMixin):
         self.scheduler.set_timesteps(num_inference_steps)
 
         def run_chunk(cp, cn, cl, n_real):
-            emb, mask = self._encode(cp, cn)
+            enc = self._encode(cp, cn)
             cb = _wrap_chunk_callback(callback, n_real)
-            return self.runner.generate(
-                cl, emb, guidance_scale=guidance_scale,
-                num_inference_steps=num_inference_steps, cap_mask=mask,
-                callback=cb,
-            )
+            return self._denoise_chunk(
+                enc, cl, guidance_scale, num_inference_steps, callback=cb)
 
         latent = _batched_generate(
             cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
             latents, self.dit_config.in_channels, run_chunk,
         )
         return self._finalize(latent, output_type, [self.tokenizer])
+
+    # -- stage hooks (prepare_stages / __call__ share these) ---------------
+    def _stage_encode(self, prompts, negs):
+        return self._encode(prompts, negs)
+
+    def _denoise_chunk(self, enc, latents, guidance_scale,
+                       num_inference_steps, *, callback=None):
+        emb, mask = enc
+        return self.runner.generate(
+            latents, emb, guidance_scale=guidance_scale,
+            num_inference_steps=num_inference_steps, cap_mask=mask,
+            callback=callback,
+        )
 
 
 def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
@@ -1246,6 +1348,7 @@ class DistriSD3Pipeline(_GenerationMixin):
         self.mmdit_config = mmdit_config
         self.vae_config = vae_config
         self.vae_params = vae_params
+        self._vae_shift = vae_config.shift_factor
         self.scheduler = scheduler
         self.tokenizers = tokenizers
         self.text_encoders = text_encoders
@@ -1485,13 +1588,11 @@ class DistriSD3Pipeline(_GenerationMixin):
             )
 
         def run_chunk(cp, cn, cl, n_real):
-            enc, pooled = self._encode(cp, cn)
+            enc = self._encode(cp, cn)
             cb = _wrap_chunk_callback(callback, n_real)
-            return self.runner.generate(
-                cl, enc, pooled, guidance_scale=guidance_scale,
-                num_inference_steps=num_inference_steps,
-                start_step=start_step,
-                callback=cb,
+            return self._denoise_chunk(
+                enc, cl, guidance_scale, num_inference_steps,
+                start_step=start_step, callback=cb,
             )
 
         latent = _batched_generate(
@@ -1499,5 +1600,18 @@ class DistriSD3Pipeline(_GenerationMixin):
             latents, self.mmdit_config.in_channels, run_chunk,
         )
         toks = [t for t in self.tokenizers if t is not None]
-        return self._finalize(latent, output_type, toks,
-                              shift=self.vae_config.shift_factor)
+        return self._finalize(latent, output_type, toks)
+
+    # -- stage hooks (prepare_stages / __call__ share these) ---------------
+    def _stage_encode(self, prompts, negs):
+        return self._encode(prompts, negs)
+
+    def _denoise_chunk(self, enc, latents, guidance_scale,
+                       num_inference_steps, *, start_step=0, callback=None):
+        emb, pooled = enc
+        return self.runner.generate(
+            latents, emb, pooled, guidance_scale=guidance_scale,
+            num_inference_steps=num_inference_steps,
+            start_step=start_step,
+            callback=callback,
+        )

@@ -15,6 +15,9 @@ star: heavy traffic, mesh never idle):
   per-key circuit breakers + execution watchdog + the graceful-
   degradation ladder (serve/resilience.py), and deterministic fault
   injection (serve/faults.py) so all of it is testable on CPU;
+* `StagePipeline` — staged pipelining (serve/staging.py, behind
+  ``ServeConfig.pipeline_stages``): overlap text-encode, denoise, and
+  VAE-decode across micro-batches, bit-identical to monolithic dispatch;
 * `PipelineExecutor` — adapter from the repo's pipelines
   (serve/executors.py); `serve.testing` has the weightless fakes.
 
@@ -61,6 +64,10 @@ def __getattr__(name):
         from . import executors
 
         return getattr(executors, name)
+    if name in ("StagePipeline", "StagedBatch"):
+        from . import staging
+
+        return getattr(staging, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -96,6 +103,8 @@ __all__ = [
     "ServeError",
     "ServeResult",
     "ServerClosedError",
+    "StagePipeline",
+    "StagedBatch",
     "Watchdog",
     "WatchdogTimeoutError",
     "install_fault_plan",

@@ -91,6 +91,19 @@ class ExecutorCache:
     ``build_fn(key)`` constructs and warms an executor (expected to be
     expensive — it compiles); ``on_evict(key, executor)`` lets the owner
     release device buffers when an entry falls out.
+
+    **Pinning** (the staged serving pipeline, serve/staging.py): a staged
+    batch holds its executor across three asynchronous stage invocations,
+    so between dispatch and decode the LRU must not free the program a
+    stage worker is about to run.  ``get(key, pin=True)`` takes a
+    refcount on the returned executor; ``unpin(executor)`` drops it.
+    Pinned entries are skipped by capacity eviction (capacity may be
+    exceeded while every entry is pinned — correctness over the HBM
+    bound, which `max_inflight_batches` already caps); an entry evicted
+    by ``invalidate`` (or by LRU pressure racing the pin) while pinned
+    leaves the map immediately — the next ``get`` rebuilds — but its
+    ``on_evict`` release is DEFERRED to the last ``unpin``, so in-flight
+    stage work never executes against freed buffers.
     """
 
     def __init__(
@@ -105,9 +118,15 @@ class ExecutorCache:
         self.on_evict = on_evict
         self._entries: "OrderedDict[ExecKey, Any]" = OrderedDict()
         self._lock = threading.Lock()
+        # refcounts by executor identity (not key: a key may rebuild while
+        # the old instance is still pinned by in-flight staged work)
+        self._pins: Dict[int, int] = {}
+        self._pin_refs: Dict[int, Any] = {}  # id -> executor (keeps id stable)
+        self._deferred: Dict[int, Tuple[ExecKey, Any]] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.deferred_evictions = 0
         self.build_seconds = 0.0
 
     def __len__(self) -> int:
@@ -118,15 +137,58 @@ class ExecutorCache:
         with self._lock:
             return key in self._entries
 
-    def get(self, key: ExecKey) -> Tuple[Any, bool]:
+    def _pin_locked(self, ex: Any) -> None:
+        i = id(ex)
+        self._pins[i] = self._pins.get(i, 0) + 1
+        self._pin_refs[i] = ex
+
+    def _pinned_locked(self, ex: Any) -> bool:
+        return self._pins.get(id(ex), 0) > 0
+
+    def pin_count(self, ex: Any) -> int:
+        with self._lock:
+            return self._pins.get(id(ex), 0)
+
+    def unpin(self, ex: Any) -> None:
+        """Drop one pin.  If the executor was evicted/invalidated while
+        pinned, the LAST unpin fires its deferred ``on_evict``."""
+        fire: Optional[Tuple[ExecKey, Any]] = None
+        with self._lock:
+            i = id(ex)
+            n = self._pins.get(i, 0) - 1
+            if n > 0:
+                self._pins[i] = n
+                return
+            self._pins.pop(i, None)
+            self._pin_refs.pop(i, None)
+            fire = self._deferred.pop(i, None)
+        if fire is not None and self.on_evict:
+            self.on_evict(*fire)
+
+    def _evict_locked(self, key: ExecKey, ex: Any) -> Optional[Tuple[ExecKey, Any]]:
+        """Entry already removed from the map; returns the (key, ex) pair
+        to release now, or None when the release is deferred to unpin."""
+        self.evictions += 1
+        if self._pinned_locked(ex):
+            self.deferred_evictions += 1
+            self._deferred[id(ex)] = (key, ex)
+            return None
+        return (key, ex)
+
+    def get(self, key: ExecKey, pin: bool = False) -> Tuple[Any, bool]:
         """(executor, hit?) — builds on miss, evicting LRU entries beyond
-        capacity.  The build runs outside the lock: stats reads never stall
-        behind a multi-second compile."""
+        capacity (never pinned ones).  The build runs outside the lock:
+        stats reads never stall behind a multi-second compile.  With
+        ``pin=True`` the returned executor carries a refcount the caller
+        must drop via ``unpin``."""
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                return self._entries[key], True
+                ex = self._entries[key]
+                if pin:
+                    self._pin_locked(ex)
+                return ex, True
             self.misses += 1
         t0 = time.monotonic()
         ex = self.build_fn(key)
@@ -136,10 +198,26 @@ class ExecutorCache:
             self.build_seconds += dt
             self._entries[key] = ex
             self._entries.move_to_end(key)
-            while len(self._entries) > self.capacity:
-                old_key, old_ex = self._entries.popitem(last=False)
-                self.evictions += 1
-                evicted.append((old_key, old_ex))
+            if pin:
+                self._pin_locked(ex)
+            over = len(self._entries) - self.capacity
+            if over > 0:
+                # oldest-first victims, skipping pinned entries (and the
+                # entry just inserted — it is the MRU, never scanned first,
+                # but a capacity-1 cache makes it the only candidate)
+                for old_key in list(self._entries):
+                    if over <= 0:
+                        break
+                    if old_key == key:
+                        continue
+                    old_ex = self._entries[old_key]
+                    if self._pinned_locked(old_ex):
+                        continue
+                    del self._entries[old_key]
+                    pair = self._evict_locked(old_key, old_ex)
+                    if pair is not None:
+                        evicted.append(pair)
+                    over -= 1
         if self.on_evict:
             for old_key, old_ex in evicted:
                 self.on_evict(old_key, old_ex)
@@ -147,15 +225,18 @@ class ExecutorCache:
 
     def invalidate(self, key: ExecKey) -> bool:
         """Drop one entry (True if it was resident), firing ``on_evict``
-        so its device buffers can be released.  The resilience layer uses
-        this to evict a poisoned executor before retrying a degraded
-        build — a cached broken program must not satisfy the retry."""
+        so its device buffers can be released — DEFERRED to the last
+        ``unpin`` when staged work still holds the executor.  The
+        resilience layer uses this to evict a poisoned executor before
+        retrying a degraded build — a cached broken program must not
+        satisfy the retry."""
+        pair = None
         with self._lock:
             ex = self._entries.pop(key, None)
             if ex is not None:
-                self.evictions += 1
-        if ex is not None and self.on_evict:
-            self.on_evict(key, ex)
+                pair = self._evict_locked(key, ex)
+        if pair is not None and self.on_evict:
+            self.on_evict(*pair)
         return ex is not None
 
     def warmup(self, keys: Iterable[ExecKey]) -> int:
@@ -178,5 +259,7 @@ class ExecutorCache:
                 "misses": self.misses,
                 "hit_rate": (self.hits / total) if total else 0.0,
                 "evictions": self.evictions,
+                "deferred_evictions": self.deferred_evictions,
+                "pinned": sum(1 for n in self._pins.values() if n > 0),
                 "build_seconds": round(self.build_seconds, 6),
             }

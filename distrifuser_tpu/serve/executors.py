@@ -15,10 +15,27 @@ changes a request's image.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .cache import ExecKey
 from .faults import FaultPlan
+
+
+def _release_buffers(tree) -> None:
+    """Best-effort early free of device buffers in a pytree — the staged
+    pipeline's "latent donation between invocations": with up to
+    ``max_inflight_batches`` batches resident, a consumed stage input
+    (initial latents, embeddings) must hand its HBM back the moment its
+    consumer finishes, not whenever host GC next runs."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        delete = getattr(leaf, "delete", None)
+        if delete is not None:
+            try:
+                delete()
+            except Exception:  # noqa: BLE001 — already deleted / aliased
+                pass
 
 
 class PipelineExecutor:
@@ -28,6 +45,12 @@ class PipelineExecutor:
     key.width) with do_classifier_free_guidance == key.cfg and the key's
     scheduler family; ``prepare(key.steps)`` should already have run (the
     factory in `pipeline_executor_factory` does all of this).
+
+    Besides the monolithic ``__call__`` contract, the executor exposes the
+    three-stage contract the staged serving pipeline (serve/staging.py)
+    drives: ``encode_stage`` / ``denoise_stage`` / ``decode_stage``, built
+    on the pipeline's `prepare_stages` programs — the same code paths as
+    ``__call__``, so the two dispatch modes produce bit-identical images.
 
     ``fault_plan`` (serve/faults.py) injects at site ``"executor.execute"``
     for direct (server-less) executor use; a server-driven executor gets
@@ -42,6 +65,11 @@ class PipelineExecutor:
         self.key = key
         self.fault_plan = fault_plan
         self.batch_size = pipeline.distri_config.batch_size
+        # scheduler timesteps are per-(pipeline, steps) state: fix them
+        # ONCE here, on the prepare path — never from the per-dispatch
+        # latent draw, which must not mutate shared scheduler state
+        pipeline.scheduler.set_timesteps(steps)
+        self._stages = None
         # per-invocation shallow-step count under the step-cache cadence
         # (0 with the cache off) — the server's shallow-share metrics read
         # this off every executor it dispatches to
@@ -57,19 +85,34 @@ class PipelineExecutor:
 
     def _draw_latents(self, seeds: Sequence[int]):
         """Per-request seeded initial noise (scaled like _batched_generate's
-        internal draw), stacked into one batch."""
+        internal draw), one vmapped draw over the stacked PRNG keys —
+        bit-identical to per-seed draws (threefry counts depend on the
+        per-image element count, not the leading axis) at one dispatch
+        instead of one per request."""
         import jax
         import jax.numpy as jnp
 
         cfg = self.pipeline.distri_config
-        self.pipeline.scheduler.set_timesteps(self.steps)
-        shape = (1, cfg.latent_height, cfg.latent_width, self._in_channels())
-        lats = [
-            jax.random.normal(jax.random.PRNGKey(int(s)), shape, jnp.float32)
-            for s in seeds
-        ]
-        return jnp.concatenate(lats, axis=0) * \
-            self.pipeline.scheduler.init_noise_sigma
+        shape = (cfg.latent_height, cfg.latent_width, self._in_channels())
+        keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+        lats = jax.vmap(
+            lambda k: jax.random.normal(k, shape, jnp.float32)
+        )(keys)
+        return lats * self.pipeline.scheduler.init_noise_sigma
+
+    def _pad_batch(self, prompts, negative_prompts, seeds):
+        """Pad to the compiled batch width by repeating the tail (same
+        convention as pipelines._pad_rows); callers drop padded outputs.
+        ONE padding rule shared by ``__call__`` and ``encode_stage`` keeps
+        the monolithic and staged dispatch modes in lockstep."""
+        n_real = len(prompts)
+        pad = (-n_real) % self.batch_size
+        if pad:
+            prompts = list(prompts) + [prompts[-1]] * pad
+            negative_prompts = (list(negative_prompts)
+                                + [negative_prompts[-1]] * pad)
+            seeds = list(seeds) + [seeds[-1]] * pad
+        return list(prompts), list(negative_prompts), list(seeds), n_real
 
     def __call__(
         self,
@@ -81,15 +124,9 @@ class PipelineExecutor:
         if self.fault_plan is not None:
             self.fault_plan.check("executor.execute", key=self.key,
                                   batch_size=len(prompts))
-        n_real = len(prompts)
+        prompts, negative_prompts, seeds, n_real = self._pad_batch(
+            prompts, negative_prompts, seeds)
         bs = self.batch_size
-        pad = (-n_real) % bs
-        if pad:
-            # pad to the compiled batch width by repeating the tail (same
-            # convention as pipelines._pad_rows); padded outputs dropped
-            prompts = prompts + [prompts[-1]] * pad
-            negative_prompts = negative_prompts + [negative_prompts[-1]] * pad
-            seeds = list(seeds) + [seeds[-1]] * pad
         # A batch wider than the compiled width (batcher max_batch_size >
         # pipeline batch_size) runs as several exactly-bs invocations of the
         # same cached program — never a retrace, never a contract error.
@@ -106,6 +143,68 @@ class PipelineExecutor:
             )
             images.extend(out.images)
         return images[:n_real]
+
+    # -- staged contract (serve/staging.py) --------------------------------
+
+    def prepare_stages(self):
+        """Lazily build (and cache) the pipeline's stage programs — one
+        `PipelineStages` per executor, at the executor's step count."""
+        if self._stages is None:
+            self._stages = self.pipeline.prepare_stages(self.steps)
+        return self._stages
+
+    def encode_stage(self, prompts: List[str], negative_prompts: List[str],
+                     seeds: List[int]) -> Dict[str, Any]:
+        """Stage 1: pad, tokenize + text-encode every compiled-width chunk
+        and draw the per-request seeded latents — encoder/host work that
+        rides in the shadow of another batch's denoise."""
+        import jax
+
+        stages = self.prepare_stages()
+        prompts, negative_prompts, seeds, n_real = self._pad_batch(
+            prompts, negative_prompts, seeds)
+        bs = self.batch_size
+        latents = self._draw_latents(seeds)
+        encoded = [
+            stages.encode(prompts[i:i + bs], negative_prompts[i:i + bs])
+            for i in range(0, len(prompts), bs)
+        ]
+        # block so the stage's service time (and the denoise worker's
+        # queue) reflects real encode compute, not async dispatch
+        jax.block_until_ready((encoded, latents))
+        return {"n_real": n_real, "encoded": encoded, "latents": latents,
+                "latent": None}
+
+    def denoise_stage(self, work: Dict[str, Any],
+                      guidance_scale: float) -> Dict[str, Any]:
+        """Stage 2: the compiled denoise program — the mesh bottleneck the
+        other stages hide behind.  Consumed inputs (initial latents,
+        embeddings) are released immediately ("donated"): the next
+        inflight batch reuses their HBM."""
+        import jax
+        import jax.numpy as jnp
+
+        stages = self.prepare_stages()
+        bs = self.batch_size
+        lats = work["latents"]
+        outs = [
+            stages.denoise(enc, lats[i * bs:(i + 1) * bs], guidance_scale)
+            for i, enc in enumerate(work["encoded"])
+        ]
+        latent = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+        latent = jax.block_until_ready(latent)
+        _release_buffers((work.pop("latents"), work.pop("encoded")))
+        work["latent"] = latent
+        return work
+
+    def decode_stage(self, work: Dict[str, Any]) -> List[Any]:
+        """Stage 3: chunked VAE decode + device->host conversion, padded
+        rows stripped — per-request np images, same convention as
+        ``__call__``."""
+        stages = self.prepare_stages()
+        images = stages.decode(work["latent"])
+        _release_buffers(work.pop("latent"))
+        return list(images)[:work["n_real"]]
 
 
 def apply_key_policy(pipeline, key: ExecKey) -> None:

@@ -50,6 +50,7 @@ from .errors import (
 
 # Degradation rung names (ordered; also the metric/health vocabulary).
 RUNG_SPLIT = "split_batch"
+RUNG_STAGING_OFF = "staging_off"
 RUNG_STEP_CACHE_OFF = "step_cache_off"
 RUNG_STEPWISE = "stepwise_fallback"
 RUNG_BUCKET = "bucket_fallback"
@@ -266,6 +267,14 @@ class Watchdog:
         self.timeouts = 0  # observability; incremented on every firing
         self._abandoned: Optional[threading.Event] = None
 
+    @property
+    def abandoned_event(self) -> Optional[threading.Event]:
+        """The done-event of the currently abandoned worker (None when no
+        abandonment is outstanding).  Callers holding resources the
+        abandoned work still uses (the staged pipeline's executor pins)
+        wait on it before releasing them."""
+        return self._abandoned
+
     def run(self, fn: Callable[[], Any]) -> Any:
         if self.timeout_s <= 0:
             return fn()
@@ -336,24 +345,35 @@ class DegradationLadder:
        the compiled width), and falls through — after at most
        log2(batch) split attempts, once per key thanks to the sticky
        cap — to the program-level rungs below;
-    2. `step_cache_off`: recompile without the temporal step-cache
+    2. `staging_off` (staged servers only, serve/staging.py): stop
+       pipelining this key's batches — with up to ``max_inflight_batches``
+       batches resident, overlap is the cheapest HBM to give back, and it
+       changes neither the program nor the numerics (the key itself is
+       unchanged; the server routes the key monolithically);
+    3. `step_cache_off`: recompile without the temporal step-cache
        cadence (its deep-feature carry is HBM the fused program can live
        without);
-    3. `stepwise_fallback`: swap the fused scan for the host-driven
+    4. `stepwise_fallback`: swap the fused scan for the host-driven
        stepwise loop — the compat-shim fallback reused as a policy: same
        numerics, a much smaller program to compile and hold;
-    4. `bucket_fallback` (off by default — it changes the output
+    5. `bucket_fallback` (off by default — it changes the output
        resolution contract): serve the request at the next smaller
        configured bucket.
 
     ``apply(key, rungs)`` maps an `ExecKey` through the applied rungs to
-    the key that should actually execute."""
+    the key that should actually execute (``staging_off`` is a dispatch-
+    mode rung: it leaves the key unchanged)."""
 
-    KEY_RUNGS = (RUNG_STEP_CACHE_OFF, RUNG_STEPWISE, RUNG_BUCKET)
+    KEY_RUNGS = (RUNG_STAGING_OFF, RUNG_STEP_CACHE_OFF, RUNG_STEPWISE,
+                 RUNG_BUCKET)
 
     def __init__(self, config: ResilienceConfig,
-                 buckets: Sequence[Tuple[int, int]] = ()):
+                 buckets: Sequence[Tuple[int, int]] = (),
+                 staging: bool = False):
         self.config = config
+        # does the owning server pipeline its dispatches?  gates the
+        # staging_off rung (a monolithic server has no staging to turn off)
+        self.staging = staging
         # area-major, like serve.batcher.BucketTable
         self.buckets = tuple(sorted(
             {(int(h), int(w)) for h, w in buckets},
@@ -367,6 +387,8 @@ class DegradationLadder:
 
     def _applicable(self, rung: str, key: ExecKey) -> bool:
         cfg = self.config
+        if rung == RUNG_STAGING_OFF:
+            return self.staging and cfg.allow_staging_off
         if rung == RUNG_STEP_CACHE_OFF:
             return cfg.allow_step_cache_off and key.step_cache_interval > 1
         if rung == RUNG_STEPWISE:
@@ -392,6 +414,7 @@ class DegradationLadder:
 
     def apply(self, key: ExecKey, rungs: Sequence[str]) -> ExecKey:
         for rung in rungs:
+            # RUNG_STAGING_OFF changes the dispatch mode, not the key
             if rung == RUNG_STEP_CACHE_OFF:
                 key = dataclasses.replace(
                     key, step_cache_interval=1, step_cache_depth=0)
@@ -419,6 +442,7 @@ class ResilienceEngine:
         buckets: Sequence[Tuple[int, int]] = (),
         clock: Callable[[], float] = time.monotonic,
         sleep: Optional[Callable[[float], Any]] = None,
+        staging: bool = False,
     ):
         self.config = config or ResilienceConfig()
         self.clock = clock
@@ -435,7 +459,8 @@ class ResilienceEngine:
                                   self.config.retry_budget_refill_per_s,
                                   clock=self.clock)
         self.watchdog = Watchdog(self.config.watchdog_timeout_s)
-        self.ladder = DegradationLadder(self.config, buckets)
+        self.ladder = DegradationLadder(self.config, buckets,
+                                        staging=staging)
         self.last_errors = RingLog(capacity=self.config.last_errors_capacity)
         # _keys_lock guards MAP membership only (insert/evict in
         # key_state, iteration copy in snapshot) — snapshot() is

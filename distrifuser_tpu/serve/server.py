@@ -69,7 +69,12 @@ from .errors import (
 )
 from .faults import FaultPlan
 from .queue import Request, RequestQueue, ServeResult
-from .resilience import RUNG_SPLIT, ResilienceEngine, failure_kind
+from .resilience import (
+    RUNG_SPLIT,
+    RUNG_STAGING_OFF,
+    ResilienceEngine,
+    failure_kind,
+)
 
 
 class InferenceServer:
@@ -137,7 +142,26 @@ class InferenceServer:
             # backoff sleeps become stop-interruptible waits: stop() never
             # waits out a backoff schedule
             sleep=self._stop.wait,
+            staging=self.config.pipeline_stages,
         )
+        # Staged pipelining (serve/staging.py): three stage workers overlap
+        # text-encode, denoise, and VAE-decode across micro-batches.  The
+        # scheduler thread submits and drains outcome events; futures
+        # resolve from the decode worker.
+        self.staging = None
+        if self.config.pipeline_stages:
+            from .staging import StagePipeline
+
+            self.staging = StagePipeline(
+                max_inflight=self.config.max_inflight_batches,
+                watchdog_timeout_s=self.config.resilience.watchdog_timeout_s,
+                clock=clock,
+                counters=self.counters,
+                on_success=self._staged_success,
+                on_failure=self._staged_failure,
+                on_release=self._staged_release,
+                fault_plan=fault_plan,
+            )
         self._thread: Optional[threading.Thread] = None
         self._started = False
 
@@ -178,6 +202,12 @@ class InferenceServer:
         for req in self.queue.close():
             self.counters.inc("rejected_server_closed")
             self._resolve(req.future, exc=ServerClosedError("server stopped"))
+        if self.staging is not None:
+            # drain the stage queues deterministically: every staged batch
+            # not yet through decode fails with ServerClosedError (the
+            # stage invocation in progress finishes, bounded by its
+            # watchdog), so no staged future is left unresolved either
+            self.staging.stop(timeout)
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
@@ -327,6 +357,9 @@ class InferenceServer:
 
         while not self._stop.is_set():
             try:
+                # staged outcomes ride an event queue back here: the
+                # breaker/ladder mutating methods are scheduler-thread-only
+                self._drain_staged_outcomes()
                 got = self.batcher.next_batch(timeout=0.05)
             except Exception:  # noqa: BLE001
                 self.counters.inc("scheduler_errors")
@@ -352,12 +385,135 @@ class InferenceServer:
 
     def _execute(self, key: BatchKey, batch: List[Request]) -> None:
         dispatch_ts = self.clock()
+        # staged outcomes that landed while this batch was forming must
+        # reach the breaker/ladder BEFORE the allow()/routing decisions
+        self._drain_staged_outcomes()
         base_key = self._exec_key_for(key.height, key.width, key.steps,
                                       key.cfg)
         if not self.resilience.allow(base_key):
             self._shed(base_key, batch)
             return
+        if self._execute_staged(key, base_key, batch, dispatch_ts):
+            return
         self._execute_resilient(key, base_key, batch, dispatch_ts)
+
+    # -- the staged execute path -------------------------------------------
+
+    def _drain_staged_outcomes(self) -> None:
+        """Apply finished staged batches' breaker/ladder bookkeeping on
+        the scheduler thread.  A staged failure is ONE terminal dispatch
+        failure (there is no intra-stage retry loop); OOM/compile kinds
+        advance the sticky degradation ladder — ``staging_off`` first, so
+        a key that cannot afford the overlap's residency falls back to
+        the monolithic path (which still has the full retry machinery)."""
+        if self.staging is None:
+            return
+        for base_key, ekey, exc in self.staging.drain_outcomes():
+            if exc is None:
+                self.resilience.on_success(base_key)
+                continue
+            self.resilience.on_failure(base_key, exc)
+            kind = failure_kind(exc)
+            if kind in ("oom", "compile"):
+                # batch_size=1 deliberately skips RUNG_SPLIT: staged
+                # dispatches never split (splitting is the retry loop's
+                # move), so the ladder advances straight to the key rungs
+                rung = self.resilience.degrade(base_key, kind, 1)
+                if rung is not None:
+                    self.counters.inc("degraded_" + rung)
+                    if rung != RUNG_STAGING_OFF:
+                        # the poisoned program must not satisfy the next
+                        # dispatch (same contract as the monolithic path)
+                        self.cache.invalidate(ekey)
+
+    def _staging_routed(self, base_key: ExecKey) -> bool:
+        return (self.staging is not None
+                and RUNG_STAGING_OFF
+                not in self.resilience.key_state(base_key).rungs)
+
+    def _execute_staged(self, key: BatchKey, base_key: ExecKey,
+                        batch: List[Request], dispatch_ts: float) -> bool:
+        """Submit the batch to the stage pipeline; True when the batch was
+        consumed (submitted or failed terminally), False to fall through
+        to the monolithic path (staging off/degraded for this key, or an
+        executor without stage programs)."""
+        if not self._staging_routed(base_key):
+            return False
+        from .staging import StagedBatch
+
+        ekey = self.resilience.degraded_key(base_key)
+        try:
+            # pinned for the batch's whole trip: LRU eviction or
+            # invalidate() must never free a program a stage worker is
+            # about to run (ExecutorCache defers the release to unpin)
+            executor, hit = self.cache.get(ekey, pin=True)
+        except Exception as exc:  # noqa: BLE001 — typed below
+            bexc = exc if isinstance(exc, ServeError) else BuildFailedError(
+                f"executor build failed for {ekey.short()}: "
+                f"{type(exc).__name__}: {exc}"
+            )
+            # one terminal dispatch failure, like a stage failure — the
+            # ladder may force staging off so the NEXT dispatch retries
+            # through the monolithic machinery
+            self.resilience.on_failure(base_key, bexc)
+            kind = failure_kind(bexc)
+            if kind in ("oom", "compile"):
+                rung = self.resilience.degrade(base_key, kind, 1)
+                if rung is not None:
+                    self.counters.inc("degraded_" + rung)
+            self.counters.inc("failed_build", len(batch))
+            self._fail_batch(batch, bexc)
+            return True
+        if not hasattr(executor, "encode_stage"):
+            # executor has no stage programs (plain fakes, custom
+            # adapters): unpin and run monolithically
+            self.cache.unpin(executor)
+            return False
+        sb = StagedBatch(
+            batch_key=key, base_key=base_key, ekey=ekey, requests=batch,
+            executor=executor, compile_hit=hit, dispatch_ts=dispatch_ts,
+        )
+        if not self.staging.submit(sb):
+            # pipeline is stopping: deterministic close, like the queued
+            # futures stop() drains
+            self.cache.unpin(executor)
+            self.counters.inc("rejected_server_closed", len(batch))
+            self._fail_batch(batch, ServerClosedError("server stopped"))
+        return True
+
+    def _staged_success(self, sb, outputs, t0: float, t1: float) -> None:
+        """Decode-worker callback: resolve and record one completed staged
+        batch (counters/histograms are thread-safe; breaker bookkeeping
+        rides drain_outcomes instead)."""
+        shallow = int(getattr(sb.executor, "shallow_steps", 0))
+        degradations = tuple(self.resilience.key_state(sb.base_key).rungs)
+        self._complete_batch(
+            sb.batch_key, sb.ekey, sb.requests, outputs, sb.dispatch_ts,
+            t0, t1, sb.compile_hit, retries=0, degradations=degradations,
+            shallow_steps=shallow,
+        )
+
+    def _staged_failure(self, sb, exc: Exception) -> None:
+        """Stage-worker callback: fail one staged batch's futures, counted
+        by failure type (mirrors the monolithic counters)."""
+        n = len(sb.requests)
+        if isinstance(exc, ServerClosedError):
+            self.counters.inc("rejected_server_closed", n)
+        elif isinstance(exc, DeadlineExceededError):
+            self.counters.inc("rejected_deadline", n)
+        elif isinstance(exc, WatchdogTimeoutError):
+            self.counters.inc("watchdog_timeouts")
+            self.counters.inc("failed_execute", n)
+        elif isinstance(exc, BuildFailedError):
+            self.counters.inc("failed_build", n)
+        elif isinstance(exc, FatalError):
+            self.counters.inc("failed_fatal", n)
+        else:
+            self.counters.inc("failed_execute", n)
+        self._fail_batch(sb.requests, exc)
+
+    def _staged_release(self, sb) -> None:
+        self.cache.unpin(sb.executor)
 
     def _shed(self, ekey: ExecKey, batch: List[Request]) -> None:
         """Circuit open: fail fast with the 503-style typed error — the
@@ -507,45 +663,58 @@ class InferenceServer:
                 raise
             # ---- success ------------------------------------------------
             res.on_success(base_key)
-            self.counters.inc("batches")
-            self.counters.inc("requests_compile_hit" if hit
-                              else "requests_compile_miss", len(batch))
-            self._batch_sizes.inc(f"size_{len(batch)}")
-            exec_s = t1 - t0
-            # shallow-step share: how much of the mesh time the step cache
-            # saved from full network evaluations (0 when the cache is off)
-            self.counters.inc("denoise_steps_total", key.steps * len(batch))
-            shallow = int(getattr(executor, "shallow_steps", 0))
-            if shallow:
-                self.counters.inc("denoise_steps_shallow",
-                                  shallow * len(batch))
-            degradations = tuple(res.key_state(base_key).rungs)
-            for req, out in zip(batch, outputs):
-                queue_wait = dispatch_ts - req.enqueue_ts
-                e2e = t1 - req.enqueue_ts
-                self.hist_queue_wait.observe(queue_wait)
-                self.hist_execute.observe(exec_s)
-                self.hist_e2e.observe(e2e)
-                self.counters.inc("completed")
-                if req.expired(t1):
-                    # deadline lapsed while IN FLIGHT: deadlines gate
-                    # scheduling, never abandon mesh work — the caller
-                    # still gets the result, and the lateness is counted
-                    self.counters.inc("completed_late")
-                self._resolve(req.future, result=ServeResult(
-                    request_id=req.request_id,
-                    output=out,
-                    bucket=(ekey.height, ekey.width),
-                    requested_size=(req.height, req.width),
-                    queue_wait_s=queue_wait,
-                    execute_s=exec_s,
-                    e2e_s=e2e,
-                    batch_size=len(batch),
-                    compile_hit=hit,
-                    retries=attempts,
-                    degradations=degradations,
-                ))
+            self._complete_batch(
+                key, ekey, batch, outputs, dispatch_ts, t0, t1, hit,
+                retries=attempts,
+                degradations=tuple(res.key_state(base_key).rungs),
+                shallow_steps=int(getattr(executor, "shallow_steps", 0)),
+            )
             return
+
+    def _complete_batch(self, key: BatchKey, ekey: ExecKey,
+                        batch: List[Request], outputs, dispatch_ts: float,
+                        t0: float, t1: float, hit: bool, *, retries: int,
+                        degradations: tuple, shallow_steps: int) -> None:
+        """Per-request success bookkeeping shared by the monolithic and
+        staged dispatch paths: counters, latency histograms, and future
+        resolution.  Thread-safe (staged batches complete on the decode
+        worker while the scheduler thread completes monolithic ones)."""
+        self.counters.inc("batches")
+        self.counters.inc("requests_compile_hit" if hit
+                          else "requests_compile_miss", len(batch))
+        self._batch_sizes.inc(f"size_{len(batch)}")
+        exec_s = t1 - t0
+        # shallow-step share: how much of the mesh time the step cache
+        # saved from full network evaluations (0 when the cache is off)
+        self.counters.inc("denoise_steps_total", key.steps * len(batch))
+        if shallow_steps:
+            self.counters.inc("denoise_steps_shallow",
+                              shallow_steps * len(batch))
+        for req, out in zip(batch, outputs):
+            queue_wait = dispatch_ts - req.enqueue_ts
+            e2e = t1 - req.enqueue_ts
+            self.hist_queue_wait.observe(queue_wait)
+            self.hist_execute.observe(exec_s)
+            self.hist_e2e.observe(e2e)
+            self.counters.inc("completed")
+            if req.expired(t1):
+                # deadline lapsed while IN FLIGHT: deadlines gate
+                # scheduling, never abandon mesh work — the caller
+                # still gets the result, and the lateness is counted
+                self.counters.inc("completed_late")
+            self._resolve(req.future, result=ServeResult(
+                request_id=req.request_id,
+                output=out,
+                bucket=(ekey.height, ekey.width),
+                requested_size=(req.height, req.width),
+                queue_wait_s=queue_wait,
+                execute_s=exec_s,
+                e2e_s=e2e,
+                batch_size=len(batch),
+                compile_hit=hit,
+                retries=retries,
+                degradations=degradations,
+            ))
 
     # -- observability -----------------------------------------------------
 
@@ -590,6 +759,8 @@ class InferenceServer:
                 "batch_window_s": self.config.batch_window_s,
                 "cache_capacity": self.config.cache_capacity,
                 "buckets": [list(b) for b in self.batcher.table.buckets],
+                "pipeline_stages": self.config.pipeline_stages,
+                "max_inflight_batches": self.config.max_inflight_batches,
             },
             "requests": reqs,
             "step_cache": {
@@ -611,6 +782,10 @@ class InferenceServer:
             },
             "cache": self.cache.stats(),
             "resilience": self.resilience.snapshot(),
+            # per-stage queue-wait/service histograms + denoise-gap
+            # fraction (None on monolithic servers)
+            "staging": (self.staging.snapshot()
+                        if self.staging is not None else None),
         }
 
     def export_metrics(self, path: str) -> Dict[str, Any]:

@@ -90,3 +90,143 @@ class FakeExecutorFactory:
     def batch_sizes(self) -> List[int]:
         """Every invocation's real batch size, across all executors."""
         return [n for ex in self.executors for n in ex.batch_sizes]
+
+
+class StageTracker:
+    """Concurrent-residency probe shared by staged fakes: counts batches
+    between encode-stage entry and decode-stage exit (the window in which
+    a real batch holds device buffers) and records the peak — what tests
+    assert the ``max_inflight_batches`` HBM cap against, independently of
+    the pipeline's own semaphore accounting."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.current = 0
+        self.peak = 0
+
+    def enter(self) -> None:
+        with self._lock:
+            self.current += 1
+            self.peak = max(self.peak, self.current)
+
+    def exit(self) -> None:
+        with self._lock:
+            self.current -= 1
+
+
+class StagedFakeExecutor(FakeExecutor):
+    """Serve-executor fake implementing the three-stage contract
+    (serve/staging.py) alongside the monolithic ``__call__``.
+
+    Per-stage simulated times make overlap measurable without XLA:
+    sleeping stages do not compete for CPU, so a pipelined run's
+    steady-state throughput approaches 1/max(stage) while the monolithic
+    run pays 1/sum(stage) — the scheduler behavior under test, isolated
+    from compute.  ``denoise_s`` defaults to ``step_time_s * steps`` so
+    the monolithic path (which sleeps exactly that in ``__call__``) costs
+    the same mesh time as the staged path.  Outputs are `fake_image`
+    either way: staged and monolithic dispatch are bit-identical.
+
+    ``fail_stage``/``fail_times`` inject ``fail_exc`` (default RuntimeError)
+    into the first N invocations of one stage; ``stage_calls`` counts every
+    stage entry for assertions.
+    """
+
+    def __init__(self, key: ExecKey, batch_size: int = 8,
+                 step_time_s: float = 0.0, encode_s: float = 0.0,
+                 denoise_s: float = None, decode_s: float = 0.0,
+                 tracker: StageTracker = None, fail_stage: str = None,
+                 fail_times: int = 0, fail_exc: Exception = None):
+        super().__init__(key, batch_size=batch_size, step_time_s=step_time_s)
+        self.encode_s = encode_s
+        self.denoise_s = (step_time_s * key.steps if denoise_s is None
+                          else denoise_s)
+        self.decode_s = decode_s
+        self.tracker = tracker
+        self.fail_stage = fail_stage
+        self.fail_times = fail_times
+        self.fail_exc = fail_exc
+        self.stage_calls = {"encode": 0, "denoise": 0, "decode": 0}
+
+    def _stage(self, name: str, sleep_s: float) -> None:
+        self.stage_calls[name] += 1
+        if self.fail_stage == name and self.fail_times > 0:
+            self.fail_times -= 1
+            if self.tracker is not None:
+                # a failed batch leaves the pipeline here: balance the
+                # encode-entry so residency probes stay correct under
+                # fault injection.  (Batches DROPPED between stages —
+                # cancel/stop — never re-enter the executor, so the
+                # tracker is only meaningful for runs without drops.)
+                self.tracker.exit()
+            raise (self.fail_exc if self.fail_exc is not None
+                   else RuntimeError(f"injected {name} stage failure"))
+        if sleep_s:
+            time.sleep(sleep_s)
+
+    def __call__(self, prompts: List[str], negative_prompts: List[str],
+                 guidance_scale: float, seeds: List[int]) -> List[Any]:
+        # the monolithic dispatch runs every stage serially: its simulated
+        # cost is the SUM of the stage times, so staged-vs-monolithic
+        # benchmark ratios measure real overlap, not a handicapped baseline
+        assert len(prompts) == len(negative_prompts) == len(seeds)
+        self.batch_sizes.append(len(prompts))
+        total = self.encode_s + self.denoise_s + self.decode_s
+        if total:
+            time.sleep(total)
+        return [fake_image(p, s, self.key) for p, s in zip(prompts, seeds)]
+
+    def encode_stage(self, prompts: List[str], negative_prompts: List[str],
+                     seeds: List[int]):
+        if self.tracker is not None:
+            self.tracker.enter()
+        self._stage("encode", self.encode_s)
+        return {"prompts": list(prompts), "seeds": list(seeds)}
+
+    def denoise_stage(self, work, guidance_scale: float):
+        self._stage("denoise", self.denoise_s)
+        return work
+
+    def decode_stage(self, work) -> List[Any]:
+        self._stage("decode", self.decode_s)
+        out = [fake_image(p, s, self.key)
+               for p, s in zip(work["prompts"], work["seeds"])]
+        if self.tracker is not None:
+            self.tracker.exit()
+        return out
+
+
+class StagedFakeExecutorFactory(FakeExecutorFactory):
+    """FakeExecutorFactory building staged fakes; one shared `StageTracker`
+    across every executor measures whole-service residency."""
+
+    def __init__(self, batch_size: int = 8, build_delay_s: float = 0.0,
+                 step_time_s: float = 0.0, encode_s: float = 0.0,
+                 denoise_s: float = None, decode_s: float = 0.0,
+                 fail_stage: str = None, fail_times: int = 0,
+                 fail_exc: Exception = None):
+        super().__init__(batch_size=batch_size, build_delay_s=build_delay_s,
+                         step_time_s=step_time_s)
+        self.encode_s = encode_s
+        self.denoise_s = denoise_s
+        self.decode_s = decode_s
+        self.fail_stage = fail_stage
+        self.fail_times = fail_times
+        self.fail_exc = fail_exc
+        self.tracker = StageTracker()
+
+    def __call__(self, key: ExecKey) -> StagedFakeExecutor:
+        if self.build_delay_s:
+            time.sleep(self.build_delay_s)
+        self.built.append(key)
+        ex = StagedFakeExecutor(
+            key, batch_size=self.batch_size, step_time_s=self.step_time_s,
+            encode_s=self.encode_s, denoise_s=self.denoise_s,
+            decode_s=self.decode_s, tracker=self.tracker,
+            fail_stage=self.fail_stage, fail_times=self.fail_times,
+            fail_exc=self.fail_exc,
+        )
+        self.executors.append(ex)
+        return ex

@@ -464,6 +464,11 @@ class ResilienceConfig:
     # distinct key ever seen.  Eviction prefers closed/undegraded state.
     max_tracked_keys: int = 256
     allow_batch_split: bool = True
+    # staged servers only (ServeConfig.pipeline_stages): let the ladder
+    # stop pipelining an OOM-ing key's batches — overlap holds up to
+    # max_inflight_batches of residency, the cheapest HBM to give back,
+    # and the rung changes neither the program nor the numerics
+    allow_staging_off: bool = True
     allow_step_cache_off: bool = True
     allow_stepwise_fallback: bool = True
     allow_bucket_fallback: bool = False
@@ -573,6 +578,17 @@ class ServeConfig:
     # pipeline builder behind executor_factory must construct its
     # DistriConfig with the same mode.
     comm_compress: str = "none"
+    # Staged pipelining (serve/staging.py, docs/SERVING.md "Staged
+    # pipelining"): overlap text-encode, denoise, and VAE-decode across
+    # micro-batches so batch k+1 encodes and batch k-1 decodes in the
+    # shadow of batch k's denoise.  Off by default: staged and monolithic
+    # execution are bit-identical per request, but staging holds up to
+    # ``max_inflight_batches`` batches of device buffers resident (the
+    # HBM cap) and trades the in-line retry loop for throughput (a stage
+    # failure is one terminal dispatch failure; sticky degradations —
+    # including the staging_off rung — handle repeat offenders).
+    pipeline_stages: bool = False
+    max_inflight_batches: int = 2
     # Failure handling: retries/backoff, per-key circuit breakers, the
     # execution watchdog, and the graceful-degradation ladder — see
     # ResilienceConfig above and docs/SERVING.md "Failure modes & tuning".
@@ -600,6 +616,11 @@ class ServeConfig:
         if self.cache_capacity < 1:
             raise ValueError(
                 f"cache_capacity must be >= 1, got {self.cache_capacity}"
+            )
+        if self.max_inflight_batches < 1:
+            raise ValueError(
+                "max_inflight_batches must be >= 1, got "
+                f"{self.max_inflight_batches}"
             )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)

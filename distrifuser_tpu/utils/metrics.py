@@ -275,6 +275,7 @@ class LatencyHistogram:
         self.lo = lo
         self.hi = hi
         import math
+        import threading
 
         self._n_buckets = (
             int(math.ceil(math.log(hi / lo) / math.log(self._FACTOR))) + 1
@@ -284,6 +285,10 @@ class LatencyHistogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        # observe() is a read-modify-write on numpy storage; the staged
+        # serving pipeline observes from stage workers concurrently with
+        # the scheduler thread (serve/staging.py), same reason as Counter
+        self._lock = threading.Lock()
 
     def _bucket(self, v: float) -> int:
         import math
@@ -295,11 +300,12 @@ class LatencyHistogram:
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self._counts[self._bucket(v)] += 1
-        self.count += 1
-        self.sum += v
-        self.min = min(self.min, v)
-        self.max = max(self.max, v)
+        with self._lock:
+            self._counts[self._bucket(v)] += 1
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
 
     def quantile(self, q: float) -> float:
         """Approximate q-quantile (0 <= q <= 1) by bucket interpolation,
@@ -360,6 +366,62 @@ class Counter:
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(sorted(self._c.items()))
+
+
+class GapTracker:
+    """Busy/idle accounting for one serially-used resource.
+
+    Backs the staged serving pipeline's **denoise-gap fraction**
+    (serve/staging.py): the denoise stage owns the mesh, so the fraction
+    of wall-time between its first and last invocation that the mesh sat
+    idle is exactly the latency the stage overlap failed to hide — the
+    measurable form of the ISSUE's "throughput ceiling moves from
+    1/sum(stage) to 1/max(stage)".  `begin(t)`/`end(t)` bracket each busy
+    interval (single consumer — the stage worker); `snapshot()` is
+    any-thread."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._t0 = None  # current interval start
+        self.first_start = None
+        self.last_end = None
+        self.busy_s = 0.0
+        self.intervals = 0
+
+    def begin(self, t: float) -> None:
+        with self._lock:
+            assert self._t0 is None, "unbalanced GapTracker.begin"
+            self._t0 = float(t)
+            if self.first_start is None:
+                self.first_start = float(t)
+
+    def end(self, t: float) -> None:
+        with self._lock:
+            assert self._t0 is not None, "GapTracker.end without begin"
+            self.busy_s += float(t) - self._t0
+            self.last_end = float(t)
+            self._t0 = None
+            self.intervals += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-friendly summary.  ``gap_fraction`` is idle/span over the
+        busy envelope [first_start, last_end]; 0.0 before two intervals
+        exist (a single invocation has no between-batch gap to report)."""
+        with self._lock:
+            if self.first_start is None or self.last_end is None:
+                return {"intervals": 0, "busy_s": 0.0, "span_s": 0.0,
+                        "gap_s": 0.0, "gap_fraction": 0.0}
+            span = self.last_end - self.first_start
+            gap = max(0.0, span - self.busy_s)
+            return {
+                "intervals": self.intervals,
+                "busy_s": self.busy_s,
+                "span_s": span,
+                "gap_s": gap,
+                "gap_fraction": (gap / span) if span > 0 else 0.0,
+            }
 
 
 class RingLog:

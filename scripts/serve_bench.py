@@ -23,6 +23,12 @@ Backends:
     latency is weight-value-independent).
 Real snapshots plug in the same way via pipeline_executor_factory; this
 box has no egress, so that path is exercised on real hardware only.
+
+``--stages`` runs the SAME load twice — monolithic, then with
+``ServeConfig.pipeline_stages`` (serve/staging.py) — and reports the
+staged/monolithic throughput ratio, per-stage queue-wait/service
+histograms, and the denoise-gap (mesh-idle) fraction; ``--gate_ratio``
+turns the ratio into an exit-code gate (tier1.yml runs it at 1.15x).
 """
 
 from __future__ import annotations
@@ -71,8 +77,23 @@ def _pick_resolution(rng: random.Random):
 
 
 def _make_dry_factory(args):
-    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+    from distrifuser_tpu.serve.testing import (
+        FakeExecutorFactory,
+        StagedFakeExecutorFactory,
+    )
 
+    if args.stages:
+        # staged fakes sleep per stage (encode/denoise/decode); their
+        # monolithic __call__ sleeps the SUM, so the staged-vs-monolithic
+        # ratio below measures scheduler overlap against an honest serial
+        # baseline
+        return StagedFakeExecutorFactory(
+            batch_size=args.max_batch_size,
+            build_delay_s=args.fake_build_s,
+            step_time_s=args.fake_step_s,
+            encode_s=args.fake_encode_s,
+            decode_s=args.fake_decode_s,
+        ), "fake"
     return FakeExecutorFactory(
         batch_size=args.max_batch_size,
         build_delay_s=args.fake_build_s,
@@ -120,8 +141,15 @@ def run_load(server: InferenceServer, args) -> dict:
     lock = threading.Lock()
 
     def submit_one(i: int):
-        with lock:
-            h, w = _pick_resolution(rng)
+        if getattr(args, "stages", False):
+            # staged compare runs pin ONE hot bucket (the first configured)
+            # so the ratio measures stage overlap at steady state, not
+            # cache churn
+            h, w = (int(x) for x in
+                    args.buckets.split(",")[0].split("x"))
+        else:
+            with lock:
+                h, w = _pick_resolution(rng)
         try:
             f = server.submit(
                 PROMPTS[i % len(PROMPTS)],
@@ -229,6 +257,23 @@ def main(argv=None) -> int:
                     help="dry-run: simulated compile per cache miss")
     ap.add_argument("--fake_step_s", type=float, default=0.002,
                     help="dry-run: simulated per-step latency")
+    ap.add_argument("--stages", action="store_true",
+                    help="staged pipelining compare: run the same load "
+                         "monolithic then staged (ServeConfig."
+                         "pipeline_stages) and report the throughput "
+                         "ratio, per-stage histograms, and the "
+                         "denoise-gap fraction")
+    ap.add_argument("--max_inflight", type=int, default=2,
+                    help="staged: max_inflight_batches (HBM cap)")
+    ap.add_argument("--fake_encode_s", type=float, default=0.0,
+                    help="dry-run staged: simulated text-encode stage time")
+    ap.add_argument("--fake_decode_s", type=float, default=0.0,
+                    help="dry-run staged: simulated VAE-decode stage time")
+    ap.add_argument("--gate_ratio", type=float, default=0.0,
+                    help="staged: fail (exit 1) unless staged/monolithic "
+                         "throughput >= this ratio OR the denoise-gap "
+                         "fraction shrank >= 2x vs the serial stage "
+                         "shares (0 disables the gate)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default=None,
                     help="write the full JSON artifact here")
@@ -239,44 +284,109 @@ def main(argv=None) -> int:
             tuple(int(x) for x in b.split("x")) for b in spec.split(",") if b
         )
 
-    config = ServeConfig(
-        max_queue_depth=args.max_queue_depth,
-        max_batch_size=args.max_batch_size,
-        batch_window_s=args.batch_window_s,
-        buckets=parse_hw(args.buckets),
-        warmup_buckets=tuple((h, w, args.steps)
-                             for h, w in parse_hw(args.warmup)),
-        default_steps=args.steps,
-        cache_capacity=args.cache_capacity,
-        default_ttl_s=args.ttl_s,
-    )
-    if args.dry_run:
-        factory, mesh_plan = _make_dry_factory(args)
-        model_id = "dry-run"
-    else:
-        factory, mesh_plan = _make_tiny_factory(args)
-        model_id = "tiny-sd"
+    def run_one(staged: bool):
+        config = ServeConfig(
+            max_queue_depth=args.max_queue_depth,
+            max_batch_size=args.max_batch_size,
+            batch_window_s=args.batch_window_s,
+            buckets=parse_hw(args.buckets),
+            warmup_buckets=tuple((h, w, args.steps)
+                                 for h, w in parse_hw(args.warmup)),
+            default_steps=args.steps,
+            cache_capacity=args.cache_capacity,
+            default_ttl_s=args.ttl_s,
+            pipeline_stages=staged,
+            max_inflight_batches=args.max_inflight,
+        )
+        if args.dry_run:
+            factory, mesh_plan = _make_dry_factory(args)
+            model_id = "dry-run"
+        else:
+            factory, mesh_plan = _make_tiny_factory(args)
+            model_id = "tiny-sd"
+        server = InferenceServer(
+            factory, config, model_id=model_id, scheduler=args.scheduler,
+            mesh_plan=mesh_plan,
+        )
+        with server:
+            load = run_load(server, args)
+            metrics = server.metrics_snapshot()
+        return load, metrics
 
-    server = InferenceServer(
-        factory, config, model_id=model_id, scheduler=args.scheduler,
-        mesh_plan=mesh_plan,
-    )
-    with server:
-        load = run_load(server, args)
-        metrics = server.metrics_snapshot()
+    bench_block = {
+        "mode": args.mode,
+        "backend": "dry-run" if args.dry_run else "tiny-pipeline",
+        "requests": args.requests if args.mode == "closed" else None,
+        "concurrency": (args.concurrency if args.mode == "closed"
+                        else None),
+        "rate_rps": args.rate if args.mode == "open" else None,
+        "duration_s": args.duration if args.mode == "open" else None,
+        "steps": args.steps,
+        "resolution_mix": ([[512, 512, 1.0]] if args.stages
+                           else [list(r) for r in RESOLUTION_MIX]),
+    }
 
+    if args.stages:
+        # same load twice — monolithic baseline, then the staged pipeline —
+        # so the artifact records the overlap as a measured ratio, not an
+        # assertion (acceptance gate: >= --gate_ratio throughput, OR the
+        # denoise-gap fraction at least halved vs the serial stage shares)
+        mono_load, mono_metrics = run_one(staged=False)
+        staged_load, staged_metrics = run_one(staged=True)
+        ratio = (staged_load["throughput_rps"] / mono_load["throughput_rps"]
+                 if mono_load["throughput_rps"] > 0 else 0.0)
+        staging = staged_metrics["staging"]
+        gap_fraction = staging["denoise_gap"]["gap_fraction"]
+        means = {s: staging["stages"][s]["service"].get("mean", 0.0)
+                 for s in ("encode", "denoise", "decode")}
+        total_mean = sum(means.values())
+        # the mesh-idle share a SERIAL dispatch would have had: every
+        # non-denoise second idles the mesh
+        serial_gap = ((means["encode"] + means["decode"]) / total_mean
+                      if total_mean > 0 else 0.0)
+        artifact = {
+            "bench": {**bench_block, "staged_compare": True,
+                      "max_inflight_batches": args.max_inflight,
+                      "gate_ratio": args.gate_ratio},
+            "monolithic": {"load": mono_load, "metrics": mono_metrics},
+            "staged": {"load": staged_load, "metrics": staged_metrics},
+            "throughput_ratio": ratio,
+            "denoise_gap_fraction": gap_fraction,
+            "serial_gap_fraction": serial_gap,
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+                f.write("\n")
+        print(json.dumps({
+            "metric": "serve_staged_throughput_ratio",
+            "value": round(ratio, 3),
+            "unit": "x",
+            "monolithic_rps": round(mono_load["throughput_rps"], 3),
+            "staged_rps": round(staged_load["throughput_rps"], 3),
+            "denoise_gap_fraction": round(gap_fraction, 4),
+            "serial_gap_fraction": round(serial_gap, 4),
+            "availability": round(staged_load["availability"], 4),
+            "peak_inflight": staging["peak_inflight"],
+            "completed": staged_load["completed"],
+        }))
+        if args.gate_ratio > 0:
+            gap_halved = (serial_gap > 0
+                          and gap_fraction <= serial_gap / 2.0)
+            if ratio < args.gate_ratio and not gap_halved:
+                print(
+                    f"GATE FAILED: staged/monolithic throughput {ratio:.3f}x"
+                    f" < {args.gate_ratio}x and denoise-gap fraction "
+                    f"{gap_fraction:.4f} not halved vs serial "
+                    f"{serial_gap:.4f}",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
+
+    load, metrics = run_one(staged=False)
     artifact = {
-        "bench": {
-            "mode": args.mode,
-            "backend": "dry-run" if args.dry_run else "tiny-pipeline",
-            "requests": args.requests if args.mode == "closed" else None,
-            "concurrency": (args.concurrency if args.mode == "closed"
-                            else None),
-            "rate_rps": args.rate if args.mode == "open" else None,
-            "duration_s": args.duration if args.mode == "open" else None,
-            "steps": args.steps,
-            "resolution_mix": [list(r) for r in RESOLUTION_MIX],
-        },
+        "bench": bench_block,
         "load": load,
         "metrics": metrics,
     }
