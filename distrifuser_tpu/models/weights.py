@@ -553,11 +553,174 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
+# quantized-weight trees (DistriConfig.weight_quant / weight_quant_aux)
+# ---------------------------------------------------------------------------
+
+# Layer names whose kernels NEVER quantize: the model output heads.  Their
+# rounding error adds directly to the predicted noise/velocity (no
+# downstream layer attenuates it), and they are a vanishing fraction of the
+# param bytes — the classic "keep first/last layers dense" PTQ policy,
+# applied to the last layer only (the input embeds feed deep stacks that
+# wash their error out).
+_DENSE_LAYERS = frozenset({"conv_out", "final_out"})
+
+
+def quantize_params(tree, mode: str):
+    """Quantize every matmul/conv kernel of a converted param tree to the
+    weight mode ("int8" / "fp8"; "none" returns the tree untouched — the
+    bit-identity guarantee of the default config, so it REFUSES trees that
+    already carry quantized leaves).
+
+    Only leaves under a ``"kernel"`` dict key with ndim >= 2 quantize — the
+    layout contract of this module's converters puts exactly the matmul and
+    conv weights there.  Norm ``scale``s, biases, embeddings, modulation
+    tables, and every other leaf stay full precision: they are small, and
+    (for norms/embeddings) precision-critical far beyond their byte share.
+    The OUTPUT HEAD (`_DENSE_LAYERS`: UNet conv_out, DiT/MMDiT final_out)
+    also stays dense — standard post-training-quantization serving policy:
+    its rounding error lands unattenuated in the predicted noise/velocity,
+    it is a vanishing byte share, and keeping it dense is what holds the
+    end-to-end parity inside the pinned tolerances (docs/PERF.md).
+    Each kernel becomes a `parallel.compress.QuantizedTensor` (int8/fp8
+    payload + one fp32 scale per output-channel tile) that dequantizes
+    lazily at its consuming dot/conv, so XLA fuses the convert and HBM
+    holds the 1-byte payload.
+    """
+    from ..parallel.compress import (
+        QuantizedTensor,
+        quantize_weight,
+        validate_weight_mode,
+    )
+
+    validate_weight_mode(mode)
+    if mode == "none":
+        # "none" is the bit-identity guarantee of the default config — a
+        # tree still carrying QuantizedTensor leaves (a quantized .npz
+        # cache loaded into a weight_quant="none" pipeline) would silently
+        # serve quantized numerics while config / weight_report / ExecKey
+        # all claim full precision.  Refuse like the mode-switch path;
+        # dequantize_params is the explicit opt-in to quantized values
+        # under a dense layout.
+        def check(node):
+            if isinstance(node, list):
+                for v in node:
+                    check(v)
+            elif isinstance(node, dict):
+                for v in node.values():
+                    check(v)
+            elif isinstance(node, QuantizedTensor):
+                raise ValueError(
+                    "quantize_params('none') on an already-quantized "
+                    "tree: 'none' promises bit-identity with the dense "
+                    "weights, which this tree no longer holds — rebuild "
+                    "from the dense tree, construct the pipeline with "
+                    "weight_quant matching the archive, or densify "
+                    "explicitly via dequantize_params"
+                )
+
+        check(tree)
+        return tree
+
+    def walk(node, name=""):
+        if isinstance(node, list):
+            return [walk(v, name) for v in node]
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (k == "kernel" and not isinstance(v, (dict, list))
+                        and getattr(v, "ndim", 0) >= 2
+                        and name not in _DENSE_LAYERS):
+                    if isinstance(v, QuantizedTensor):
+                        # idempotent at the SAME mode (a pre-quantized
+                        # .npz cache loads straight into a
+                        # weight_quant=mode pipeline); a mode switch
+                        # would requantize quantized values and compound
+                        # the rounding error — refuse
+                        have = ("int8" if v.payload.dtype == jnp.int8
+                                else "fp8")
+                        if have == mode:
+                            out[k] = v
+                            continue
+                        raise ValueError(
+                            f"quantize_params({mode!r}) on a tree already "
+                            f"quantized at {have!r}: requantizing "
+                            "compounds the rounding error — rebuild from "
+                            "the dense tree"
+                        )
+                    out[k] = quantize_weight(jnp.asarray(v), mode)
+                else:
+                    out[k] = walk(v, k)
+            return out
+        return node
+
+    return walk(tree)
+
+
+def dequantize_params(tree):
+    """Densify every `QuantizedTensor` leaf back to a plain array.  The
+    values are the *dequantized* kernels — exactly what the quantized
+    forward computed with, NOT the original full-precision weights (the
+    per-tile rounding is baked in)."""
+    from ..parallel.compress import QuantizedTensor
+
+    def walk(node):
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, QuantizedTensor):
+            return node.__jax_array__()
+        return node
+
+    return walk(tree)
+
+
+def params_nbytes(tree) -> int:
+    """Exact weight-HBM bytes of a param tree: the closed-form sum over
+    leaves (`QuantizedTensor` kernels count payload + scales — its leaves
+    ARE the resident buffers).  The serve fleet's per-executor weight
+    reports and scripts/bench_weights.py both read this."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+    return total
+
+
+# ---------------------------------------------------------------------------
 # on-disk cache of converted trees
 # ---------------------------------------------------------------------------
 
+# Reserved npz leaf names for a QuantizedTensor kernel: payload, fp32
+# scales, and the (compute dtype, payload dtype) name pair — npz does not
+# round-trip ml_dtypes' float8 (it comes back as a void view), so the
+# payload dtype is recorded and viewed back on load.
+_QT_PAYLOAD, _QT_SCALE, _QT_DTYPES = "__wq__", "__wqs__", "__wqd__"
+
+# Dense leaves with ml_dtypes dtypes (bfloat16 trees) hit the same npz void
+# problem as fp8 payloads: store a uint8 byte view plus the dtype name and
+# view back on load.
+_RAW_VALUE, _RAW_DTYPE = "__wqr__", "__wqrd__"
+
+
+def _weight_payload_dtype(name: str):
+    if name == "int8":
+        return np.dtype(np.int8)
+    from ..parallel.compress import fp8_dtype
+
+    dt = fp8_dtype()
+    if dt is None or np.dtype(dt).name != name:
+        raise ValueError(
+            f"saved quantized payload dtype {name!r} is not available in "
+            "this jax build"
+        )
+    return np.dtype(dt)
+
 
 def _flatten(tree, prefix=""):
+    from ..parallel.compress import QuantizedTensor
+
     flat = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
@@ -565,18 +728,91 @@ def _flatten(tree, prefix=""):
     elif isinstance(tree, list):
         for i, v in enumerate(tree):
             flat.update(_flatten(v, f"{prefix}{i}."))
+    elif isinstance(tree, QuantizedTensor):
+        flat[f"{prefix}{_QT_PAYLOAD}"] = np.asarray(tree.payload)
+        flat[f"{prefix}{_QT_SCALE}"] = np.asarray(tree.scale, np.float32)
+        flat[f"{prefix}{_QT_DTYPES}"] = np.array(
+            [np.dtype(tree.dtype).name, np.dtype(tree.payload.dtype).name]
+        )
     else:
-        flat[prefix[:-1]] = np.asarray(tree)
+        v = np.asarray(tree)
+        if v.dtype.kind == "V":  # ml_dtypes (bf16/fp8): npz would void it
+            flat[f"{prefix}{_RAW_VALUE}"] = (
+                np.ascontiguousarray(v).view(np.uint8))
+            flat[f"{prefix}{_RAW_DTYPE}"] = np.array(v.dtype.name)
+        else:
+            flat[prefix[:-1]] = v
     return flat
 
 
 def save_params(path: str, tree) -> None:
+    """Cache a converted tree as one flat .npz — quantized trees included
+    (int8/fp8 payload + fp32 scales in the same archive), so conversion
+    AND quantization run once and a server restart mmaps the result."""
     np.savez(path, **_flatten(tree))
 
 
-def load_params(path: str, dtype=jnp.float32):
+def _restore(tree, dtype):
+    """Nested npz dicts -> param tree: QuantizedTensor markers rebuilt
+    (payload dtype viewed back — npz voids fp8), everything else cast to
+    ``dtype``.  jnp.array copies (never zero-copy views) for the same
+    mmap-lifetime reason as _cast."""
+    from ..parallel.compress import QuantizedTensor
+
+    if isinstance(tree, list):
+        return [_restore(v, dtype) for v in tree]
+    if isinstance(tree, dict):
+        if _QT_PAYLOAD in tree:
+            names = [str(x) for x in tree[_QT_DTYPES]]
+            pdt = _weight_payload_dtype(names[1])
+            payload = np.asarray(tree[_QT_PAYLOAD])
+            if payload.dtype != pdt:
+                payload = payload.view(pdt)
+            return QuantizedTensor(
+                jnp.array(payload),
+                jnp.array(tree[_QT_SCALE], jnp.float32),
+                jnp.dtype(names[0]),
+            )
+        if _RAW_VALUE in tree:
+            raw = np.asarray(tree[_RAW_VALUE]).view(
+                np.dtype(str(tree[_RAW_DTYPE])))
+            return jnp.array(raw, dtype)
+        return {k: _restore(v, dtype) for k, v in tree.items()}
+    return jnp.array(tree, dtype)
+
+
+def load_params(path: str, dtype=None):
+    """Load a `save_params` archive back into a param tree.
+
+    A DENSE archive casts to ``dtype`` (default float32), exactly like the
+    converters always did.  A QUANTIZED archive's compute dtype comes from
+    the archive itself — the per-tile scales were baked against the
+    quantized kernel's original dtype — and the WHOLE tree (norms, biases,
+    embeddings included) adopts it, so a reload never produces a
+    mixed-precision tree the quantize-at-load path cannot.  Passing an
+    explicit ``dtype`` that disagrees with a quantized archive raises:
+    a caller wanting a different compute dtype rebuilds from the dense
+    weights."""
     data = np.load(path)
     tree: Dict[str, Any] = {}
+    recorded = set()
     for key in data.files:
         _assign(tree, key.split("."), data[key])
-    return _cast(_listify(tree), dtype)
+        if key.split(".")[-1] == _QT_DTYPES:
+            recorded.add(str(data[key][0]))
+    if recorded:
+        if len(recorded) > 1:
+            raise ValueError(
+                f"quantized archive {path!r} mixes compute dtypes "
+                f"{sorted(recorded)}"
+            )
+        archived = jnp.dtype(recorded.pop())
+        if dtype is not None and jnp.dtype(dtype) != archived:
+            raise ValueError(
+                f"load_params(dtype={jnp.dtype(dtype).name!r}) on a "
+                f"quantized archive with compute dtype {archived.name!r}: "
+                "the per-tile scales were baked against the archived dtype "
+                "— rebuild from the dense weights to change compute dtype"
+            )
+        dtype = archived
+    return _restore(_listify(tree), dtype or jnp.float32)

@@ -25,13 +25,19 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.compress import asdense
 from ..parallel.context import PatchContext
 
 _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 
 
 def conv2d(p, x, *, stride: int = 1, padding=None):
-    """Dense NHWC conv. `padding` defaults to (k-1)//2 ("same" for odd k)."""
+    """Dense NHWC conv. `padding` defaults to (k-1)//2 ("same" for odd k).
+
+    ``asdense`` dequantizes a weight-quantized kernel right here, at the
+    consuming conv (lax primitives don't take ``__jax_array__``); inside a
+    traced program XLA fuses the convert, so HBM still holds the int8/fp8
+    payload."""
     kh, kw = p["kernel"].shape[:2]
     if padding is None:
         padding = ((kh - 1) // 2, (kw - 1) // 2)
@@ -39,7 +45,7 @@ def conv2d(p, x, *, stride: int = 1, padding=None):
         padding = (padding, padding)
     y = lax.conv_general_dilated(
         x,
-        p["kernel"],
+        asdense(p["kernel"]),
         window_strides=(stride, stride),
         padding=(
             (padding[0], padding[0]),
@@ -58,7 +64,7 @@ def _conv_valid_h(p, x, stride: int, pad_w: int):
     (conv2d.py:95-110)."""
     y = lax.conv_general_dilated(
         x,
-        p["kernel"],
+        asdense(p["kernel"]),
         window_strides=(stride, stride),
         padding=((0, 0), (pad_w, pad_w)),
         dimension_numbers=_DIMNUMS,

@@ -29,6 +29,13 @@ Modes (DistriConfig.comm_compress):
   Closed-loop (DPCM) coding: the delta is taken against the *reconstructed*
   previous value, so quantization error does not accumulate across steps.
 
+The same per-tile machinery also generalizes from the wires to the
+*weights* (ROADMAP item 5): `QuantizedTensor` + `quantize_weight` hold
+matmul/conv kernels as int8/fp8 payloads with one fp32 scale per
+output-channel tile, dequantized lazily at the consuming dot/conv
+(models/weights.py quantize_params owns the tree-level policy;
+DistriConfig.weight_quant the knob).
+
 Only stale-phase refresh traffic compresses; warmup/sync collectives stay
 full-precision and bit-exact (reference-faithful).  GroupNorm moment
 exchanges are never compressed: they are O(groups) — noise against the KV
@@ -43,12 +50,18 @@ from __future__ import annotations
 import math
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..utils.config import SP_AXIS
 
 COMPRESS_MODES = ("none", "int8", "fp8", "int8_residual")
+
+# Weight-tree quantization modes (DistriConfig.weight_quant /
+# weight_quant_aux; models/weights.py quantize_params).  "int8_residual" is
+# wire-only: weights have no previous-step value to delta-code against.
+WEIGHT_QUANT_MODES = ("none", "int8", "fp8")
 
 # Layer kinds (context.KIND_REGISTRY) whose stale refresh compresses.  "gn"
 # is deliberately absent (see module docstring); "stepcache" is a local
@@ -85,35 +98,140 @@ def validate_mode(mode: str) -> None:
         )
 
 
-def quantize(x, mode: str):
-    """Per-tile symmetric quantization over the LAST axis.
+def quantize(x, mode: str, axis: int = -1):
+    """Per-tile symmetric quantization over one reduction axis.
 
     Returns ``(payload, scale)``: payload is int8 (or float8_e4m3fn for
-    "fp8") with x's shape; scale is fp32 with shape ``x.shape[:-1]`` — one
-    scale per tile, the "halo-row / KV-row" granularity.  Exact zeros map to
-    exact zeros (edge-device halo semantics depend on it).
+    "fp8") with x's shape; scale is fp32 with shape ``x.shape`` minus
+    ``axis`` — one scale per tile.  The default ``axis=-1`` is the wire
+    granularity (one scale per halo-row / KV-row); weight kernels use
+    ``axis=-2`` (one scale per output-channel tile — the reduction axis of
+    the consuming dot/conv, so dequantization error stays per-output-
+    channel-bounded).  Exact zeros map to exact zeros (edge-device halo
+    semantics depend on it).
     """
     xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1)
+    amax = jnp.max(jnp.abs(xf), axis=axis)
     if mode in ("int8", "int8_residual"):
         scale = jnp.maximum(amax, _SCALE_FLOOR) / _INT8_MAX
         q = jnp.clip(
-            jnp.round(xf / scale[..., None]), -_INT8_MAX, _INT8_MAX
+            jnp.round(xf / jnp.expand_dims(scale, axis)), -_INT8_MAX,
+            _INT8_MAX
         ).astype(jnp.int8)
     elif mode == "fp8":
         dt = fp8_dtype()
         if dt is None:
             raise ValueError("fp8 payloads unsupported by this jax build")
         scale = jnp.maximum(amax, _SCALE_FLOOR) / _FP8_MAX
-        q = (xf / scale[..., None]).astype(dt)
+        q = (xf / jnp.expand_dims(scale, axis)).astype(dt)
     else:
         raise ValueError(f"not a quantizing mode: {mode!r}")
     return q, scale
 
 
-def dequantize(payload, scale, dtype):
+def dequantize(payload, scale, dtype, axis: int = -1):
     """Inverse of ``quantize`` (up to the per-tile rounding error)."""
-    return (payload.astype(jnp.float32) * scale[..., None]).astype(dtype)
+    return (payload.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis)).astype(dtype)
+
+
+def validate_weight_mode(mode: str) -> None:
+    """Config-time validation of a weight-quantization mode, shared by
+    DistriConfig (``weight_quant``/``weight_quant_aux``) and ServeConfig."""
+    if mode not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"weight_quant must be one of {WEIGHT_QUANT_MODES}, got {mode!r}"
+        )
+    if mode == "fp8" and not fp8_supported():
+        raise ValueError(
+            "weight_quant='fp8' needs jax.numpy.float8_e4m3fn, which this "
+            "jax build lacks — use 'int8'"
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """A quantized weight kernel: 1-byte payload + one fp32 scale per
+    output-channel tile, dequantized lazily where it is consumed.
+
+    The payload keeps the kernel's layout (linear ``[..., in, out]``, conv
+    HWIO ``[kh, kw, I, O]``); the scale reduces away the second-to-last
+    (input/reduction) axis, so a stacked block tree ``[depth, in, out]``
+    keeps per-(block, out-channel) scales and slices along ``depth``
+    exactly like a dense leaf (``jax.tree.map(lambda l: l[:k], ...)``
+    maps into payload and scale, both depth-leading).
+
+    Registered as a pytree node, so quantized trees flow through jit /
+    shard_map / scan unchanged; ``__jax_array__`` makes any jnp consumer
+    (``x @ kernel``, einsum, vmap'd linears) dequantize on the fly —
+    inside a traced program XLA fuses the convert+multiply into the
+    consuming dot, so HBM holds (and streams) the 1-byte payload.  lax
+    primitives don't take the protocol: explicit call sites (the conv
+    paths in ops/conv.py) densify via ``asdense``.
+    """
+
+    __slots__ = ("payload", "scale", "_dtype")
+
+    def __init__(self, payload, scale, dtype):
+        self.payload = payload
+        self.scale = scale
+        self._dtype = jnp.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self.payload.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.payload.ndim
+
+    @property
+    def size(self) -> int:
+        return self.payload.size
+
+    @property
+    def dtype(self):
+        """The dequantized (compute) dtype — what the dense leaf had."""
+        return self._dtype
+
+    @property
+    def nbytes(self) -> int:
+        """HBM residency: payload plus scales (what the fleet's weight
+        reports sum)."""
+        return int(self.payload.size * jnp.dtype(self.payload.dtype).itemsize
+                   + self.scale.size * 4)
+
+    def __jax_array__(self):
+        return dequantize(self.payload, self.scale, self._dtype, axis=-2)
+
+    def __repr__(self) -> str:
+        return (f"QuantizedTensor(shape={tuple(self.shape)}, "
+                f"payload={jnp.dtype(self.payload.dtype).name}, "
+                f"dtype={self._dtype.name})")
+
+    def tree_flatten(self):
+        return (self.payload, self.scale), (self._dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+
+def quantize_weight(w, mode: str) -> QuantizedTensor:
+    """Quantize one kernel leaf with per-output-channel-tile fp32 scales
+    (the output axis is last in both the linear and HWIO conv layouts, so
+    the reduction axis is always ``-2``)."""
+    if mode not in ("int8", "fp8"):
+        raise ValueError(f"not a weight-quantizing mode: {mode!r}")
+    q, scale = quantize(w, mode, axis=-2)
+    return QuantizedTensor(q, scale, w.dtype)
+
+
+def asdense(x):
+    """Dequantize a `QuantizedTensor` (identity on anything else) — for
+    call sites that feed lax primitives directly, which don't take the
+    ``__jax_array__`` protocol."""
+    return x.__jax_array__() if isinstance(x, QuantizedTensor) else x
 
 
 def wire_nbytes(shape: Sequence[int], itemsize: int, mode: str) -> int:

@@ -41,6 +41,8 @@ from .models.weights import (
     convert_unet_state_dict,
     convert_vae_state_dict,
     load_sharded_safetensors,
+    params_nbytes,
+    quantize_params,
 )
 from .parallel.runner import make_runner
 from .schedulers import BaseScheduler, FlowMatchEulerScheduler, get_scheduler
@@ -422,6 +424,23 @@ def _decode_chunked(decode, vae_params, latent, bs, scaling, shift=0.0):
     return jnp.concatenate(outs, axis=0)
 
 
+def _quantize_aux(cfg, vae_params, text_encoders=(), t5_params=None):
+    """Load-time quantization of the AUXILIARY models (VAE, CLIP text
+    encoders, T5) under the ``weight_quant_aux`` sub-knob — one place for
+    the policy every pipeline family shares, so a constructor can't
+    quantize one component under the wrong knob or skip one.  The DENOISER
+    stays with its caller: its ``weight_quant`` step has per-family
+    ordering constraints (PixArt folds the size conditioning first).
+    Returns ``(vae_params, [(cfg, params), ...], t5_params-or-None)``.
+    """
+    q = lambda p: quantize_params(p, cfg.weight_quant_aux)  # noqa: E731
+    return (
+        q(vae_params),
+        [(tc, q(tp)) for tc, tp in text_encoders],
+        None if t5_params is None else q(t5_params),
+    )
+
+
 class _GenerationMixin:
     """Machinery shared by EVERY pipeline family (UNet, DiT, MMDiT): the
     output packaging tail of __call__, the staged-execution surface
@@ -503,6 +522,76 @@ class _GenerationMixin:
             "steps": counts,
             "bytes_per_step": per_step,
             "total_bytes": int(total),
+        }
+
+    def set_weight_quant(self, mode: str) -> None:
+        """Re-quantize the DENOISER's weights to ``mode`` post-construction
+        (docs/PERF.md "Quantized weights").
+
+        The quantize direction ("none" -> int8/fp8) is the serve ladder's
+        ``weight_quant_on`` rung promoted to a pipeline policy hook
+        (serve.executors.apply_key_policy calls it for ExecKeys that
+        request quantization from a full-precision builder): quantizing the
+        already-converted dense tree is the exact same operation load-time
+        quantization performs.  Call before `prepare()` — the quantized
+        tree is a different pytree structure, so anything already compiled
+        is dropped and retraces.
+
+        The reverse direction raises: a quantized tree's full-precision
+        values are gone (dequantizing bakes the rounding in), so a
+        "full-precision" program recovered this way would silently carry
+        quantization error — builders wanting both precisions must build
+        from the dense weights per key."""
+        from .parallel.compress import validate_weight_mode
+
+        cfg = self.distri_config
+        validate_weight_mode(mode)
+        if mode == cfg.weight_quant:
+            return
+        if cfg.parallelism in ("tensor", "pipefusion"):
+            # same guard as DistriConfig.__post_init__: these runners
+            # pre-shard/pre-slice their kernels eagerly, and quantizing
+            # the sharded tree post-hoc would feed QuantizedTensor leaves
+            # into lax paths that never densify them
+            raise ValueError(
+                f"weight_quant does not apply to parallelism="
+                f"{cfg.parallelism!r} (pre-sharded kernels) — the ladder's "
+                "weight_quant_on rung cannot degrade this pipeline"
+            )
+        if cfg.weight_quant != "none":
+            raise ValueError(
+                f"cannot switch weight_quant {cfg.weight_quant!r} -> "
+                f"{mode!r}: the full-precision kernels are gone — rebuild "
+                "the pipeline from the dense weights instead"
+            )
+        self.runner.params = quantize_params(self.runner.params, mode)
+        cfg.weight_quant = mode
+        compiled = getattr(self.runner, "_compiled", None)
+        if compiled:
+            compiled.clear()
+
+    def weight_report(self) -> dict:
+        """Per-component weight-HBM bytes (models/weights.params_nbytes:
+        quantized kernels count payload + scales) plus the active modes —
+        what the serve executors surface into ``metrics_snapshot()`` next
+        to the PR-4 wire bytes."""
+        cfg = self.distri_config
+        parts = {
+            "denoiser": params_nbytes(self.runner.params),
+            "vae": params_nbytes(self.vae_params),
+        }
+        text = 0
+        for _tc, tparams in getattr(self, "text_encoders", ()) or ():
+            text += params_nbytes(tparams)
+        t5 = getattr(self, "t5", None)
+        if t5 is not None and t5[1] is not None:
+            text += params_nbytes(t5[1])
+        parts["text_encoders"] = text
+        return {
+            "weight_quant": cfg.weight_quant,
+            "weight_quant_aux": cfg.weight_quant_aux,
+            "per_component_nbytes": parts,
+            "total_bytes": sum(parts.values()),
         }
 
     def set_stepwise(self, enabled: bool = True) -> None:
@@ -648,10 +737,15 @@ class _DistriPipelineBase(_GenerationMixin):
         self.distri_config = distri_config
         self.unet_config = unet_config
         self.vae_config = vae_config
-        self.vae_params = vae_params
+        # load-time weight quantization (docs/PERF.md "Quantized weights"):
+        # the denoiser under weight_quant, the aux models (text encoders +
+        # VAE) under their own tolerance sub-knob — "none" is a no-op, so
+        # the default config stays bit-identical
+        unet_params = quantize_params(unet_params, distri_config.weight_quant)
+        self.vae_params, self.text_encoders, _ = _quantize_aux(
+            distri_config, vae_params, text_encoders)
         self.scheduler = scheduler
         self.tokenizers = tokenizers
-        self.text_encoders = text_encoders
         self.runner = make_runner(distri_config, unet_config, unet_params, scheduler)
         cfg = distri_config
         # public introspection: which decode path was installed
@@ -1091,13 +1185,17 @@ class DistriPixArtPipeline(_GenerationMixin):
         self.distri_config = cfg
         self.dit_config = dit_config
         self.vae_config = vae_config
-        self.vae_params = vae_params
+        self.vae_params, _, t5_q = _quantize_aux(cfg, vae_params,
+                                                 t5_params=t5_params)
         self.scheduler = scheduler
         self.tokenizer = tokenizer
-        self.t5 = (t5_config, t5_params)
+        self.t5 = (t5_config, t5_q)
+        # fold the size conditioning BEFORE quantizing: it edits embedding
+        # biases the quantizer must see in their final form
         dit_params = dit_mod.fold_size_condition(
             dit_params, dit_config, float(cfg.height), float(cfg.width)
         )
+        dit_params = quantize_params(dit_params, cfg.weight_quant)
         runner_cls = (
             PipeFusionRunner if cfg.parallelism == "pipefusion"
             else DiTDenoiseRunner
@@ -1347,12 +1445,14 @@ class DistriSD3Pipeline(_GenerationMixin):
         self.distri_config = cfg
         self.mmdit_config = mmdit_config
         self.vae_config = vae_config
-        self.vae_params = vae_params
+        self.vae_params, self.text_encoders, t5_q = _quantize_aux(
+            cfg, vae_params, text_encoders, t5_params)
         self._vae_shift = vae_config.shift_factor
         self.scheduler = scheduler
         self.tokenizers = tokenizers
-        self.text_encoders = text_encoders
-        self.t5 = (t5_config, t5_params)
+        text_encoders = self.text_encoders
+        mmdit_params = quantize_params(mmdit_params, cfg.weight_quant)
+        self.t5 = (t5_config, t5_q)
         self.max_t5_tokens = max_t5_tokens
         pooled_dim = sum(
             tc.projection_dim or tc.hidden_size for tc, _ in text_encoders

@@ -41,7 +41,13 @@ class ExecKey:
     differing only in cadence must not share an executor — and so is
     ``comm_compress`` (DistriConfig semantics): the stale-refresh
     quantize/dequantize ops are traced into the program, so a mode change
-    is a different executable.  ``exec_mode``
+    is a different executable — and ``weight_quant``
+    (DistriConfig semantics): the param tree's pytree structure and the
+    dequantize converts are part of the traced program, so a
+    full-precision and a quantized executor for the same bucket are
+    distinct compiled programs coexisting in one fleet (the resilience
+    ladder's ``weight_quant_on`` rung moves OOM-degraded keys onto the
+    smaller quantized one).  ``exec_mode``
     ("fused" | "stepwise") selects the denoise-loop dispatch: the fused
     compiled scan, or the host-driven stepwise loop — same numerics, a
     much smaller program; the resilience layer's degradation ladder
@@ -58,6 +64,7 @@ class ExecKey:
     step_cache_interval: int = 1
     step_cache_depth: int = 0
     comm_compress: str = "none"
+    weight_quant: str = "none"
     exec_mode: str = "fused"
 
     def __post_init__(self):
@@ -66,23 +73,34 @@ class ExecKey:
                 f"exec_mode must be 'fused' or 'stepwise', got "
                 f"{self.exec_mode!r}"
             )
-        from ..parallel.compress import COMPRESS_MODES
+        from ..parallel.compress import COMPRESS_MODES, WEIGHT_QUANT_MODES
 
         if self.comm_compress not in COMPRESS_MODES:
             raise ValueError(
                 f"comm_compress must be one of {COMPRESS_MODES}, got "
                 f"{self.comm_compress!r}"
             )
+        if self.weight_quant not in WEIGHT_QUANT_MODES:
+            raise ValueError(
+                f"weight_quant must be one of {WEIGHT_QUANT_MODES}, got "
+                f"{self.weight_quant!r}"
+            )
 
     def short(self) -> str:
+        # every identity field appears (scheduler included): short() keys
+        # the per-executor ledgers (weight_bytes, circuits, degradations),
+        # so two resident keys must never collide to one tag
         g = "cfg" if self.cfg else "nocfg"
         sc = (f":sc{self.step_cache_interval}x{self.step_cache_depth}"
               if self.step_cache_interval > 1 else "")
         cc = ("" if self.comm_compress == "none"
               else f":{self.comm_compress}")
+        wq = ("" if self.weight_quant == "none"
+              else f":wq-{self.weight_quant}")
         em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
-        return (f"{self.model_id}:{self.height}x{self.width}"
-                f"@{self.steps}st:{g}:{self.mesh_plan}{sc}{cc}{em}")
+        return (f"{self.model_id}:{self.scheduler}:{self.height}x"
+                f"{self.width}@{self.steps}st:{g}:{self.mesh_plan}"
+                f"{sc}{cc}{wq}{em}")
 
 
 class ExecutorCache:
@@ -248,6 +266,17 @@ class ExecutorCache:
             _, hit = self.get(key)
             built += 0 if hit else 1
         return built
+
+    def weight_bytes(self) -> Dict[str, Optional[int]]:
+        """Per-resident-executor weight-HBM bytes (None for executors that
+        don't report — fakes, custom adapters): the fleet's weight-memory
+        ledger, surfaced by `InferenceServer.metrics_snapshot()` alongside
+        the PR-4 wire bytes."""
+        with self._lock:
+            return {
+                k.short(): getattr(ex, "weight_nbytes", None)
+                for k, ex in self._entries.items()
+            }
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -69,6 +69,23 @@ class BuildFailedError(RetryableError):
     cheaper program (step-cache off, stepwise loop, smaller bucket)."""
 
 
+class DegradationInapplicableError(ValueError):
+    """A key's degradation-relevant field cannot be forced onto the built
+    pipeline — deterministically, for every rebuild (e.g. the
+    ``weight_quant_on`` rung against a tensor/pipefusion builder whose
+    pre-sharded kernels can never quantize, or ``stepwise_fallback``
+    against PipeFusion).  Raised by `executors.apply_key_policy`;
+    the server's retry loop RETRACTS the named rung for that key (it is
+    pinned inapplicable, never re-picked) instead of retrying a build
+    that can only fail the same way.  A ValueError, not a ServeError:
+    direct `apply_key_policy` callers keep seeing the exception class the
+    underlying pipeline hooks always raised."""
+
+    def __init__(self, message: str, rung: str):
+        super().__init__(message)
+        self.rung = rung
+
+
 class ExecuteFailedError(RetryableError):
     """The batched mesh dispatch raised.  The original exception rides
     ``__cause__``."""

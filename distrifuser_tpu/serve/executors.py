@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .cache import ExecKey
+from .errors import DegradationInapplicableError
 from .faults import FaultPlan
 
 
@@ -74,6 +75,11 @@ class PipelineExecutor:
         # (0 with the cache off) — the server's shallow-share metrics read
         # this off every executor it dispatches to
         self.shallow_steps = pipeline.step_cache_plan(steps)["shallow_steps"]
+        # weight-HBM ledger entry (pipelines.weight_report): what this
+        # executor's resident param trees cost, quantization included —
+        # surfaced per key by ExecutorCache.weight_bytes / metrics_snapshot
+        report = getattr(pipeline, "weight_report", None)
+        self.weight_nbytes = report()["total_bytes"] if report else None
 
     def _in_channels(self) -> int:
         pipe = self.pipeline
@@ -232,8 +238,30 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
     # configure is the builder's job, like the cadence above
     if key.comm_compress == "none" and dcfg.comm_compress != "none":
         dcfg.comm_compress = "none"
+    # weight_quant inverts the convention: here the QUANTIZE direction is
+    # the safe post-construction force (quantizing the built dense tree is
+    # exactly what load-time quantization does), and the ladder's
+    # weight_quant_on rung depends on it working against builders that
+    # ignore the field.  The reverse — a full-precision key against a
+    # quantized builder — raises inside set_weight_quant: the dense
+    # kernels are gone, and a silently dequantized "full-precision"
+    # program would carry hidden rounding error.
+    if (key.weight_quant != getattr(dcfg, "weight_quant", "none")
+            and hasattr(pipeline, "set_weight_quant")):
+        try:
+            pipeline.set_weight_quant(key.weight_quant)
+        except ValueError as exc:
+            # deterministic for every rebuild of this (builder, key) pair
+            # — the retry loop retracts the weight_quant_on rung instead
+            # of retrying into the same wall (serve/errors.py)
+            raise DegradationInapplicableError(
+                str(exc), rung="weight_quant_on") from exc
     if key.exec_mode == "stepwise":
-        pipeline.set_stepwise(True)
+        try:
+            pipeline.set_stepwise(True)
+        except ValueError as exc:
+            raise DegradationInapplicableError(
+                str(exc), rung="stepwise_fallback") from exc
 
 
 def pipeline_executor_factory(

@@ -53,6 +53,7 @@ RUNG_SPLIT = "split_batch"
 RUNG_STAGING_OFF = "staging_off"
 RUNG_STEP_CACHE_OFF = "step_cache_off"
 RUNG_STEPWISE = "stepwise_fallback"
+RUNG_WEIGHT_QUANT = "weight_quant_on"
 RUNG_BUCKET = "bucket_fallback"
 
 
@@ -327,6 +328,11 @@ class KeyResilience:
     rungs: List[str] = dataclasses.field(default_factory=list)
     batch_cap: Optional[int] = None
     last_error: str = ""
+    # rungs retracted because applying them proved deterministically
+    # impossible for this key's builder (executors.apply_key_policy raised
+    # DegradationInapplicableError — e.g. weight_quant_on against a
+    # tensor/pipefusion pipeline): pinned so next_rung never re-picks them
+    inapplicable: List[str] = dataclasses.field(default_factory=list)
 
 
 class DegradationLadder:
@@ -356,7 +362,13 @@ class DegradationLadder:
     4. `stepwise_fallback`: swap the fused scan for the host-driven
        stepwise loop — the compat-shim fallback reused as a policy: same
        numerics, a much smaller program to compile and hold;
-    5. `bucket_fallback` (off by default — it changes the output
+    5. `weight_quant_on` (off by default — the first rung whose outputs
+       CHANGE, within the pinned parity tolerances): rebuild the key with
+       int8 quantized weights (ExecKey.weight_quant="int8",
+       executors.apply_key_policy quantizes the built tree) — roughly
+       halves the executor's weight HBM, the biggest single give-back,
+       while keeping the resolution contract bucket_fallback would break;
+    6. `bucket_fallback` (off by default — it changes the output
        resolution contract): serve the request at the next smaller
        configured bucket.
 
@@ -365,7 +377,7 @@ class DegradationLadder:
     mode rung: it leaves the key unchanged)."""
 
     KEY_RUNGS = (RUNG_STAGING_OFF, RUNG_STEP_CACHE_OFF, RUNG_STEPWISE,
-                 RUNG_BUCKET)
+                 RUNG_WEIGHT_QUANT, RUNG_BUCKET)
 
     def __init__(self, config: ResilienceConfig,
                  buckets: Sequence[Tuple[int, int]] = (),
@@ -393,6 +405,8 @@ class DegradationLadder:
             return cfg.allow_step_cache_off and key.step_cache_interval > 1
         if rung == RUNG_STEPWISE:
             return cfg.allow_stepwise_fallback and key.exec_mode == "fused"
+        if rung == RUNG_WEIGHT_QUANT:
+            return cfg.allow_weight_quant_on and key.weight_quant == "none"
         if rung == RUNG_BUCKET:
             return (cfg.allow_bucket_fallback
                     and self._smaller_bucket(key) is not None)
@@ -408,7 +422,9 @@ class DegradationLadder:
             return None
         degraded = self.apply(key, state.rungs)
         for rung in self.KEY_RUNGS:
-            if rung not in state.rungs and self._applicable(rung, degraded):
+            if (rung not in state.rungs
+                    and rung not in state.inapplicable
+                    and self._applicable(rung, degraded)):
                 return rung
         return None
 
@@ -420,6 +436,10 @@ class DegradationLadder:
                     key, step_cache_interval=1, step_cache_depth=0)
             elif rung == RUNG_STEPWISE:
                 key = dataclasses.replace(key, exec_mode="stepwise")
+            elif rung == RUNG_WEIGHT_QUANT:
+                # int8 over fp8: universally available, and the rung's
+                # point is bytes — both payloads are 1 byte/element
+                key = dataclasses.replace(key, weight_quant="int8")
             elif rung == RUNG_BUCKET:
                 b = self._smaller_bucket(key)
                 if b is not None:
@@ -558,6 +578,23 @@ class ResilienceEngine:
             st.rungs.append(rung)
         return rung
 
+    def retract_rung(self, key: ExecKey, rung: str) -> Optional[str]:
+        """Un-apply a sticky rung whose application proved impossible for
+        this key's builder (the build raised through
+        `executors.apply_key_policy`'s DegradationInapplicableError) and
+        pin it inapplicable so `next_rung` never re-picks it — a transient
+        OOM must not become a permanently failing key.  Returns the rung
+        when it was actually retracted, None when it was never applied
+        (the key itself requested the impossible field: that is the
+        caller's contract error, and the normal retry path fails it)."""
+        st = self.key_state(key)
+        if rung not in st.rungs:
+            return None
+        st.rungs.remove(rung)
+        if rung not in st.inapplicable:
+            st.inapplicable.append(rung)
+        return rung
+
     def degraded_key(self, key: ExecKey) -> ExecKey:
         with self._keys_lock:
             st = self._keys.get(key)
@@ -590,10 +627,12 @@ class ResilienceEngine:
         circuits = {k.short(): st.breaker.snapshot() for k, st in items}
         degradations = {}
         for k, st in items:
-            if st.rungs or st.batch_cap is not None:
+            if st.rungs or st.batch_cap is not None or st.inapplicable:
                 entry: Dict[str, Any] = {"rungs": list(st.rungs)}
                 if st.batch_cap is not None:
                     entry["batch_cap"] = st.batch_cap
+                if st.inapplicable:
+                    entry["inapplicable"] = list(st.inapplicable)
                 degradations[k.short()] = entry
         return {
             "circuits": circuits,

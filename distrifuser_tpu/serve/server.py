@@ -56,6 +56,7 @@ from .errors import (
     BuildFailedError,
     CircuitOpenError,
     DeadlineExceededError,
+    DegradationInapplicableError,
     ExecuteFailedError,
     FatalError,
     NoBucketError,
@@ -266,6 +267,7 @@ class InferenceServer:
             step_cache_interval=self.config.step_cache_interval,
             step_cache_depth=self.config.step_cache_depth,
             comm_compress=self.config.comm_compress,
+            weight_quant=self.config.weight_quant,
         )
 
     def _batch_cap_for(self, key: BatchKey) -> Optional[int]:
@@ -617,7 +619,18 @@ class InferenceServer:
                 failed_counter = ("failed_build"
                                   if isinstance(exc, BuildFailedError)
                                   else "failed_execute")
-                if kind in ("oom", "compile"):
+                cause = exc.__cause__
+                if (isinstance(exc, BuildFailedError)
+                        and isinstance(cause, DegradationInapplicableError)
+                        and res.retract_rung(base_key, cause.rung)):
+                    # the rung can NEVER build for this key's builder
+                    # (e.g. weight_quant_on against tensor/pipefusion):
+                    # un-apply it and retry at the retracted key instead
+                    # of turning a transient OOM into a permanently
+                    # failing key; the pin in KeyResilience.inapplicable
+                    # keeps the ladder from re-picking it
+                    self.counters.inc("degradation_retracted_" + cause.rung)
+                elif kind in ("oom", "compile"):
                     rung = res.degrade(base_key, kind, len(batch))
                     if rung == RUNG_SPLIT:
                         if not res.acquire_retry():
@@ -781,6 +794,13 @@ class InferenceServer:
                 "mean": (n_reqs / n_batches) if n_batches else 0.0,
             },
             "cache": self.cache.stats(),
+            # per-executor weight-HBM bytes (quantization-aware, None for
+            # non-reporting executors) — the weight-side companion of the
+            # PR-4 wire-byte accounting
+            "weights": {
+                "weight_quant": self.config.weight_quant,
+                "per_executor_nbytes": self.cache.weight_bytes(),
+            },
             "resilience": self.resilience.snapshot(),
             # per-stage queue-wait/service histograms + denoise-gap
             # fraction (None on monolithic servers)

@@ -146,6 +146,24 @@ class DistriConfig:
     # GroupNorm moment exchanges never compress (tiny, cancellation-
     # sensitive).  Composes with comm_batch and the step cache.
     comm_compress: str = "none"
+    # Quantized-weight serving (parallel/compress.py QuantizedTensor;
+    # models/weights.py quantize_params): hold the DENOISER's matmul/conv
+    # kernels as int8 (or fp8 where the jax build has float8_e4m3fn)
+    # payloads with one fp32 scale per output-channel tile, dequantized on
+    # the fly at the consuming dot/conv — XLA fuses the convert, so HBM
+    # residency and weight streaming drop to ~1 byte/element.  "none"
+    # (default) is bit-identical to today.  Norm/bias/embedding leaves
+    # never quantize.  Composes with the step cache, comm_compress,
+    # comm_batch, and the fused/stepwise loops; tensor parallelism and
+    # PipeFusion pre-shard/pre-slice their kernels eagerly and reject the
+    # knob loudly.
+    weight_quant: str = "none"
+    # Same knob for the AUXILIARY models (CLIP/T5 text encoders + VAE):
+    # a separate sub-knob because their tolerance budgets differ from the
+    # denoiser's — the text embedding feeds every denoise step, and VAE
+    # decode error lands directly in output pixels (docs/PERF.md
+    # "Quantized weights" for the measured tolerances).
+    weight_quant_aux: str = "none"
     # Sequence-parallel VAE decode over the sp axis (exact: fresh halo convs,
     # psum'd GroupNorm, ring mid attention — models/vae.py decode_sp).  The
     # reference decodes the full latent replicated on every rank; this is n x
@@ -215,7 +233,7 @@ class DistriConfig:
             # Same constraint as the reference pipelines (pipelines.py:71).
             raise ValueError("height and width must be multiples of 8")
         # lazy import: parallel.compress imports SP_AXIS from this module
-        from ..parallel.compress import validate_mode
+        from ..parallel.compress import validate_mode, validate_weight_mode
 
         validate_mode(self.comm_compress)
         if self.comm_compress != "none" and self.parallelism != "patch":
@@ -223,6 +241,17 @@ class DistriConfig:
                 "comm_compress targets the displaced-patch refresh "
                 f"exchanges (parallelism='patch'); {self.parallelism!r} has "
                 "no stale refresh traffic to compress"
+            )
+        validate_weight_mode(self.weight_quant)
+        validate_weight_mode(self.weight_quant_aux)
+        if (self.weight_quant != "none"
+                and self.parallelism in ("tensor", "pipefusion")):
+            raise ValueError(
+                "weight_quant quantizes the replicated denoiser kernels "
+                "(parallelism='patch'/'naive_patch'); "
+                f"{self.parallelism!r} pre-shards or pre-slices its param "
+                "tree eagerly and would silently densify the payloads — "
+                "keep weight_quant='none' there"
             )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
@@ -471,6 +500,14 @@ class ResilienceConfig:
     allow_staging_off: bool = True
     allow_step_cache_off: bool = True
     allow_stepwise_fallback: bool = True
+    # OOM/compile ladder rung below stepwise: rebuild the key with int8
+    # quantized weights (ExecKey.weight_quant="int8") — roughly halves the
+    # executor's weight HBM, the biggest single give-back on the ladder.
+    # OFF by default because, unlike the rungs above it, outputs change
+    # (within the pinned parity tolerances, docs/PERF.md "Quantized
+    # weights"); opt in like bucket_fallback when availability under OOM
+    # outranks bit-stability.
+    allow_weight_quant_on: bool = False
     allow_bucket_fallback: bool = False
     last_errors_capacity: int = 16
     seed: int = 0
@@ -578,6 +615,16 @@ class ServeConfig:
     # pipeline builder behind executor_factory must construct its
     # DistriConfig with the same mode.
     comm_compress: str = "none"
+    # Service-wide DENOISER weight quantization (DistriConfig.weight_quant
+    # semantics): threaded into every ExecKey — full-precision and
+    # quantized executables are different compiled programs and coexist in
+    # one fleet under distinct keys.  The pipeline builder behind
+    # executor_factory must construct its DistriConfig with the same mode
+    # (serve.executors.apply_key_policy force-quantizes builders that
+    # ignore the field, so ladder-degraded keys work against any builder).
+    # The aux-model sub-knob (weight_quant_aux) stays a builder decision:
+    # it is fixed per builder, so it needs no per-key identity.
+    weight_quant: str = "none"
     # Staged pipelining (serve/staging.py, docs/SERVING.md "Staged
     # pipelining"): overlap text-encode, denoise, and VAE-decode across
     # micro-batches so batch k+1 encodes and batch k-1 decodes in the
@@ -624,9 +671,10 @@ class ServeConfig:
             )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
-        from ..parallel.compress import validate_mode
+        from ..parallel.compress import validate_mode, validate_weight_mode
 
         validate_mode(self.comm_compress)
+        validate_weight_mode(self.weight_quant)
         # BucketTable owns bucket validation and the area-major ordering
         # invariant ("smallest covering bucket" scans front-to-back) — one
         # normalization, not a copy here that could drift.  Lazy import:

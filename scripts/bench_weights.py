@@ -1,0 +1,224 @@
+"""Quantized-weight serving micro-bench: weight-HBM bytes + parity per mode.
+
+Tiny-config CPU-runnable probe of the weight_quant knob
+(parallel/compress.py QuantizedTensor; models/weights.py quantize_params):
+build otherwise-identical tiny pipelines per family (UNet / DiT / MMDiT) —
+one per requested mode — and report, per (family, mode):
+
+  * denoiser weight-HBM bytes from ``weight_report()`` (the closed-form
+    ``params_nbytes`` sum: int8/fp8 payloads + fp32 scales vs dense
+    elements) and the reduction ratio vs "none";
+  * steps/sec of the END-TO-END pipeline call — text-encode, the fused
+    denoise loop, VAE decode, and the host copy are all inside the timed
+    window, so on the tiny configs this is whole-pipeline latency, not
+    denoise-loop throughput (on CPU it mostly shows the quantized path
+    adds no wall-clock cliff — the streaming win needs real HBM; the
+    byte column is the number the knob exists for, and it is exact on
+    any backend);
+  * max |Δ| of the decoded image vs the same family's "none" run.
+
+Emits ONE JSON line.  Gates on the acceptance criteria: >= 1.7x denoiser
+byte reduction at int8 for every family, parity within the pinned
+tolerances (UNet <= 1e-2, DiT/MMDiT <= 3e-3 — docs/PERF.md "Quantized
+weights"), and a second "none" pipeline bit-identical to the baseline
+(the default config changes nothing).
+
+Timing discipline matches bench_stepcache.py: compile outside the timed
+window, every repeat ends in a device_get data dependency.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_weights.py \
+        [--steps 2] [--families unet,dit,mmdit] [--modes none,int8,fp8] \
+        [--repeats 2] [--out FILE]
+
+The tier-1 workflow runs this and uploads the line as an artifact, next to
+the step-cache / comm-compression / staged-serve benches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pinned per-family parity tolerances (max |Δ| of the decoded image vs the
+# "none" run at identical seed/steps) — docs/PERF.md "Quantized weights".
+# int8 gates CI; fp8's 3-bit mantissa cannot meet the int8 numbers and is
+# scored against its own informative bounds (reported, never gated).
+TOLERANCES = {"unet": 1e-2, "dit": 3e-3, "mmdit": 3e-3}
+FP8_BOUNDS = {"unet": 4.5e-2, "dit": 1e-2, "mmdit": 1.3e-2}
+INT8_MIN_RATIO = 1.7
+
+
+def _build(family: str, mode: str):
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+
+    # guidance OFF: CFG's (1+gs)-fold difference amplification is a
+    # property of the sampler, not of the quantizer under test
+    common = dict(
+        devices=jax.devices()[:1], height=128, width=128, warmup_steps=1,
+        parallelism="patch", do_classifier_free_guidance=False,
+        dtype=jnp.float32, weight_quant=mode,
+    )
+    if family == "unet":
+        from distrifuser_tpu.models.clip import (init_clip_params,
+                                                 tiny_clip_config)
+        from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+        from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+        from distrifuser_tpu.pipelines import DistriSDPipeline
+
+        cfg = DistriConfig(**common)
+        tc = tiny_clip_config(hidden=32)
+        ucfg = tiny_config(cross_attention_dim=32, sdxl=False)
+        return DistriSDPipeline.from_params(
+            cfg, ucfg, init_unet_params(jax.random.PRNGKey(0), ucfg),
+            tiny_vae_config(),
+            init_vae_params(jax.random.PRNGKey(1), tiny_vae_config()),
+            [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+        )
+    if family == "dit":
+        from distrifuser_tpu.models import dit as dit_mod
+        from distrifuser_tpu.models import t5 as t5_mod
+        from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+        from distrifuser_tpu.pipelines import DistriPixArtPipeline
+
+        cfg = DistriConfig(**common)
+        t5cfg = t5_mod.tiny_t5_config()
+        dcfg = dit_mod.DiTConfig(
+            sample_size=16, patch_size=2, hidden_size=64, depth=4,
+            num_heads=4, mlp_ratio=2, caption_dim=t5cfg.d_model,
+        )
+        return DistriPixArtPipeline.from_params(
+            cfg, dcfg, dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg),
+            tiny_vae_config(),
+            init_vae_params(jax.random.PRNGKey(1), tiny_vae_config()),
+            t5_config=t5cfg,
+            t5_params=t5_mod.init_t5_params(jax.random.PRNGKey(2), t5cfg),
+        )
+    if family == "mmdit":
+        from distrifuser_tpu.models import mmdit as mm
+        from distrifuser_tpu.models.clip import (CLIPTextConfig,
+                                                 init_clip_params,
+                                                 tiny_clip_config)
+        from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+        from distrifuser_tpu.pipelines import DistriSD3Pipeline
+
+        cfg = DistriConfig(height=256, width=256, **{
+            k: v for k, v in common.items() if k not in ("height", "width")})
+        tc1 = tiny_clip_config(hidden=16)
+        tc2 = CLIPTextConfig(
+            vocab_size=1000, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=32, projection_dim=8,
+        )
+        mcfg = mm.tiny_mmdit_config()
+        return DistriSD3Pipeline.from_params(
+            cfg, mcfg, mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg),
+            tiny_vae_config(),
+            init_vae_params(jax.random.PRNGKey(1), tiny_vae_config()),
+            [tc1, tc2],
+            [init_clip_params(jax.random.PRNGKey(2), tc1),
+             init_clip_params(jax.random.PRNGKey(3), tc2)],
+        )
+    raise SystemExit(f"unknown family {family!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--families", type=str, default="unet,dit,mmdit")
+    ap.add_argument("--modes", type=str, default="none,int8,fp8")
+    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also append the JSON line to this file")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+
+    from distrifuser_tpu.parallel.compress import fp8_supported
+
+    modes = [m for m in args.modes.split(",") if m]
+    if not fp8_supported() and "fp8" in modes:
+        modes.remove("fp8")
+    # "none" is the parity/byte baseline of every other row: always run
+    # it, and first (whatever order --modes listed)
+    modes = ["none"] + [m for m in modes if m != "none"]
+    families = [f for f in args.families.split(",") if f]
+
+    per_family = {}
+    ok = True
+    for family in families:
+        rows = {}
+        base_img = base_bytes = None
+        for mode in modes:
+            pipe = _build(family, mode)
+            prompt = "a tpu etching an image"
+            gen = lambda: np.stack(pipe(  # noqa: E731 — fresh traced call
+                [prompt] if family == "unet" else prompt,
+                num_inference_steps=args.steps, seed=args.seed,
+                guidance_scale=1.0, output_type="np").images)
+            img = gen()  # compile outside the timed window
+            best = min(
+                (lambda t0: (gen(), time.perf_counter() - t0)[1])(
+                    time.perf_counter()
+                )
+                for _ in range(args.repeats)
+            )
+            nbytes = pipe.weight_report()["per_component_nbytes"]["denoiser"]
+            row = {
+                "denoiser_nbytes": int(nbytes),
+                "steps_per_s": round(args.steps / best, 3),
+            }
+            if mode == "none":
+                base_img, base_bytes = img, nbytes
+                # a SECOND "none" build must be bit-identical: the default
+                # config path is untouched by the quantization machinery
+                img2 = np.stack(_build(family, "none")(
+                    [prompt] if family == "unet" else prompt,
+                    num_inference_steps=args.steps, seed=args.seed,
+                    guidance_scale=1.0, output_type="np").images)
+                row["bit_identical"] = bool((img == img2).all())
+                ok &= row["bit_identical"]
+            else:
+                delta = float(np.abs(img.astype(np.float64)
+                                     - base_img.astype(np.float64)).max())
+                row["byte_reduction"] = round(base_bytes / nbytes, 3)
+                row["max_abs_delta"] = delta
+                tol = (TOLERANCES if mode == "int8" else FP8_BOUNDS)[family]
+                row["within_tolerance"] = delta <= tol
+                if mode == "int8":
+                    ok &= row["within_tolerance"]
+                    ok &= row["byte_reduction"] >= INT8_MIN_RATIO
+            rows[mode] = row
+        per_family[family] = rows
+
+    line = {
+        "bench": "weights",
+        "backend": jax.default_backend(),
+        "steps": args.steps,
+        "seed": args.seed,
+        "tolerances": TOLERANCES,
+        "fp8_bounds": FP8_BOUNDS,
+        "int8_min_ratio": INT8_MIN_RATIO,
+        "families": per_family,
+        "ok": bool(ok),
+    }
+    print(json.dumps(line), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,17 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         "wire (int8/fp8 + per-tile fp32 scales; "
                         "int8_residual delta-codes against the carried "
                         "stale value — docs/PERF.md)")
+    parser.add_argument("--weight_quant", type=str, default="none",
+                        choices=["none", "int8", "fp8"],
+                        help="hold the denoiser's matmul/conv kernels as "
+                        "int8/fp8 payloads + per-output-channel-tile fp32 "
+                        "scales, dequantized at the consuming dot/conv "
+                        "(docs/PERF.md 'Quantized weights')")
+    parser.add_argument("--weight_quant_aux", type=str, default="none",
+                        choices=["none", "int8", "fp8"],
+                        help="same knob for the aux models (CLIP/T5 text "
+                        "encoders + VAE) — separate because their "
+                        "tolerance budgets differ from the denoiser's")
     parser.add_argument("--no_vae_sp", action="store_true",
                         help="disable the sequence-parallel VAE decode "
                         "(replicate the dense decode on every device instead)")
@@ -132,6 +143,8 @@ def config_from_args(args) -> DistriConfig:
         ulysses_degree=args.ulysses_degree,
         comm_batch=args.comm_batch,
         comm_compress=args.comm_compress,
+        weight_quant=getattr(args, "weight_quant", "none"),
+        weight_quant_aux=getattr(args, "weight_quant_aux", "none"),
         hybrid_loop=args.hybrid_loop,
         vae_sp=not args.no_vae_sp,
         dtype=None if args.dtype is None else getattr(jnp, args.dtype),

@@ -355,6 +355,72 @@ def test_updater_accepts_bench_attention_lines(tmp_path):
     assert routes[(64, 12)][0] == "inrepo"  # failed upstream excluded
 
 
+def test_updater_accepts_batch2_campaign_records(tmp_path):
+    """chip_campaign.py emits ``batch=2`` (the CFG pair) in attn/tune
+    records: the updater must carry them end to end — the roofline floor
+    doubles (4*B*h*L^2*d flops), the batch lands in the table comment, and
+    the rendered block round-trips."""
+    import json as _json
+
+    import update_sdpa_table as upd
+
+    # b=2 floor at L=16384 h=10 d=64: 4*2*10*16384^2*64/197e12 ~= 6.98 ms
+    floor_b2 = upd._roofline_floor_ms(
+        {"L": 16384, "heads": 10, "head_dim": 64, "batch": 2})
+    floor_b1 = upd._roofline_floor_ms(
+        {"L": 16384, "heads": 10, "head_dim": 64})
+    assert floor_b2 == pytest.approx(2 * floor_b1)
+
+    log = tmp_path / "campaign.log"
+    lines = [
+        {"phase": "attn", "L": 16384, "heads": 10, "head_dim": 64,
+         "batch": 2, "ms": {"xla": 30.0, "inrepo": 20.0, "upstream": 12.0}},
+        # 5 ms sits ABOVE the b=1 floor (~3.5 ms) but BELOW the b=2 floor
+        # (~6.98 ms): a b=2 record must drop it as a timing escape
+        {"phase": "tune_upstream", "L": 16384, "heads": 10, "head_dim": 64,
+         "batch": 2, "ms": {"512x512": 5.0, "256x1024": 10.0}},
+    ]
+    log.write_text("\n".join(_json.dumps(rec) for rec in lines) + "\n")
+    attn, tune = upd.parse_log(str(log))
+    assert attn[0]["batch"] == 2 and tune[0]["batch"] == 2
+    routes = upd.build_routes(attn, tune)
+    impl, bq, bk, comment = routes[(64, 14)]
+    assert (impl, bq, bk) == ("upstream", 256, 1024)  # not the 5 ms escape
+    assert "b=2" in comment
+    block = upd.render_block(routes, "unit-test-b2")
+    ns = {"Route": Route}
+    exec(block.replace(upd.BEGIN, "").replace(upd.END, ""), ns)
+    assert ns["MEASURED_ROUTES"][(64, 14)] == Route("upstream", 256, 1024)
+
+
+def test_lookup_nearest_shape_fallback_for_missing_key():
+    """The table is keyed by (head_dim, log2 L) — a query whose exact
+    (batch, seq, heads) combination was never measured still routes via
+    the NEAREST measured bucket at its head_dim (within
+    MAX_BUCKET_DISTANCE), and falls through to the analytic default
+    beyond it.  Batch and head count deliberately do not partition the
+    table: the campaign measures the CFG pair at the model's head counts,
+    and the latency ordering tracks sequence-length scale."""
+    table = {(64, 12): Route("upstream", 256, 1024),
+             (64, 14): Route("inrepo", 512, 512)}
+    old = sdpa_routing.MEASURED_ROUTES
+    sdpa_routing.MEASURED_ROUTES = table
+    try:
+        # L=6000 (bucket ~12.55) was never measured: nearest is 12
+        assert sdpa_routing.lookup(6000, 64) == Route("upstream", 256, 1024)
+        # L=11585 (bucket ~13.5): ties resolve to a measured neighbor,
+        # never to None, as long as one is in range
+        assert sdpa_routing.lookup(11585, 64) in table.values()
+        # L=23000 (bucket ~14.5): nearest is 14
+        assert sdpa_routing.lookup(23000, 64) == Route("inrepo", 512, 512)
+        # missing head_dim: no fallback across head_dims
+        assert sdpa_routing.lookup(6000, 128) is None
+        # far outside every measured bucket: analytic default decides
+        assert sdpa_routing.lookup(240, 64) is None
+    finally:
+        sdpa_routing.MEASURED_ROUTES = old
+
+
 def test_largest_dividing_tile():
     """Tile fitting for the upstream kernel (ADVICE r4): a tuned tile that
     does not divide the call's length is halved to the largest power-of-2

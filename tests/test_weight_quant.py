@@ -1,0 +1,479 @@
+"""Quantized-weight serving (DistriConfig.weight_quant, ISSUE 6): per-tile
+round-trip bounds, tree-level quantization policy, three-family end-to-end
+parity at the pinned tolerances, "none" bit-identity, npz save/load
+equivalence, ExecKey separation in one executor fleet, and the resilience
+ladder's weight_quant_on rung under injected OOM."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+from distrifuser_tpu.models.weights import (
+    dequantize_params,
+    load_params,
+    params_nbytes,
+    quantize_params,
+    save_params,
+)
+from distrifuser_tpu.parallel.compress import (
+    QuantizedTensor,
+    asdense,
+    fp8_supported,
+    quantize,
+    dequantize,
+    quantize_weight,
+    validate_weight_mode,
+)
+from distrifuser_tpu.serve import (
+    CircuitBreaker,
+    DegradationLadder,
+    ExecKey,
+    InferenceServer,
+    ResilienceConfig,
+    ServeConfig,
+)
+from distrifuser_tpu.serve.faults import InjectedResourceExhausted
+from distrifuser_tpu.serve.resilience import (
+    RUNG_WEIGHT_QUANT,
+    KeyResilience,
+)
+from distrifuser_tpu.serve.testing import FakeExecutor
+
+from test_pipelines import build_sd_pipeline
+
+# the pinned per-family parity tolerances (docs/PERF.md "Quantized
+# weights"; scripts/bench_weights.py gates CI on the same numbers)
+TOL = {"unet": 1e-2, "dit": 3e-3, "mmdit": 3e-3}
+
+MODES = ["int8"] + (["fp8"] if fp8_supported() else [])
+
+
+# --------------------------------------------------------------------------
+# per-tile quantize/dequantize round-trip bounds
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_weight_roundtrip_error_bounded_per_tile(mode):
+    w = jax.random.normal(jax.random.PRNGKey(0), (6, 48, 32)) * jnp.exp(
+        jax.random.normal(jax.random.PRNGKey(1), (6, 1, 32)) * 2
+    )  # per-(block, out-channel) magnitude spread: per-tile scales must adapt
+    qt = quantize_weight(w, mode)
+    err = np.abs(np.asarray(asdense(qt), np.float64) - np.asarray(w, np.float64))
+    # symmetric rounding: |err| <= scale/2 per int8 tile; fp8 e4m3 has a
+    # 3-bit mantissa -> relative ~2^-4 of the tile amax
+    amax = np.abs(np.asarray(w, np.float64)).max(axis=-2, keepdims=True)
+    bound = amax / 254.0 if mode == "int8" else amax / 16.0
+    assert (err <= bound + 1e-7).all()
+    assert qt.shape == w.shape and qt.dtype == w.dtype
+    # scale reduces the second-to-last (reduction) axis only
+    assert qt.scale.shape == (6, 32)
+
+
+def test_weight_quantize_zeros_and_nbytes():
+    w = jnp.zeros((16, 8))
+    qt = quantize_weight(w, "int8")
+    assert (np.asarray(qt.payload) == 0).all()
+    assert (np.asarray(asdense(qt)) == 0).all()
+    # HBM residency: 1-byte payload + fp32 scale per output channel
+    assert qt.nbytes == 16 * 8 + 8 * 4
+    # asdense is the identity on plain arrays
+    assert asdense(w) is w
+
+
+def test_wire_quantize_axis_parameter_matches_wire_granularity():
+    """axis=-1 (the PR-4 wire default) and axis=-2 (the weight tile) are
+    the same machinery: round-tripping either way stays within the tile
+    bound of its own axis."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 6, 8))
+    for axis in (-1, -2):
+        q, s = quantize(x, "int8", axis=axis)
+        back = dequantize(q, s, x.dtype, axis=axis)
+        amax = np.abs(np.asarray(x)).max(axis=axis, keepdims=True)
+        assert (np.abs(np.asarray(back) - np.asarray(x))
+                <= amax / 254.0 + 1e-7).all()
+
+
+def test_validate_weight_mode():
+    validate_weight_mode("none")
+    validate_weight_mode("int8")
+    with pytest.raises(ValueError, match="weight_quant"):
+        validate_weight_mode("int8_residual")  # wire-only mode
+    with pytest.raises(ValueError, match="weight_quant"):
+        validate_weight_mode("int4")
+
+
+# --------------------------------------------------------------------------
+# tree-level policy (models/weights.quantize_params)
+# --------------------------------------------------------------------------
+
+
+def test_quantize_params_policy_and_bytes():
+    params = init_unet_params(jax.random.PRNGKey(0), tiny_config())
+    q = quantize_params(params, "int8")
+    # structure-preserving: same dict/list skeleton
+    assert jax.tree.structure(q) != jax.tree.structure(params)  # QT leaves
+    # matmul/conv kernels quantize ...
+    assert isinstance(q["conv_in"]["kernel"], QuantizedTensor)
+    # ... but the OUTPUT HEAD stays dense (PTQ policy, docs/PERF.md) ...
+    assert not isinstance(q["conv_out"]["kernel"], QuantizedTensor)
+    # ... and norm scales / biases stay dense
+    assert q["conv_in"]["bias"].dtype == params["conv_in"]["bias"].dtype
+    assert not isinstance(q["conv_in"]["bias"], QuantizedTensor)
+    # the knob exists for this number: >= 1.7x denoiser byte reduction
+    assert params_nbytes(params) / params_nbytes(q) >= 1.7
+    # "none" is the identity, not a copy
+    assert quantize_params(params, "none") is params
+    # idempotent at the same mode: a pre-quantized .npz cache loads
+    # straight into a weight_quant="int8" pipeline (quantized leaves kept
+    # by identity, nothing requantized)
+    q2 = quantize_params(q, "int8")
+    assert q2["conv_in"]["kernel"] is q["conv_in"]["kernel"]
+    # a MODE SWITCH would requantize quantized values: refuse
+    if fp8_supported():
+        with pytest.raises(ValueError, match="already quantized"):
+            quantize_params(q, "fp8")
+    # "none" on an already-quantized tree would silently serve quantized
+    # numerics under a full-precision identity (config / weight_report /
+    # ExecKey all claiming "none"): refuse just as loudly
+    with pytest.raises(ValueError, match="bit-identity"):
+        quantize_params(q, "none")
+    # dequantize_params densifies every QT leaf back to plain arrays
+    d = dequantize_params(q)
+    assert jax.tree.structure(d) == jax.tree.structure(params)
+    np.testing.assert_allclose(
+        np.asarray(d["conv_in"]["kernel"]),
+        np.asarray(params["conv_in"]["kernel"]), atol=0.02)
+
+
+def test_quantized_tree_save_load_equivalence(tmp_path):
+    """Conversion + quantization runs once: the quantized tree round-trips
+    through the flat .npz (payload + scales + dtype pair) bit-exactly."""
+    params = init_unet_params(jax.random.PRNGKey(0), tiny_config())
+    for mode in MODES:
+        q = quantize_params(params, mode)
+        path = str(tmp_path / f"q_{mode}.npz")
+        save_params(path, q)
+        back = load_params(path)
+        assert jax.tree.structure(q) == jax.tree.structure(back)
+        for a, b in zip(jax.tree.leaves(q), jax.tree.leaves(back)):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert params_nbytes(back) == params_nbytes(q)
+
+
+# --------------------------------------------------------------------------
+# end-to-end parity + bit-identity (UNet family here; DiT/MMDiT parity is
+# pinned at the same tolerances by scripts/bench_weights.py in CI, and the
+# families share one quantization path — models/weights.quantize_params)
+# --------------------------------------------------------------------------
+
+
+def test_unet_family_parity_and_none_bit_identity(devices8):
+    kw = dict(batch_size=1, do_classifier_free_guidance=False)
+    base, _ = build_sd_pipeline(devices8, 1, **kw)
+    gen = lambda p: np.stack(  # noqa: E731
+        p(["a cat"], num_inference_steps=2, seed=3, guidance_scale=1.0,
+          output_type="np").images).astype(np.float64)
+    ref = gen(base)
+    # weight_quant="none" is bit-identical to a config that predates the knob
+    again, _ = build_sd_pipeline(devices8, 1, weight_quant="none", **kw)
+    np.testing.assert_array_equal(gen(again), ref)
+    # int8 stays inside the pinned family tolerance
+    q, _ = build_sd_pipeline(devices8, 1, weight_quant="int8", **kw)
+    assert np.abs(gen(q) - ref).max() <= TOL["unet"]
+    rep = q.weight_report()
+    assert rep["weight_quant"] == "int8"
+    assert rep["per_component_nbytes"]["denoiser"] * 1.7 <= (
+        base.weight_report()["per_component_nbytes"]["denoiser"])
+    # aux models were NOT quantized (separate sub-knob)
+    assert rep["weight_quant_aux"] == "none"
+    assert rep["per_component_nbytes"]["vae"] == (
+        base.weight_report()["per_component_nbytes"]["vae"])
+
+
+def test_set_weight_quant_matches_load_time_and_refuses_reverse(devices8):
+    kw = dict(batch_size=1, do_classifier_free_guidance=False)
+    load_time, _ = build_sd_pipeline(devices8, 1, weight_quant="int8", **kw)
+    post, _ = build_sd_pipeline(devices8, 1, **kw)
+    post.set_weight_quant("int8")
+    gen = lambda p: np.stack(  # noqa: E731
+        p(["a cat"], num_inference_steps=1, seed=5, guidance_scale=1.0,
+          output_type="np").images)
+    np.testing.assert_array_equal(gen(load_time), gen(post))
+    # the dense kernels are gone: un-quantizing must refuse loudly
+    with pytest.raises(ValueError, match="rebuild"):
+        post.set_weight_quant("none")
+
+
+def test_weight_quant_rejects_eager_sharding_parallelism(devices8):
+    from distrifuser_tpu import DistriConfig
+
+    with pytest.raises(ValueError, match="weight_quant"):
+        DistriConfig(height=128, width=128, parallelism="tensor",
+                     weight_quant="int8")
+    # the post-construction hook enforces the SAME guard: the ladder must
+    # not force-quantize a pre-sharded tensor-parallel tree
+    pipe, _ = build_sd_pipeline(devices8, 1, parallelism="tensor",
+                                batch_size=1)
+    with pytest.raises(ValueError, match="parallelism"):
+        pipe.set_weight_quant("int8")
+    # through the serve policy hook the same refusal comes back typed, so
+    # the retry loop can retract the ladder rung instead of retrying into
+    # a deterministic wall
+    from distrifuser_tpu.serve.errors import DegradationInapplicableError
+    from distrifuser_tpu.serve.executors import apply_key_policy
+
+    with pytest.raises(DegradationInapplicableError) as ei:
+        apply_key_policy(pipe, key_for(weight_quant="int8"))
+    assert ei.value.rung == RUNG_WEIGHT_QUANT
+
+
+def test_quantized_npz_loads_into_quantized_pipeline(tmp_path, devices8):
+    """The docs' restart story end to end: convert+quantize once, save,
+    reload, hand the pre-quantized tree to a weight_quant='int8' pipeline
+    — the constructor keeps the quantized leaves (idempotent) and the
+    forward matches quantize-at-load bit for bit.  The archived compute
+    dtype wins over load_params' dtype argument (the scales were baked
+    against it)."""
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.clip import init_clip_params, tiny_clip_config
+    from distrifuser_tpu.models.unet import tiny_config as unet_tiny
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+
+    # archived compute dtype wins over load_params' dtype argument: the
+    # WHOLE tree (dense leaves included) adopts it, and an explicit
+    # mismatching dtype refuses
+    bf16_kernel = {"kernel": jnp.ones((8, 4), jnp.bfloat16),
+                   "bias": np.zeros((4,), np.float32)}
+    dpath = str(tmp_path / "bf16_kernel.npz")
+    save_params(dpath, quantize_params(bf16_kernel, "int8"))
+    loaded = load_params(dpath)
+    assert loaded["kernel"].dtype == jnp.bfloat16
+    assert loaded["bias"].dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="compute dtype"):
+        load_params(dpath, jnp.float32)
+
+    ucfg = unet_tiny(cross_attention_dim=32, sdxl=False)
+    dense = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    path = str(tmp_path / "unet_int8.npz")
+    save_params(path, quantize_params(dense, "int8"))
+    reloaded = load_params(path)
+    assert reloaded["conv_in"]["kernel"].dtype == jnp.float32  # archived
+
+    def pipe_with(unet_params):
+        cfg = DistriConfig(devices=devices8[:1], height=128, width=128,
+                           warmup_steps=1, weight_quant="int8",
+                           do_classifier_free_guidance=False, batch_size=1)
+        tc = tiny_clip_config(hidden=32)
+        return DistriSDPipeline.from_params(
+            cfg, ucfg, unet_params, tiny_vae_config(),
+            init_vae_params(jax.random.PRNGKey(1), tiny_vae_config()),
+            [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+        )
+
+    gen = lambda p: np.stack(  # noqa: E731
+        p(["a cat"], num_inference_steps=1, seed=5, guidance_scale=1.0,
+          output_type="np").images)
+    np.testing.assert_array_equal(gen(pipe_with(reloaded)),
+                                  gen(pipe_with(dense)))
+
+
+# --------------------------------------------------------------------------
+# serve: ExecKey separation + the weight_quant_on ladder rung
+# --------------------------------------------------------------------------
+
+
+def key_for(h=512, w=512, steps=4, **kw):
+    kw.setdefault("model_id", "m")
+    kw.setdefault("scheduler", "ddim")
+    kw.setdefault("cfg", True)
+    kw.setdefault("mesh_plan", "dp1.cfg1.sp1")
+    return ExecKey(height=h, width=w, steps=steps, **kw)
+
+
+def test_exec_key_weight_quant_identity_and_short():
+    full = key_for()
+    quant = dataclasses.replace(full, weight_quant="int8")
+    assert full != quant and hash(full) != hash(quant)
+    assert "wq-int8" in quant.short() and "wq" not in full.short()
+    with pytest.raises(ValueError, match="weight_quant"):
+        key_for(weight_quant="int4")
+
+
+def test_ladder_rung_ordering_and_gate():
+    cfg = ResilienceConfig(allow_weight_quant_on=True,
+                           allow_bucket_fallback=True)
+    lad = DegradationLadder(cfg, buckets=((512, 512), (1024, 1024)))
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    k = key_for(1024, 1024)
+    order = []
+    for _ in range(6):
+        rung = lad.next_rung(st, "oom", k, batch_size=1)
+        if rung is None:
+            break
+        st.rungs.append(rung)
+        order.append(rung)
+    # weight_quant_on sits between stepwise and the contract-changing
+    # bucket fallback (it changes numerics within tolerance, not shape)
+    assert order.index("stepwise_fallback") < order.index(RUNG_WEIGHT_QUANT)
+    assert order.index(RUNG_WEIGHT_QUANT) < order.index("bucket_fallback")
+    dk = lad.apply(k, st.rungs)
+    assert dk.weight_quant == "int8"
+    # OFF by default: the first rung whose outputs change is opt-in
+    lad_default = DegradationLadder(ResilienceConfig(), buckets=())
+    st2 = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    st2.rungs.extend(["staging_off", "step_cache_off", "stepwise_fallback"])
+    assert lad_default.next_rung(st2, "oom", k, batch_size=1) is None
+    # already-quantized keys have nothing to give back on this rung
+    lad_on = DegradationLadder(cfg, buckets=())
+    st3 = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    st3.rungs.extend(["staging_off", "step_cache_off", "stepwise_fallback"])
+    qk = dataclasses.replace(k, weight_quant="int8")
+    assert lad_on.next_rung(st3, "oom", qk, batch_size=1) is None
+
+
+def test_server_oom_ladder_lands_on_quantized_key_both_executors_resident():
+    """Acceptance (ISSUE 6): one server holds a full-precision AND a
+    quantized executor for the SAME bucket under distinct ExecKeys — the
+    OOM ladder switches the key onto weight_quant_on, and the fleet's
+    weight ledger reports both programs' bytes."""
+    DENSE, QUANT = 1_000_000, 540_000
+    built = []
+
+    class LedgerFake(FakeExecutor):
+        def __init__(self, key, **kw):
+            super().__init__(key, **kw)
+            self.weight_nbytes = QUANT if key.weight_quant == "int8" else DENSE
+            self.oomed = False
+
+        def __call__(self, prompts, negatives, gs, seeds):
+            # the dense program OOMs once at execute time (fragmented HBM);
+            # the quantized rebuild fits
+            if self.key.weight_quant == "none" and not self.oomed:
+                self.oomed = True
+                raise InjectedResourceExhausted("RESOURCE_EXHAUSTED: HBM")
+            return super().__call__(prompts, negatives, gs, seeds)
+
+    def factory(key):
+        built.append(key)
+        return LedgerFake(key, batch_size=4)
+
+    cfg = ServeConfig(
+        max_queue_depth=16, max_batch_size=1, batch_window_s=0.05,
+        buckets=((512, 512),), default_steps=4,
+        resilience=ResilienceConfig(
+            max_retries=4, backoff_base_s=0.001, backoff_max_s=0.002,
+            backoff_jitter=0.0, allow_weight_quant_on=True,
+            allow_staging_off=False, allow_step_cache_off=False,
+            allow_stepwise_fallback=False, allow_batch_split=False,
+        ),
+    )
+    with InferenceServer(factory, cfg) as server:
+        r = server.submit("p", height=512, width=512, seed=1).result(timeout=30)
+        # the ladder invalidated the poisoned dense program; the operator
+        # re-admits it through the fleet's public cache surface once the
+        # HBM pressure passes — both executables now coexist
+        server.cache.get(built[0])
+        snap = server.metrics_snapshot()
+        health = server.health()
+    assert r.degradations == (RUNG_WEIGHT_QUANT,)
+    wq = [k.weight_quant for k in built]
+    assert wq == ["none", "int8", "none"]
+    assert built[0] == dataclasses.replace(built[1], weight_quant="none")
+    # both executables coexist in the fleet, under distinct short() tags,
+    # and the weight ledger shows the quantized program is the smaller one
+    ledger = snap["weights"]["per_executor_nbytes"]
+    assert ledger == {built[0].short(): DENSE, built[1].short(): QUANT}
+    assert snap["requests"]["degraded_" + RUNG_WEIGHT_QUANT] == 1
+    assert health["status"] == "degraded"
+
+
+def test_ladder_retracts_weight_quant_rung_builder_cannot_quantize():
+    """A transient OOM must not become a permanently failing key when the
+    builder can never quantize (tensor/pipefusion parallelism): the
+    quantized rebuild's DegradationInapplicableError retracts the
+    weight_quant_on rung, pins it inapplicable so the ladder never
+    re-picks it, and the request still completes at full precision."""
+    from distrifuser_tpu.serve.errors import DegradationInapplicableError
+
+    built = []
+
+    class OnceOOMFake(FakeExecutor):
+        def __init__(self, key, **kw):
+            super().__init__(key, **kw)
+            self.oomed = False
+
+        def __call__(self, prompts, negatives, gs, seeds):
+            if not self.oomed:
+                self.oomed = True
+                raise InjectedResourceExhausted("RESOURCE_EXHAUSTED: HBM")
+            return super().__call__(prompts, negatives, gs, seeds)
+
+    def factory(key):
+        built.append(key)
+        if key.weight_quant != "none":
+            # what executors.apply_key_policy raises for a tensor/
+            # pipefusion pipeline (pre-sharded kernels cannot quantize)
+            raise DegradationInapplicableError(
+                "weight_quant does not apply to parallelism='tensor'",
+                rung=RUNG_WEIGHT_QUANT)
+        return OnceOOMFake(key, batch_size=4)
+
+    cfg = ServeConfig(
+        max_queue_depth=16, max_batch_size=1, batch_window_s=0.05,
+        buckets=((512, 512),), default_steps=4,
+        resilience=ResilienceConfig(
+            max_retries=5, backoff_base_s=0.001, backoff_max_s=0.002,
+            backoff_jitter=0.0, allow_weight_quant_on=True,
+            allow_staging_off=False, allow_step_cache_off=False,
+            allow_stepwise_fallback=False, allow_batch_split=False,
+        ),
+    )
+    with InferenceServer(factory, cfg) as server:
+        r = server.submit("p", height=512, width=512, seed=1).result(timeout=30)
+        snap = server.metrics_snapshot()
+    # the retracted rung no longer degrades the request...
+    assert r.degradations == ()
+    wq = [k.weight_quant for k in built]
+    assert wq == ["none", "int8", "none"]
+    assert snap["requests"]["degradation_retracted_" + RUNG_WEIGHT_QUANT] == 1
+    # ...and is pinned inapplicable in the health surface so the ladder
+    # never re-picks it for this key
+    degr = snap["resilience"]["degradations"]
+    assert [e["inapplicable"] for e in degr.values()] == [[RUNG_WEIGHT_QUANT]]
+    assert all(e["rungs"] == [] for e in degr.values())
+
+
+def test_apply_key_policy_quantizes_full_precision_builder(devices8):
+    """serve.executors.apply_key_policy force-quantizes a builder that
+    ignored ExecKey.weight_quant (the ladder rung depends on it), and the
+    executor reports quantized weight bytes + the shrunk program parity."""
+    from distrifuser_tpu.serve.executors import pipeline_executor_factory
+
+    def build(key: ExecKey):
+        pipe, _ = build_sd_pipeline(
+            devices8, 1, height=key.height, width=key.width, batch_size=1,
+            do_classifier_free_guidance=False,
+        )
+        return pipe  # builder ignores key.weight_quant entirely
+
+    factory = pipeline_executor_factory(build)
+    key = ExecKey(model_id="t", scheduler="ddim", height=128, width=128,
+                  steps=1, cfg=False, mesh_plan="dp1.cfg1.sp1")
+    dense = factory(key)
+    quant = factory(dataclasses.replace(key, weight_quant="int8"))
+    assert dense.pipeline.distri_config.weight_quant == "none"
+    assert quant.pipeline.distri_config.weight_quant == "int8"
+    assert quant.weight_nbytes * 1.7 <= dense.weight_nbytes
+    a = dense(["a cat"], [""], 1.0, seeds=[3])
+    b = quant(["a cat"], [""], 1.0, seeds=[3])
+    assert np.abs(np.asarray(a[0], np.float64)
+                  - np.asarray(b[0], np.float64)).max() <= TOL["unet"]
