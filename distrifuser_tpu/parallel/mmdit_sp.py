@@ -750,7 +750,13 @@ class MMDiTDenoiseRunner:
         layout = cfg.attn_impl
         if not cfg.is_sp:
             report = {"layout": layout, "kv_state_elems": 0,
-                      "per_step_collective_elems": 0}
+                      "per_step_collective_elems": 0,
+                      # byte model: a single-device group has no sp
+                      # traffic — zero is the truth, not a guess
+                      # (pipelines.comm_plan raises on runners that
+                      # lack these keys)
+                      "per_step_collective_bytes": 0,
+                      "sync_step_collective_bytes": 0}
             if cfg.step_cache_enabled:
                 report["step_cache"] = {
                     "interval": cfg.step_cache_interval,

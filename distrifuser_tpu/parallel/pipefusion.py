@@ -41,6 +41,38 @@ guidance (epsilon chunks are gathered and combined at stage 0), ``dp`` still
 shards independent images, and the scheduler family (DDIM/Euler/DPM++ 2M)
 steps patch-wise — its state is carried stacked per patch so DPM's
 cross-step scalars stay correct while patches of adjacent steps interleave.
+
+First-class knob composition (PR 7; ROADMAP item 2):
+
+* **Temporal step cache** (``step_cache_interval``/``step_cache_depth``,
+  parallel/stepcache.py): ``step_cache_depth`` counts *pipeline stages*
+  here — on shallow steps the deepest K stages do not run their blocks.
+  Each deep stage carries a per-patch residual delta ``out - in`` recorded
+  at its last full pass (warmup passes record it too, so the first
+  post-warmup step may already be shallow); on a shallow item the stage's
+  tick body takes a `lax.cond` branch that emits ``h_in + delta[patch]``
+  and leaves its KV cache untouched — the stage's block FLOPs and KV
+  commits vanish from the shallow path while the tick schedule (and hence
+  the static scan shape) stays uniform, so the compiled program carries
+  exactly two tick bodies (full + pass-through) like the displaced
+  runners' full/shallow pair.  The ring hops themselves still run on
+  shallow ticks (a chunk must still travel to stage 0 for its scheduler
+  update), so shallow wire bytes equal full-step bytes — ``comm_report``
+  says so explicitly.
+* **Wire compression** (``comm_compress``, parallel/compress.py): the
+  inter-stage activation chunk is quantized before each steady-state
+  `ppermute` hop and dequantized right after (int8/fp8 payload + one fp32
+  scale per token row).  ``int8_residual`` delta-codes against the
+  previous step's chunk for the same (patch, sender-stage) pair,
+  closed-loop: sender and receiver both carry the *reconstructed*
+  previous payload (seeded from the exact warmup hops), so quantization
+  error never accumulates.  Warmup mega-patch hops never compress —
+  warmup-only runs stay bit-identical.
+* **Quantized weights** (``weight_quant``): the stacked block tree is
+  quantized BEFORE the depth split with depth-leading per-tile scales
+  (compress.QuantizedTensor), so shard_map slices payload and scale alike
+  and each stage holds 1-byte stage-local kernels, dequantized at the
+  consuming dot.
 """
 
 from __future__ import annotations
@@ -59,6 +91,7 @@ from jax.sharding import PartitionSpec as P
 from ..models import dit as dit_mod
 from ..models.dit import DiTConfig
 from ..ops.linear import linear
+from .compress import dequantize, fp8_dtype, quantize, wire_nbytes
 from .guidance import branch_select, combine_guidance
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
@@ -78,6 +111,15 @@ def _tree_dynamic_update(tree, sub, i, pred):
         return jnp.where(pred, new, l)
 
     return jax.tree.map(upd, tree, sub)
+
+
+def _buf_update(buf, val, i, pred):
+    """Write ``val`` at index ``i`` of per-patch buffer ``buf`` where
+    ``pred`` (the masked commit idiom shared by the delta and predictor
+    carries)."""
+    new = lax.dynamic_update_index_in_dim(buf, val.astype(buf.dtype), i,
+                                          axis=0)
+    return jnp.where(pred, new, buf)
 
 
 class PipeFusionRunner:
@@ -121,7 +163,16 @@ class PipeFusionRunner:
                 "fall back to"
             )
         self.stages = cfg.n_device_per_batch
+        if pipe_patches is None:
+            pipe_patches = cfg.pipe_patches  # may still be None
         self.patches = self.stages if pipe_patches is None else pipe_patches
+        if cfg.step_cache_enabled and cfg.step_cache_depth >= self.stages:
+            raise ValueError(
+                "under PipeFusion, step_cache_depth counts PIPELINE STAGES "
+                f"skipped on shallow steps: depth {cfg.step_cache_depth} "
+                f"must be < the {self.stages} stages (stage 0 embeds and "
+                "scheduler-steps, it can never be skipped)"
+            )
         n_tok = dcfg.num_tokens
         if dcfg.depth % self.stages != 0:
             raise ValueError(
@@ -216,11 +267,25 @@ class PipeFusionRunner:
         cap_bias = dit_mod.caption_mask_bias(my_mask)
         bloc = my_enc.shape[0]  # batch inside the pipeline (2B when folded)
 
+        # knob composition (module docstring): wire compression of the
+        # steady ring hops + the stage-skipping step cache
+        mode = cfg.comm_compress
+        use_sc = cfg.step_cache_enabled
+        n_deep = cfg.step_cache_depth if use_sc else 0
+        interval = cfg.step_cache_interval
+        is_deep = p_idx >= (n_stage - n_deep)  # False everywhere when off
+
         compute_dtype = params["proj_in"]["kernel"].dtype
         pos = dit_mod.pos_embed_table(dcfg, compute_dtype)
 
         blocks_local = params["blocks"]  # leaves [Lp, ...] (sharded over sp)
-        y_cap = dit_mod.caption_project(params, my_enc)  # loop-invariant
+        # model-dtype entry cast, exactly like precompute_caption_kv's (its
+        # docstring explains the silent upcast leak): fp32 caption embeds
+        # would otherwise yield fp32 cross-attention KV that promotes the
+        # whole residual stream — at bf16 that broke the _run_stage scan
+        # carry outright (f32 out vs bf16 in)
+        y_cap = dit_mod.caption_project(
+            params, my_enc.astype(compute_dtype))  # loop-invariant
         cap_kv_local = jax.vmap(lambda kvp: linear(kvp, y_cap))(
             blocks_local["cross_kv"]
         )  # [Lp, Bl, Lt, 2*hid]
@@ -255,9 +320,81 @@ class PipeFusionRunner:
             sstate = _tree_dynamic_update(sstate, new_st, m, pred)
             return x_full, sstate
 
+        def split_patches(full):
+            """[bloc, n_tok, hid] -> [n_patch, bloc, chunk, hid]."""
+            return full.reshape(bloc, n_patch, chunk, hid).transpose(
+                1, 0, 2, 3)
+
+        def init_aux():
+            """Knob-dependent extra carry: the per-stage step-cache delta
+            and/or the residual coder's sender/receiver predictors.  One
+            pytree shared by every tick body (warmup records, steady
+            consumes), so the scan carry structure never depends on which
+            step body runs."""
+            aux = {}
+            if use_sc:
+                aux["delta"] = jnp.zeros(
+                    (n_patch, bloc, chunk, hid), compute_dtype)
+            if mode == "int8_residual":
+                aux["send_pred"] = jnp.zeros(
+                    (n_patch, bloc, chunk, hid), jnp.float32)
+                aux["recv_pred"] = jnp.zeros(
+                    (n_patch, bloc, chunk, hid), jnp.float32)
+            return aux
+
+        def steady_ring0():
+            """Zero ring for the steady phase: raw chunk, or the
+            (payload, scale) pair the compressed hops permute."""
+            if mode == "none":
+                return jnp.zeros((bloc, chunk, hid), compute_dtype)
+            pdt = fp8_dtype() if mode == "fp8" else jnp.int8
+            return (jnp.zeros((bloc, chunk, hid), pdt),
+                    jnp.zeros((bloc, chunk), jnp.float32))
+
+        def decode_hop(ring, aux, m_recv, ok_recv):
+            """Reconstruct the received activation chunk from the ring
+            carry (dequantize + residual predictor add), updating the
+            receiver-side predictor closed-loop."""
+            if mode == "none":
+                return ring, aux
+            payload, scale = ring
+            dec = dequantize(payload, scale, jnp.float32)
+            if mode == "int8_residual":
+                pred = lax.dynamic_index_in_dim(
+                    aux["recv_pred"], m_recv, axis=0, keepdims=False)
+                dec = pred + dec
+                aux = dict(aux)
+                aux["recv_pred"] = _buf_update(
+                    aux["recv_pred"], dec, m_recv, ok_recv)
+            return dec.astype(compute_dtype), aux
+
+        def encode_hop(payload, aux, m_my, ok_my):
+            """Quantize the outgoing chunk (delta-coded for the residual
+            mode, with the sender predictor advanced to the same
+            reconstruction the receiver will compute)."""
+            if mode == "none":
+                return payload, aux
+            src = payload.astype(jnp.float32)
+            if mode == "int8_residual":
+                pred = lax.dynamic_index_in_dim(
+                    aux["send_pred"], m_my, axis=0, keepdims=False)
+                q, s = quantize(src - pred, mode)
+                recon = pred + dequantize(q, s, jnp.float32)
+                aux = dict(aux)
+                aux["send_pred"] = _buf_update(
+                    aux["send_pred"], recon, m_my, ok_my)
+            else:
+                q, s = quantize(src, mode)
+            return (q, s), aux
+
+        def ring_permute(payload):
+            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            return jax.tree.map(
+                lambda l: lax.ppermute(l, SP_AXIS, perm), payload)
+
         # ---------------- phase 1: synchronous mega-patch warmup ----------
         def warmup_tick(carry, tau):
-            x_full, sstate, kv_cache, ring = carry
+            x_full, sstate, kv_cache, aux, ring = carry
             active = tau % n_stage
             s = tau // n_stage  # step being fed through the pipeline
 
@@ -298,23 +435,52 @@ class PipeFusionRunner:
                 blocks_local, cap_kv_local, kv_cache, h_in, c6, 0, valid,
                 cap_bias,
             )
+            if use_sc:
+                # every warmup pass is a full run: refresh this stage's
+                # per-patch deep delta so the first post-warmup step may
+                # already be shallow (shallow-first cadence)
+                aux = dict(aux)
+                aux["delta"] = jnp.where(
+                    valid, split_patches((h_out - h_in).astype(compute_dtype)),
+                    aux["delta"])
 
             eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_c])
             pad = jnp.zeros((bloc, n_tok, hid - d_out), eps_out.dtype)
             payload = jnp.where(
                 is_last, jnp.concatenate([eps_out, pad], axis=-1), h_out
             )
+            if mode == "int8_residual":
+                # warmup hops are exact (never compressed); both coder ends
+                # seed their predictors from the SAME raw values, so the
+                # first steady-state delta is coded against a shared,
+                # consistent reference
+                aux = dict(aux)
+                aux["send_pred"] = jnp.where(
+                    valid, split_patches(payload.astype(jnp.float32)),
+                    aux["send_pred"])
+                consumed = (valid & ~is_first) | do_recv
+                aux["recv_pred"] = jnp.where(
+                    consumed, split_patches(ring.astype(jnp.float32)),
+                    aux["recv_pred"])
             ring = lax.ppermute(
                 payload, SP_AXIS,
                 [(i, (i + 1) % n_stage) for i in range(n_stage)],
             )
-            return (x_full, sstate, kv_cache, ring), None
+            return (x_full, sstate, kv_cache, aux, ring), None
 
         # ---------------- phase 2: displaced patch streaming --------------
         n_items = (num_steps - n_sync) * n_patch
 
         def steady_tick(carry, tau):
-            x_full, sstate, kv_cache, ring = carry
+            x_full, sstate, kv_cache, aux, ring = carry
+
+            # what my ring predecessor processed last tick (= what I am
+            # consuming now): item tau - p for stages > 0, item
+            # tau - n_stage (the returning epsilon) for stage 0
+            q_recv = (tau - 1) - ((p_idx - 1) % n_stage)
+            ok_recv = (q_recv >= 0) & (q_recv < n_items)
+            m_recv = jnp.clip(q_recv, 0, n_items - 1) % n_patch
+            h_recv, aux = decode_hop(ring, aux, m_recv, ok_recv)
 
             # stage-0 receive: epsilon chunk of item tau - n_stage
             q_arr = tau - n_stage
@@ -322,7 +488,7 @@ class PipeFusionRunner:
             q_arr_c = jnp.clip(q_arr, 0, n_items - 1)
             s_arr = n_sync + q_arr_c // n_patch
             m_arr = q_arr_c % n_patch
-            eps_chunk = ring[..., :d_out]
+            eps_chunk = h_recv[..., :d_out]
             guided = self._combine_eps(eps_chunk, gs, batch)
             x_full, sstate = sched_patch(
                 x_full, sstate, guided, m_arr, s_arr, is_first & ok_arr
@@ -334,7 +500,7 @@ class PipeFusionRunner:
             m_in = q_in % n_patch
             h0 = embed_chunk(x_full, m_in, s_in)
 
-            h_in = jnp.where(is_first, h0, ring.astype(compute_dtype))
+            h_in = jnp.where(is_first, h0, h_recv.astype(compute_dtype))
 
             # my item this tick
             q_my = tau - p_idx
@@ -343,24 +509,53 @@ class PipeFusionRunner:
             s_my = n_sync + q_my_c // n_patch
             m_my = q_my_c % n_patch
             c6 = c6_all[s_my]
-            h_out, kv_cache = self._run_stage(
-                blocks_local, cap_kv_local, kv_cache, h_in, c6,
-                m_my * chunk, ok_my, cap_bias,
-            )
+
+            def run_blocks(h, kv):
+                return self._run_stage(
+                    blocks_local, cap_kv_local, kv, h, c6,
+                    m_my * chunk, ok_my, cap_bias,
+                )
+
+            if use_sc:
+                # shallow-first cadence over the post-warmup step index:
+                # deep stages take a pass-through branch (carried delta,
+                # untouched KV) on shallow items — a real lax.cond, so the
+                # block FLOPs exist only on the full path
+                shallow_my = (s_my - n_sync) % interval < interval - 1
+
+                def full_branch(ops):
+                    h, kv, delta = ops
+                    h_out, kv = run_blocks(h, kv)
+                    delta = _buf_update(
+                        delta, h_out - h, m_my, ok_my & is_deep)
+                    return h_out, kv, delta
+
+                def shallow_branch(ops):
+                    h, kv, delta = ops
+                    d = lax.dynamic_index_in_dim(
+                        delta, m_my, axis=0, keepdims=False)
+                    return h + d.astype(h.dtype), kv, delta
+
+                aux = dict(aux)
+                h_out, kv_cache, aux["delta"] = lax.cond(
+                    is_deep & shallow_my, shallow_branch, full_branch,
+                    (h_in, kv_cache, aux["delta"]),
+                )
+            else:
+                h_out, kv_cache = run_blocks(h_in, kv_cache)
 
             eps_out = dit_mod.final_layer(params, dcfg, h_out, temb_all[s_my])
             pad = jnp.zeros((bloc, chunk, hid - d_out), eps_out.dtype)
             payload = jnp.where(
                 is_last, jnp.concatenate([eps_out, pad], axis=-1), h_out
             )
-            ring = lax.ppermute(
-                payload, SP_AXIS,
-                [(i, (i + 1) % n_stage) for i in range(n_stage)],
-            )
-            return (x_full, sstate, kv_cache, ring), None
+            payload, aux = encode_hop(payload, aux, m_my, ok_my)
+            ring = ring_permute(payload)
+            return (x_full, sstate, kv_cache, aux, ring), None
 
         return types.SimpleNamespace(
             warmup_tick=warmup_tick, steady_tick=steady_tick,
+            init_aux=init_aux, steady_ring0=steady_ring0,
             n_items=n_items, n_stage=n_stage, is_first=is_first, bloc=bloc,
             chunk=chunk, hid=hid, compute_dtype=compute_dtype,
             l_per=dcfg.depth // n_stage, n_tok=n_tok,
@@ -396,21 +591,20 @@ class PipeFusionRunner:
         x, sstate, kv_cache = self._init_carry(ctx, latents)
 
         ring0 = jnp.zeros((ctx.bloc, ctx.n_tok, ctx.hid), ctx.compute_dtype)
-        carry = (x, sstate, kv_cache, ring0)
+        carry = (x, sstate, kv_cache, ctx.init_aux(), ring0)
         n_warm_ticks = n_sync * ctx.n_stage + 1
         carry, _ = lax.scan(ctx.warmup_tick, carry, jnp.arange(n_warm_ticks))
-        x, sstate, kv_cache, _ = carry
+        x, sstate, kv_cache, aux, _ = carry
 
         if n_sync >= num_steps:
             x_full = lax.psum(jnp.where(ctx.is_first, x, 0.0), SP_AXIS)
             return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
 
-        ring0 = jnp.zeros((ctx.bloc, ctx.chunk, ctx.hid), ctx.compute_dtype)
-        carry = (x, sstate, kv_cache, ring0)
+        carry = (x, sstate, kv_cache, aux, ctx.steady_ring0())
         carry, _ = lax.scan(
             ctx.steady_tick, carry, jnp.arange(ctx.n_items + ctx.n_stage)
         )
-        x, _, _, _ = carry
+        x = carry[0]
 
         x_full = lax.psum(jnp.where(ctx.is_first, x, 0.0), SP_AXIS)
         return dit_mod.unpatchify(dcfg, x_full, dcfg.in_channels)
@@ -426,13 +620,28 @@ class PipeFusionRunner:
         Static arithmetic — no device work: PipeFusion's whole point is that
         weights shrink depth/P-fold and the per-hop wire traffic is one
         [B, N/M, hidden] chunk instead of the displaced-patch O(L) gathers.
+
+        Byte accounting (``*_bytes`` keys, the contract
+        ``pipelines.comm_plan`` consumes): one steady step is exactly
+        ``patches`` ring ticks, each permuting one compressed-or-raw
+        activation chunk between sp neighbors; one warmup (sync) step is
+        ``stages`` ticks of the full-precision mega-patch payload.
+        Shallow (step-cache) steps skip deep-stage COMPUTE and KV commits
+        but the chunk still rides every hop to reach stage 0 for its
+        scheduler update, so shallow wire bytes equal full-step bytes
+        (``step_cache.shallow_per_step_collective_elems`` says so rather
+        than implying a saving that does not exist).  The cfg-axis guidance
+        gather is reported separately (``per_step_cfg_gather_bytes``) and
+        excluded from ``per_step_collective_bytes``, matching the displaced
+        DiT report which also counts only sp-axis traffic.
         """
-        dcfg = self.dcfg
+        cfg, dcfg = self.cfg, self.dcfg
         n_tok = dcfg.num_tokens
         hid = dcfg.hidden_size
         l_per = dcfg.depth // self.stages
+        chunk = n_tok // self.patches
         bloc = batch_size * (
-            2 if (self.cfg.do_classifier_free_guidance and not self.cfg.cfg_split)
+            2 if (cfg.do_classifier_free_guidance and not cfg.cfg_split)
             else 1
         )
         one_block_params = sum(
@@ -444,16 +653,46 @@ class PipeFusionRunner:
             for k, v in self.params.items() if k != "blocks"
             for l in jax.tree.leaves(v)
         )
-        return {
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        ring_active = self.stages > 1  # a 1-stage "ring" is a self-permute
+        hop_bytes = (
+            wire_nbytes((bloc, chunk, hid), itemsize, cfg.comm_compress)
+            if ring_active else 0
+        )
+        warm_hop_bytes = bloc * n_tok * hid * itemsize if ring_active else 0
+        per_step_elems = (self.patches * bloc * chunk * hid
+                          if ring_active else 0)
+        report = {
             "stages": self.stages,
             "patches": self.patches,
             "params_per_device": shared_params + one_block_params * l_per,
             "params_replicated_equiv": shared_params + one_block_params * dcfg.depth,
             "kv_cache_elems_per_device": l_per * 2 * bloc * n_tok * hid,
-            "ring_payload_elems_per_tick": bloc * (n_tok // self.patches) * hid,
+            "ring_payload_elems_per_tick": bloc * chunk * hid,
             "ticks_per_step_steady": self.patches,
             "bubble_ticks": self.stages,
+            # wire bytes, closed form (compression-aware; warmup never
+            # compresses)
+            "comm_compress": cfg.comm_compress,
+            "per_hop_bytes": int(hop_bytes),
+            "warmup_hop_bytes": int(warm_hop_bytes),
+            "per_step_collective_elems": int(per_step_elems),
+            "per_step_collective_bytes": int(self.patches * hop_bytes),
+            "sync_step_collective_bytes": int(self.stages * warm_hop_bytes),
+            "per_step_cfg_gather_bytes": int(
+                self.patches * batch_size * chunk * dcfg.token_out_dim
+                * itemsize
+                if cfg.cfg_split else 0
+            ),
         }
+        if cfg.step_cache_enabled:
+            report["step_cache"] = {
+                "interval": cfg.step_cache_interval,
+                "depth": cfg.step_cache_depth,  # PIPELINE STAGES skipped
+                # hops persist on shallow steps (docstring): bytes equal
+                "shallow_per_step_collective_elems": int(per_step_elems),
+            }
+        return report
 
     # ------------------------------------------------------------------
     # public API
@@ -510,24 +749,24 @@ class PipeFusionRunner:
             ring0 = jnp.zeros((ctx.bloc, ctx.n_tok, ctx.hid),
                               ctx.compute_dtype)
             carry, _ = lax.scan(
-                ctx.warmup_tick, (x, sstate, kv_cache, ring0),
+                ctx.warmup_tick, (x, sstate, kv_cache, ctx.init_aux(), ring0),
                 jnp.arange(n_sync * ctx.n_stage + 1),
             )
-            x, sstate, kv_cache, _ = carry
+            x, sstate, kv_cache, aux, _ = carry
             add_dev = lambda t: jax.tree.map(lambda l: l[None], t)  # noqa: E731
-            return add_dev(x), add_dev(sstate), add_dev(kv_cache)
+            return add_dev(x), add_dev(sstate), add_dev(kv_cache), add_dev(aux)
 
-        def device_steady(params, x, sstate, kv_cache, enc, cap_mask, gs):
-            x, sstate, kv_cache = jax.tree.map(
-                lambda l: l[0], (x, sstate, kv_cache)
+        def device_steady(params, x, sstate, kv_cache, aux, enc, cap_mask,
+                          gs):
+            x, sstate, kv_cache, aux = jax.tree.map(
+                lambda l: l[0], (x, sstate, kv_cache, aux)
             )
             batch = x.shape[0]
             ctx = self._tick_ctx(params, enc, cap_mask, gs, batch, num_steps,
                                  n_sync)
-            ring0 = jnp.zeros((ctx.bloc, ctx.chunk, ctx.hid),
-                              ctx.compute_dtype)
             carry, _ = lax.scan(
-                ctx.steady_tick, (x, sstate, kv_cache, ring0),
+                ctx.steady_tick, (x, sstate, kv_cache, aux,
+                                  ctx.steady_ring0()),
                 jnp.arange(ctx.n_items + ctx.n_stage),
             )
             x = carry[0]
@@ -537,16 +776,16 @@ class PipeFusionRunner:
         warm = jax.jit(lambda p, l, e, m, g: shard_map(
             device_warm, mesh=cfg.mesh,
             in_specs=(param_specs, lat_spec, enc_spec, enc_spec, P()),
-            out_specs=(state_spec, state_spec, state_spec),
+            out_specs=(state_spec, state_spec, state_spec, state_spec),
             check_vma=False,
         )(p, l, e, m, g))
-        steady = jax.jit(lambda p, x, ss, kv, e, m, g: shard_map(
+        steady = jax.jit(lambda p, x, ss, kv, ax, e, m, g: shard_map(
             device_steady, mesh=cfg.mesh,
             in_specs=(param_specs, state_spec, state_spec, state_spec,
-                      enc_spec, enc_spec, P()),
+                      state_spec, enc_spec, enc_spec, P()),
             out_specs=lat_spec,
             check_vma=False,
-        )(p, x, ss, kv, e, m, g), donate_argnums=(1, 2, 3))
+        )(p, x, ss, kv, ax, e, m, g), donate_argnums=(1, 2, 3, 4))
         return warm, steady
 
     def generate(self, latents, enc, guidance_scale=5.0, num_inference_steps=20,
@@ -574,8 +813,10 @@ class PipeFusionRunner:
         cap_mask = jnp.asarray(cap_mask, jnp.float32)
         if self._hybrid_dispatch(num_inference_steps):
             warm, steady = self._ensure_hybrid(num_inference_steps)
-            x, sstate, kv = warm(self.params, latents, enc, cap_mask, gs)
-            return steady(self.params, x, sstate, kv, enc, cap_mask, gs)
+            x, sstate, kv, aux = warm(self.params, latents, enc, cap_mask,
+                                      gs)
+            return steady(self.params, x, sstate, kv, aux, enc, cap_mask,
+                          gs)
         if num_inference_steps not in self._compiled:
             self._compiled[num_inference_steps] = self._build(num_inference_steps)
         return self._compiled[num_inference_steps](

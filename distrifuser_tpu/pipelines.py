@@ -511,11 +511,20 @@ class _GenerationMixin:
                         * sc["shallow_per_step_collective_elems"] // elems
                     )
         if not per_step:
-            # no byte-modeled report for this runner (PipeFusion's ring
-            # micro-pipeline, non-sp early returns): say so rather than
-            # returning a confident-looking zero
-            return {"comm_compress": cfg.comm_compress, "steps": counts,
-                    "bytes_per_step": {}, "total_bytes": None}
+            # Every runner family now carries a byte model — the UNet
+            # per-phase trace, the DiT/MMDiT closed forms (zero for
+            # non-sp groups), and PipeFusionRunner.comm_report's per-hop
+            # arithmetic.  A runner reaching this branch has NO byte
+            # model (tensor parallelism, a custom runner): raise rather
+            # than hand back a confident-looking empty plan a capacity
+            # model would happily multiply by zero.
+            raise ValueError(
+                f"{type(runner).__name__} has no byte-modeled comm "
+                "report (comm_volume_report bytes / comm_report "
+                "per_step_collective_bytes): comm_plan cannot price this "
+                "runner's traffic — add the closed form instead of "
+                "guessing"
+            )
         total = sum(per_step.get(ph, 0) * n for ph, n in counts.items())
         return {
             "comm_compress": cfg.comm_compress,
@@ -548,11 +557,13 @@ class _GenerationMixin:
         validate_weight_mode(mode)
         if mode == cfg.weight_quant:
             return
-        if cfg.parallelism in ("tensor", "pipefusion"):
-            # same guard as DistriConfig.__post_init__: these runners
-            # pre-shard/pre-slice their kernels eagerly, and quantizing
-            # the sharded tree post-hoc would feed QuantizedTensor leaves
-            # into lax paths that never densify them
+        if cfg.parallelism == "tensor":
+            # same guard as DistriConfig.__post_init__: the tensor runner
+            # pre-shards its kernels eagerly, and quantizing the sharded
+            # tree post-hoc would feed QuantizedTensor leaves into lax
+            # paths that never densify them.  (PipeFusion is fine: its
+            # runner holds the full stacked tree and shard_map slices
+            # payload and scale alike at trace time.)
             raise ValueError(
                 f"weight_quant does not apply to parallelism="
                 f"{cfg.parallelism!r} (pre-sharded kernels) — the ladder's "
@@ -616,9 +627,10 @@ class _GenerationMixin:
             raise ValueError(
                 "stepwise fallback does not apply to the PipeFusion patch "
                 "pipeline: PipeFusionRunner has no host-driven stepwise "
-                "loop (parallel/pipefusion.py) — exclude "
-                "RUNG_STEPWISE via ResilienceConfig"
-                "(allow_stepwise_fallback=False) when serving pipefusion"
+                "loop (parallel/pipefusion.py).  The serve ladder never "
+                "picks RUNG_STEPWISE for pipefusion keys — it degrades "
+                "them via the pipeline_off rung (rebuild as displaced "
+                "patch parallelism, serve/resilience.py) instead"
             )
         self.distri_config.use_cuda_graph = not enabled
 

@@ -52,7 +52,15 @@ class ExecKey:
     compiled scan, or the host-driven stepwise loop — same numerics, a
     much smaller program; the resilience layer's degradation ladder
     (serve/resilience.py) switches a failing key to "stepwise" as a
-    policy fallback."""
+    policy fallback.  ``parallelism`` ("patch" | "pipefusion") and
+    ``pipe_patches`` (0 = the builder's default, one patch per stage)
+    are compile-identity fields too: displaced patch parallelism and the
+    PipeFusion depth-sharded tick pipeline are entirely different XLA
+    programs over the same mesh, so one fleet holds a patch-parallel and
+    a pipeline-parallel executor for different resolution buckets
+    simultaneously (`ServeConfig.bucket_parallelism`), and the ladder's
+    ``pipeline_off`` rung rebuilds a failing pipefusion key as the
+    *identical* key a patch bucket would use."""
 
     model_id: str
     scheduler: str
@@ -66,6 +74,8 @@ class ExecKey:
     comm_compress: str = "none"
     weight_quant: str = "none"
     exec_mode: str = "fused"
+    parallelism: str = "patch"
+    pipe_patches: int = 0
 
     def __post_init__(self):
         if self.exec_mode not in ("fused", "stepwise"):
@@ -85,6 +95,27 @@ class ExecKey:
                 f"weight_quant must be one of {WEIGHT_QUANT_MODES}, got "
                 f"{self.weight_quant!r}"
             )
+        if self.parallelism not in ("patch", "pipefusion"):
+            raise ValueError(
+                f"ExecKey.parallelism must be 'patch' or 'pipefusion', "
+                f"got {self.parallelism!r}"
+            )
+        if self.pipe_patches < 0:
+            raise ValueError(
+                f"pipe_patches must be >= 0, got {self.pipe_patches}"
+            )
+        if self.pipe_patches and self.parallelism != "pipefusion":
+            raise ValueError(
+                "pipe_patches is a pipefusion-only field; a patch key "
+                "carrying it would silently alias two different compiled "
+                "programs"
+            )
+        if self.parallelism == "pipefusion" and self.exec_mode != "fused":
+            raise ValueError(
+                "exec_mode='stepwise' does not exist for pipefusion keys "
+                "(no host-driven loop) — the ladder degrades them via "
+                "pipeline_off instead"
+            )
 
     def short(self) -> str:
         # every identity field appears (scheduler included): short() keys
@@ -98,9 +129,11 @@ class ExecKey:
         wq = ("" if self.weight_quant == "none"
               else f":wq-{self.weight_quant}")
         em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
+        pf = ("" if self.parallelism == "patch"
+              else f":pf{self.pipe_patches or ''}")
         return (f"{self.model_id}:{self.scheduler}:{self.height}x"
                 f"{self.width}@{self.steps}st:{g}:{self.mesh_plan}"
-                f"{sc}{cc}{wq}{em}")
+                f"{sc}{cc}{wq}{em}{pf}")
 
 
 class ExecutorCache:

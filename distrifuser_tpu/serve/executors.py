@@ -228,6 +228,35 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
     builder's job; forcing it here could violate the model's depth
     bounds, so it is left alone.)"""
     dcfg = pipeline.distri_config
+    # Parallelization strategy is NOT forcible post-construction (the
+    # runner class is chosen at pipeline build): a builder must construct
+    # from key.parallelism/key.pipe_patches.  The key tracks exactly the
+    # patch-vs-pipefusion distinction (tensor/naive_patch builders under
+    # a "patch" key are the pre-existing legacy contract and stay legal);
+    # crossing THAT line is deterministic for every rebuild of this
+    # (builder, key) pair, so it raises TYPED — when the key was degraded
+    # onto "patch" by the pipeline_off rung and the builder cannot honor
+    # it, the retry loop retracts the rung instead of retrying into the
+    # same wall (and when the key itself requested the impossible
+    # strategy, the retraction no-ops and the build failure surfaces
+    # normally).
+    if (key.parallelism == "pipefusion") != (dcfg.parallelism == "pipefusion"):
+        raise DegradationInapplicableError(
+            f"key wants parallelism={key.parallelism!r} but the builder "
+            f"constructed {dcfg.parallelism!r} — build_pipeline must read "
+            "key.parallelism", rung="pipeline_off")
+    if key.parallelism == "pipefusion" and key.pipe_patches:
+        # ground truth is the RUNNER's effective patch count (a builder
+        # that ignores the field leaves dcfg.pipe_patches=None and the
+        # runner falls back to one patch per stage — comparing the config
+        # field would wave that through under the ':pfN' cache identity)
+        built = getattr(getattr(pipeline, "runner", None), "patches",
+                        dcfg.pipe_patches)
+        if built != key.pipe_patches:
+            raise DegradationInapplicableError(
+                f"key wants pipe_patches={key.pipe_patches} but the "
+                f"builder constructed {built} — build_pipeline must read "
+                "key.pipe_patches", rung="pipeline_off")
     if (key.step_cache_interval == 1
             and (dcfg.step_cache_interval, dcfg.step_cache_depth) != (1, 0)):
         dcfg.step_cache_interval = 1

@@ -52,6 +52,7 @@ from .errors import (
 RUNG_SPLIT = "split_batch"
 RUNG_STAGING_OFF = "staging_off"
 RUNG_STEP_CACHE_OFF = "step_cache_off"
+RUNG_PIPELINE_OFF = "pipeline_off"
 RUNG_STEPWISE = "stepwise_fallback"
 RUNG_WEIGHT_QUANT = "weight_quant_on"
 RUNG_BUCKET = "bucket_fallback"
@@ -359,16 +360,24 @@ class DegradationLadder:
     3. `step_cache_off`: recompile without the temporal step-cache
        cadence (its deep-feature carry is HBM the fused program can live
        without);
-    4. `stepwise_fallback`: swap the fused scan for the host-driven
+    4. `pipeline_off` (pipefusion keys only; `allow_pipeline_off`):
+       rebuild the key as displaced patch parallelism
+       (parallelism="patch", pipe_patches dropped) — the degraded key is
+       EXACTLY the key a patch-parallel bucket uses, so the rebuild is
+       bit-identical to a fresh patch executor and shares its cache
+       entry.  This is the pipefusion analog of `stepwise_fallback`
+       (which never applies to pipefusion keys — there is no host-driven
+       stepwise loop to fall back to);
+    5. `stepwise_fallback`: swap the fused scan for the host-driven
        stepwise loop — the compat-shim fallback reused as a policy: same
        numerics, a much smaller program to compile and hold;
-    5. `weight_quant_on` (off by default — the first rung whose outputs
+    6. `weight_quant_on` (off by default — the first rung whose outputs
        CHANGE, within the pinned parity tolerances): rebuild the key with
        int8 quantized weights (ExecKey.weight_quant="int8",
        executors.apply_key_policy quantizes the built tree) — roughly
        halves the executor's weight HBM, the biggest single give-back,
        while keeping the resolution contract bucket_fallback would break;
-    6. `bucket_fallback` (off by default — it changes the output
+    7. `bucket_fallback` (off by default — it changes the output
        resolution contract): serve the request at the next smaller
        configured bucket.
 
@@ -376,8 +385,8 @@ class DegradationLadder:
     the key that should actually execute (``staging_off`` is a dispatch-
     mode rung: it leaves the key unchanged)."""
 
-    KEY_RUNGS = (RUNG_STAGING_OFF, RUNG_STEP_CACHE_OFF, RUNG_STEPWISE,
-                 RUNG_WEIGHT_QUANT, RUNG_BUCKET)
+    KEY_RUNGS = (RUNG_STAGING_OFF, RUNG_STEP_CACHE_OFF, RUNG_PIPELINE_OFF,
+                 RUNG_STEPWISE, RUNG_WEIGHT_QUANT, RUNG_BUCKET)
 
     def __init__(self, config: ResilienceConfig,
                  buckets: Sequence[Tuple[int, int]] = (),
@@ -403,8 +412,15 @@ class DegradationLadder:
             return self.staging and cfg.allow_staging_off
         if rung == RUNG_STEP_CACHE_OFF:
             return cfg.allow_step_cache_off and key.step_cache_interval > 1
+        if rung == RUNG_PIPELINE_OFF:
+            return (cfg.allow_pipeline_off
+                    and key.parallelism == "pipefusion")
         if rung == RUNG_STEPWISE:
-            return cfg.allow_stepwise_fallback and key.exec_mode == "fused"
+            # never for pipefusion keys: no host-driven stepwise loop
+            # exists there — pipeline_off is their program-level rung
+            return (cfg.allow_stepwise_fallback
+                    and key.exec_mode == "fused"
+                    and key.parallelism != "pipefusion")
         if rung == RUNG_WEIGHT_QUANT:
             return cfg.allow_weight_quant_on and key.weight_quant == "none"
         if rung == RUNG_BUCKET:
@@ -434,6 +450,11 @@ class DegradationLadder:
             if rung == RUNG_STEP_CACHE_OFF:
                 key = dataclasses.replace(
                     key, step_cache_interval=1, step_cache_depth=0)
+            elif rung == RUNG_PIPELINE_OFF:
+                # the degraded key IS the patch bucket's key: the rebuild
+                # shares its cache entry bit-for-bit
+                key = dataclasses.replace(
+                    key, parallelism="patch", pipe_patches=0)
             elif rung == RUNG_STEPWISE:
                 key = dataclasses.replace(key, exec_mode="stepwise")
             elif rung == RUNG_WEIGHT_QUANT:

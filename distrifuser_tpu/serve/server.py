@@ -256,6 +256,15 @@ class InferenceServer:
         return keys
 
     def _exec_key_for(self, h: int, w: int, steps: int, cfg: bool) -> ExecKey:
+        # per-bucket strategy map (ServeConfig.bucket_parallelism, keyed
+        # by post-snap bucket): lets one fleet hold patch-parallel and
+        # pipeline-parallel executors for different resolution buckets
+        # simultaneously — PipeFusion wins at high resolution / deep
+        # meshes, displaced patches below the crossover (docs/PERF.md)
+        parallelism = self.config.bucket_parallelism.get(
+            (h, w), self.config.parallelism)
+        pipe_patches = (int(self.config.pipe_patches or 0)
+                        if parallelism == "pipefusion" else 0)
         return ExecKey(
             model_id=self.model_id,
             scheduler=self.scheduler,
@@ -268,6 +277,8 @@ class InferenceServer:
             step_cache_depth=self.config.step_cache_depth,
             comm_compress=self.config.comm_compress,
             weight_quant=self.config.weight_quant,
+            parallelism=parallelism,
+            pipe_patches=pipe_patches,
         )
 
     def _batch_cap_for(self, key: BatchKey) -> Optional[int]:

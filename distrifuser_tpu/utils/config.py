@@ -144,7 +144,11 @@ class DistriConfig:
     # near-identical, so the residual's dynamic range and hence the error
     # is far smaller).  Warmup/sync exchanges always stay full-precision;
     # GroupNorm moment exchanges never compress (tiny, cancellation-
-    # sensitive).  Composes with comm_batch and the step cache.
+    # sensitive).  Composes with comm_batch and the step cache.  Under
+    # parallelism="pipefusion" the same knob compresses the inter-stage
+    # activation ring hops instead (parallel/pipefusion.py; the residual
+    # mode delta-codes against the previous step's chunk for the same
+    # (patch, stage) pair); warmup mega-patch hops never compress.
     comm_compress: str = "none"
     # Quantized-weight serving (parallel/compress.py QuantizedTensor;
     # models/weights.py quantize_params): hold the DENOISER's matmul/conv
@@ -154,8 +158,11 @@ class DistriConfig:
     # residency and weight streaming drop to ~1 byte/element.  "none"
     # (default) is bit-identical to today.  Norm/bias/embedding leaves
     # never quantize.  Composes with the step cache, comm_compress,
-    # comm_batch, and the fused/stepwise loops; tensor parallelism and
-    # PipeFusion pre-shard/pre-slice their kernels eagerly and reject the
+    # comm_batch, and the fused/stepwise loops.  PipeFusion quantizes its
+    # stacked block tree BEFORE the depth split (the per-tile scales keep
+    # the depth-leading layout, so shard_map slices payload and scale
+    # alike and the stage-local payloads never densify); tensor
+    # parallelism pre-shards its kernels eagerly and still rejects the
     # knob loudly.
     weight_quant: str = "none"
     # Same knob for the AUXILIARY models (CLIP/T5 text encoders + VAE):
@@ -187,6 +194,12 @@ class DistriConfig:
     # cadence run different XLA programs (serve keys them separately).
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    # PipeFusion only (parallelism="pipefusion"): how many token-chunks
+    # ("patches") stream through the pipeline stages.  None = one per
+    # stage (the minimum); more patches shrink the per-hop payload and
+    # deepen the overlap at the cost of more in-flight scheduler state.
+    # Part of the compiled program's identity (serve ExecKey.pipe_patches).
+    pipe_patches: Optional[int] = None
 
     # --- TPU-specific ---
     devices: Optional[Sequence[Any]] = None  # explicit device list (tests)
@@ -236,31 +249,33 @@ class DistriConfig:
         from ..parallel.compress import validate_mode, validate_weight_mode
 
         validate_mode(self.comm_compress)
-        if self.comm_compress != "none" and self.parallelism != "patch":
+        if (self.comm_compress != "none"
+                and self.parallelism not in ("patch", "pipefusion")):
             raise ValueError(
                 "comm_compress targets the displaced-patch refresh "
-                f"exchanges (parallelism='patch'); {self.parallelism!r} has "
+                "exchanges (parallelism='patch') or the PipeFusion "
+                f"inter-stage activation hops; {self.parallelism!r} has "
                 "no stale refresh traffic to compress"
             )
         validate_weight_mode(self.weight_quant)
         validate_weight_mode(self.weight_quant_aux)
-        if (self.weight_quant != "none"
-                and self.parallelism in ("tensor", "pipefusion")):
+        if self.weight_quant != "none" and self.parallelism == "tensor":
             raise ValueError(
-                "weight_quant quantizes the replicated denoiser kernels "
-                "(parallelism='patch'/'naive_patch'); "
-                f"{self.parallelism!r} pre-shards or pre-slices its param "
-                "tree eagerly and would silently densify the payloads — "
-                "keep weight_quant='none' there"
+                "weight_quant quantizes whole kernels ahead of the mesh "
+                "split; parallelism='tensor' pre-shards its param tree "
+                "eagerly and would silently densify the payloads — keep "
+                "weight_quant='none' there (PipeFusion quantizes the "
+                "stacked block tree before the depth split and is fine)"
             )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
         if self.step_cache_enabled:
-            if self.parallelism != "patch":
+            if self.parallelism not in ("patch", "pipefusion"):
                 raise ValueError(
                     "step-cache rides the displaced-patch carry state "
-                    f"(parallelism='patch'); {self.parallelism!r} has no "
-                    "cross-step activation carry to stash the deep cache in"
+                    "(parallelism='patch') or the PipeFusion per-stage "
+                    f"delta carry; {self.parallelism!r} has no cross-step "
+                    "activation carry to stash the deep cache in"
                 )
             if self.hybrid_loop:
                 raise ValueError(
@@ -268,6 +283,17 @@ class DistriConfig:
                     "cadence adds a second (shallow) body to the steady-state "
                     "scan, defeating hybrid's one-body compile-time rationale "
                     "— use the fully fused loop with the step cache"
+                )
+        if self.pipe_patches is not None:
+            if self.parallelism != "pipefusion":
+                raise ValueError(
+                    "pipe_patches configures the PipeFusion patch stream "
+                    f"(parallelism='pipefusion'); {self.parallelism!r} has "
+                    "no pipeline to stream patches through"
+                )
+            if self.pipe_patches < 1:
+                raise ValueError(
+                    f"pipe_patches must be >= 1, got {self.pipe_patches}"
                 )
 
         if self.devices is None:
@@ -499,6 +525,19 @@ class ResilienceConfig:
     # and the rung changes neither the program nor the numerics
     allow_staging_off: bool = True
     allow_step_cache_off: bool = True
+    # PipeFusion keys only (ExecKey.parallelism="pipefusion"): on OOM or
+    # compile failure, rebuild the key as displaced patch parallelism
+    # (parallelism="patch", pipe_patches dropped) — the degraded key is
+    # EXACTLY the key a patch-parallel bucket would use, so the rebuild is
+    # bit-identical to a fresh patch executor for the same bucket.  This
+    # replaces stepwise_fallback for pipefusion keys (the fused tick
+    # schedule has no host-driven stepwise loop to fall back to; the
+    # stepwise rung never applies to them).  ON by default: the
+    # alternative for a failing pipefusion key is no program-level rung at
+    # all.  Outputs change only as much as the two parallelization
+    # strategies differ (both are tolerance-pinned against the same
+    # oracles).
+    allow_pipeline_off: bool = True
     allow_stepwise_fallback: bool = True
     # OOM/compile ladder rung below stepwise: rebuild the key with int8
     # quantized weights (ExecKey.weight_quant="int8") — roughly halves the
@@ -625,6 +664,26 @@ class ServeConfig:
     # The aux-model sub-knob (weight_quant_aux) stays a builder decision:
     # it is fixed per builder, so it needs no per-key identity.
     weight_quant: str = "none"
+    # Service-wide parallelization strategy (DistriConfig.parallelism
+    # semantics, "patch" or "pipefusion"): threaded into every ExecKey —
+    # patch-parallel and pipeline-parallel executors are different XLA
+    # programs coexisting in one fleet under distinct keys.  The builder
+    # behind executor_factory must construct its DistriConfig from
+    # key.parallelism (serve.executors.apply_key_policy rejects a
+    # mismatch with a typed error so the ladder can retract).
+    parallelism: str = "patch"
+    # With parallelism="pipefusion": DistriConfig.pipe_patches for the
+    # built pipelines (None = one patch per stage), a compile-identity
+    # field on ExecKey like the cadence knobs.
+    pipe_patches: Optional[int] = None
+    # Per-resolution-bucket strategy overrides: {(height, width):
+    # "patch" | "pipefusion"} keyed by BUCKET (post-snap) resolution.
+    # PipeFusion wins at high resolution and deep meshes (docs/PERF.md
+    # "When pipeline beats displaced patches"); the map lets one fleet
+    # serve small buckets patch-parallel and big buckets
+    # pipeline-parallel simultaneously.  Buckets absent from the map use
+    # the service-wide ``parallelism``.
+    bucket_parallelism: Any = dataclasses.field(default_factory=dict)
     # Staged pipelining (serve/staging.py, docs/SERVING.md "Staged
     # pipelining"): overlap text-encode, denoise, and VAE-decode across
     # micro-batches so batch k+1 encodes and batch k-1 decodes in the
@@ -675,6 +734,25 @@ class ServeConfig:
 
         validate_mode(self.comm_compress)
         validate_weight_mode(self.weight_quant)
+        _SERVE_PARALLELISMS = ("patch", "pipefusion")
+        if self.parallelism not in _SERVE_PARALLELISMS:
+            raise ValueError(
+                f"ServeConfig.parallelism must be one of "
+                f"{_SERVE_PARALLELISMS}, got {self.parallelism!r}"
+            )
+        if self.pipe_patches is not None and int(self.pipe_patches) < 1:
+            raise ValueError(
+                f"pipe_patches must be >= 1, got {self.pipe_patches}"
+            )
+        norm_bp = {}
+        for hw, strat in dict(self.bucket_parallelism or {}).items():
+            if strat not in _SERVE_PARALLELISMS:
+                raise ValueError(
+                    f"bucket_parallelism[{tuple(hw)}] must be one of "
+                    f"{_SERVE_PARALLELISMS}, got {strat!r}"
+                )
+            norm_bp[(int(hw[0]), int(hw[1]))] = strat
+        self.bucket_parallelism = norm_bp
         # BucketTable owns bucket validation and the area-major ordering
         # invariant ("smallest covering bucket" scans front-to-back) — one
         # normalization, not a copy here that could drift.  Lazy import:
@@ -682,6 +760,13 @@ class ServeConfig:
         from ..serve.batcher import BucketTable
 
         self.buckets = BucketTable(self.buckets).buckets
+        for hw in self.bucket_parallelism:
+            if hw not in self.buckets:
+                raise ValueError(
+                    f"bucket_parallelism key {hw} is not a configured "
+                    f"bucket (buckets: {tuple(self.buckets)}) — the map is "
+                    "keyed by post-snap bucket resolution"
+                )
         warm = []
         for b in self.warmup_buckets:
             if len(b) not in (2, 3):

@@ -1,0 +1,210 @@
+"""Patch-vs-PipeFusion micro-bench: steps/sec + per-hop wire bytes.
+
+Tiny-config CPU-runnable probe of ROADMAP item 2 (PipeFusion as a
+first-class execution mode): build the SAME (steps, resolution) tiny-DiT
+config twice — displaced patch parallelism (parallel/dit_sp.py, the
+reference method) and the PipeFusion patch pipeline
+(parallel/pipefusion.py) — and report, as ONE JSON line:
+
+* ``steps_per_s`` for both runners and their ratio (on the CPU mesh this
+  mostly shows dispatch/compile structure — the latency win needs real
+  ICI — the byte columns are the numbers the mode exists for);
+* the closed-form per-step wire bytes of each layout
+  (``comm_report``): the displaced DiT refreshes O(depth) KV slabs per
+  step, the pipeline moves ``patches`` activation-chunk hops — one
+  ``[B, N/M, hidden]`` payload per tick, depth-independent;
+* the compressed-vs-none hop byte ratio per requested ``comm_compress``
+  mode (the PR-4 machinery lifted onto the inter-stage hops).
+
+Gates (exit 1 on failure):
+
+* **byte gate**: pipeline per-step hop bytes <= 1/1.5 of the displaced
+  patch stale-refresh bytes at the same config (the ISSUE-7 acceptance
+  floor; the closed forms give ~2*depth x in practice);
+* **accounting identity**: ``pipelines.comm_plan`` prices the pipefusion
+  stale phase with EXACTLY the runner's closed-form
+  ``per_step_collective_bytes`` — the byte model has one home.
+
+Timing discipline matches bench_compress.py: compile outside the timed
+window, every repeat ends in a `jax.device_get` data dependency.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_pipefusion.py \
+        [--steps 8] [--devices 2] [--depth 4] \
+        [--modes none,int8,int8_residual] [--repeats 2] [--out FILE]
+
+The tier-1 workflow runs this and uploads the line as an artifact, next
+to bench_compress / bench_weights.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--devices", type=int, default=2,
+                    help="pipeline stages / sp-axis width (cfg off)")
+    ap.add_argument("--depth", type=int, default=4,
+                    help="tiny-DiT depth (must divide into --devices stages)")
+    ap.add_argument("--warmup_steps", type=int, default=1)
+    ap.add_argument("--pipe_patches", type=int, default=None)
+    ap.add_argument("--modes", type=str, default="none,int8,int8_residual")
+    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--byte_gate", type=float, default=1.5,
+                    help="required patch-refresh / pipeline-hop byte ratio")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also append the JSON line to this file")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(8, args.devices)}"
+            ).strip()
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models import dit as dit_mod
+    from distrifuser_tpu.parallel.compress import fp8_supported
+    from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+    from distrifuser_tpu.parallel.pipefusion import PipeFusionRunner
+    from distrifuser_tpu.schedulers import get_scheduler
+
+    modes = [m for m in args.modes.split(",") if m]
+    if not fp8_supported() and "fp8" in modes:
+        modes.remove("fp8")
+    if "none" not in modes:
+        modes.insert(0, "none")
+
+    dcfg = dit_mod.tiny_dit_config(depth=args.depth)
+    params = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+    common = dict(
+        devices=None, height=dcfg.sample_size * 8,
+        width=dcfg.sample_size * 8, warmup_steps=args.warmup_steps,
+        do_classifier_free_guidance=False, split_batch=False,
+        dtype=jnp.float32,
+    )
+    common["devices"] = jax.devices()[: args.devices]
+
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (1, dcfg.sample_size, dcfg.sample_size, dcfg.in_channels),
+        jnp.float32,
+    )
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 8, dcfg.caption_dim), jnp.float32
+    )
+
+    def timed(runner):
+        gen = lambda: jax.device_get(  # noqa: E731 — data dep ends the clock
+            runner.generate(lat, enc, guidance_scale=1.0,
+                            num_inference_steps=args.steps)
+        )
+        gen()  # compile outside the timed window
+        best = min(
+            (lambda t0: (gen(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            )
+            for _ in range(args.repeats)
+        )
+        return round(args.steps / best, 3)
+
+    patch_cfg = DistriConfig(parallelism="patch", **common)
+    patch = DiTDenoiseRunner(patch_cfg, dcfg, params, get_scheduler("ddim"))
+    patch_rep = patch.comm_report()
+    patch_sps = timed(patch)
+
+    per_mode = {}
+    pipe_sps = None
+    for mode in modes:
+        cfg = DistriConfig(parallelism="pipefusion", comm_compress=mode,
+                           pipe_patches=args.pipe_patches, **common)
+        runner = PipeFusionRunner(cfg, dcfg, params, get_scheduler("ddim"))
+        rep = runner.comm_report()
+        rec = {
+            "per_hop_bytes": rep["per_hop_bytes"],
+            "per_step_bytes": rep["per_step_collective_bytes"],
+            "sync_step_bytes": rep["sync_step_collective_bytes"],
+        }
+        if mode == "none":
+            pipe_sps = timed(runner)  # time the uncompressed pipeline once
+            rec["steps_per_s"] = pipe_sps
+        per_mode[mode] = rec
+
+    # accounting identity: the pipeline-level comm_plan must price the
+    # pipefusion stale phase with the runner's closed form, to the byte
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriPixArtPipeline
+
+    plan_cfg = DistriConfig(parallelism="pipefusion",
+                            comm_compress=modes[-1],
+                            pipe_patches=args.pipe_patches, **common)
+    vcfg = tiny_vae_config()
+    pixart = DistriPixArtPipeline.from_params(
+        plan_cfg, dcfg, params, vcfg,
+        init_vae_params(jax.random.PRNGKey(1), vcfg),
+    )
+    plan = pixart.comm_plan(args.steps)
+    closed = pixart.runner.comm_report()
+    plan_matches = (
+        plan["bytes_per_step"].get("stale")
+        == closed["per_step_collective_bytes"]
+        and plan["bytes_per_step"].get("sync")
+        == closed["sync_step_collective_bytes"]
+    )
+
+    patch_stale = patch_rep["per_step_collective_bytes"]
+    pipe_stale = per_mode["none"]["per_step_bytes"]
+    byte_ratio = round(patch_stale / pipe_stale, 3) if pipe_stale else None
+    for mode, rec in per_mode.items():
+        if mode != "none" and per_mode["none"]["per_hop_bytes"]:
+            rec["hop_byte_reduction"] = round(
+                per_mode["none"]["per_hop_bytes"] / rec["per_hop_bytes"], 3
+            )
+
+    line = {
+        "bench": "pipefusion",
+        "backend": jax.default_backend(),
+        "steps": args.steps,
+        "devices": args.devices,
+        "depth": args.depth,
+        "warmup_steps": args.warmup_steps,
+        "pipe_patches": args.pipe_patches or args.devices,
+        "patch": {
+            "per_step_bytes": patch_stale,
+            "sync_step_bytes": patch_rep["sync_step_collective_bytes"],
+            "steps_per_s": patch_sps,
+        },
+        "pipefusion": per_mode,
+        "steps_per_s_ratio": (round(pipe_sps / patch_sps, 3)
+                              if patch_sps else None),
+        "stale_byte_ratio_patch_over_pipe": byte_ratio,
+        "comm_plan_matches_closed_form": bool(plan_matches),
+        "byte_gate": args.byte_gate,
+    }
+    ok = bool(plan_matches and byte_ratio is not None
+              and byte_ratio >= args.byte_gate)
+    line["ok"] = ok
+    print(json.dumps(line), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

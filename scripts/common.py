@@ -58,6 +58,10 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         choices=["patch", "tensor", "naive_patch", "pipefusion"],
                         help="pipefusion applies to the DiT family only "
                         "(dit_example.py)")
+    parser.add_argument("--pipe_patches", type=int, default=None,
+                        help="with --parallelism pipefusion: token-chunks "
+                        "in flight through the stage ring (>= stages; "
+                        "default: one per stage)")
     parser.add_argument("--no_cuda_graph", action="store_true",
                         help="parity alias: disable the fused compiled loop")
     parser.add_argument("--split_scheme", type=str, default="row",
@@ -136,6 +140,7 @@ def config_from_args(args) -> DistriConfig:
         mode=args.sync_mode,
         use_cuda_graph=not args.no_cuda_graph,
         parallelism=args.parallelism,
+        pipe_patches=getattr(args, "pipe_patches", None),
         split_scheme=args.split_scheme,
         batch_size=args.batch_size,
         dp_degree=args.dp_degree,

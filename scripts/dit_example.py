@@ -22,10 +22,7 @@ from common import add_distri_args, config_from_args, is_main_process
 
 def main():
     parser = argparse.ArgumentParser()
-    add_distri_args(parser)
-    parser.add_argument("--pipe_patches", type=int, default=None,
-                        help="token-chunks in flight (>= pipeline stages; "
-                        "default: one per stage)")
+    add_distri_args(parser)  # includes --parallelism / --pipe_patches
     parser.add_argument("--depth", type=int, default=None,
                         help="override DiT depth (must divide into stages)")
     parser.add_argument("--model", type=str, default=None,

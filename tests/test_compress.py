@@ -500,6 +500,28 @@ def test_apply_key_policy_forces_compress_off():
     assert dcfg.comm_compress == "none"
 
 
+def test_comm_plan_raises_without_byte_model():
+    """A runner with no byte-modeled comm report must make comm_plan
+    RAISE, not hand back a confident-looking empty plan (the PipeFusion
+    carve-out used to return total_bytes=None silently; every first-party
+    runner now carries a byte model, so reaching the fallback is a bug in
+    the runner, not a condition to paper over)."""
+    import types
+
+    from distrifuser_tpu.pipelines import _GenerationMixin
+
+    class Shell(_GenerationMixin):
+        def __init__(self):
+            self.distri_config = types.SimpleNamespace(
+                comm_compress="none", warmup_steps=1,
+                step_cache_interval=1, step_cache_depth=0,
+                step_cache_enabled=False)
+            self.runner = object()  # neither comm_volume_report nor comm_report
+
+    with pytest.raises(ValueError, match="byte-model"):
+        Shell().comm_plan(4)
+
+
 def test_pipeline_comm_plan(devices8):
     from test_pipelines import build_sd_pipeline
 

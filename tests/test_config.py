@@ -86,3 +86,24 @@ def test_axon_backend_classifies_as_tpu(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "axon")
     cfg = DistriConfig(devices=jax.devices()[:1], use_cuda_graph=False)
     assert cfg.dtype == jnp.bfloat16
+
+
+def test_pipefusion_accepts_first_class_knobs(devices8):
+    """PR 7 (ROADMAP item 2): the knobs DistriConfig used to reject for
+    parallelism='pipefusion' — comm_compress, weight_quant, the step
+    cache, and the new pipe_patches — all construct; the step cache still
+    pairs its knobs, and weight_quant still rejects tensor parallelism."""
+    cfg = DistriConfig(
+        devices=devices8[:2], height=128, width=128,
+        parallelism="pipefusion", comm_compress="int8_residual",
+        step_cache_interval=2, step_cache_depth=1, weight_quant="int8",
+        pipe_patches=4, use_cuda_graph=True,
+    )
+    assert cfg.step_cache_enabled and cfg.pipe_patches == 4
+
+
+def test_pipe_patches_validation(devices8):
+    with pytest.raises(ValueError, match="pipe_patches"):
+        make_config(devices8[:2], pipe_patches=2)  # patch parallelism
+    with pytest.raises(ValueError, match="pipe_patches"):
+        make_config(devices8[:2], parallelism="pipefusion", pipe_patches=0)

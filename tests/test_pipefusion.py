@@ -377,6 +377,204 @@ def test_hybrid_matches_fused(sched):
     np.testing.assert_allclose(a, b, atol=2e-4)
 
 
+# ---------------------------------------------------------------------------
+# first-class knob composition (PR 7, ROADMAP item 2): step cache, wire
+# compression, quantized weights, and the serve-side pipeline_off rung
+# ---------------------------------------------------------------------------
+
+
+def knob_config(n_dev=2, **kw):
+    """2-stage default (the cheapest real pipeline on the CPU runner)."""
+    kw.setdefault("warmup_steps", 1)
+    return DistriConfig(
+        devices=jax.devices()[:n_dev], height=128, width=128,
+        do_classifier_free_guidance=False, split_batch=False,
+        parallelism="pipefusion", **kw,
+    )
+
+
+def knob_generate(dcfg, params, steps=6, **kw):
+    runner = PipeFusionRunner(knob_config(**kw), dcfg, params,
+                              get_scheduler("ddim"))
+    lat, enc = make_inputs(dcfg)
+    return np.asarray(
+        runner.generate(lat, enc, guidance_scale=1.0,
+                        num_inference_steps=steps)
+    )
+
+
+def test_step_cache_skips_deep_stages_with_pinned_parity():
+    """interval=2 x depth=1 (depth counts PIPELINE STAGES): the deep
+    stage's pass-through branch must stay within the pinned drift of the
+    cadence-off baseline (measured 1.2e-2 on this seed/config)."""
+    dcfg, params = make_model(depth=4)
+    base = knob_generate(dcfg, params)
+    cached = knob_generate(dcfg, params, step_cache_interval=2,
+                           step_cache_depth=1)
+    assert np.abs(cached - base).max() <= 3e-2
+    assert np.isfinite(cached).all()
+    # depth must leave stage 0 running: >= stages rejects at construction
+    with pytest.raises(ValueError, match="STAGES"):
+        PipeFusionRunner(
+            knob_config(step_cache_interval=2, step_cache_depth=2),
+            dcfg, params, get_scheduler("ddim"),
+        )
+
+
+def test_compressed_hops_parity_pinned():
+    """int8 / closed-loop int8_residual ring hops vs the uncompressed
+    pipeline: pinned tolerances (measured 1.3e-2 / 4e-3), and the
+    residual coder must beat plain int8 — its whole point."""
+    dcfg, params = make_model(depth=4)
+    base = knob_generate(dcfg, params)
+    d_int8 = np.abs(knob_generate(dcfg, params, comm_compress="int8")
+                    - base).max()
+    d_res = np.abs(
+        knob_generate(dcfg, params, comm_compress="int8_residual") - base
+    ).max()
+    assert d_int8 <= 3e-2
+    assert d_res <= 1.2e-2
+    assert d_res < d_int8
+
+
+def test_compressed_warmup_only_bit_identical():
+    """Warmup mega-patch hops never compress: a run that never leaves
+    warmup is bit-identical with every knob on."""
+    dcfg, params = make_model(depth=4)
+    base = knob_generate(dcfg, params, steps=3, warmup_steps=9)
+    knobs = knob_generate(dcfg, params, steps=3, warmup_steps=9,
+                          comm_compress="int8_residual",
+                          step_cache_interval=2, step_cache_depth=1)
+    np.testing.assert_array_equal(base, knobs)
+
+
+def test_weight_quant_stage_local_slices():
+    """int8-quantized stacked block tree through the depth split: the
+    per-(block, out-channel) scales slice along depth exactly like dense
+    leaves, with pinned parity vs the dense pipeline."""
+    from distrifuser_tpu.models.weights import quantize_params
+
+    dcfg, params = make_model(depth=4)
+    base = knob_generate(dcfg, params)
+    quant = knob_generate(dcfg, quantize_params(params, "int8"),
+                          weight_quant="int8")
+    assert np.abs(quant - base).max() <= 6e-2
+    assert np.isfinite(quant).all()
+
+
+def test_all_knobs_acceptance_config():
+    """The ISSUE-7 acceptance point: comm_compress='int8_residual' x
+    step cache (2x1) x weight_quant='int8' constructs and generates on a
+    2-device CPU mesh with pinned parity vs the all-knobs-off baseline."""
+    from distrifuser_tpu.models.weights import quantize_params
+
+    dcfg, params = make_model(depth=4)
+    base = knob_generate(dcfg, params)
+    allk = knob_generate(
+        dcfg, quantize_params(params, "int8"), weight_quant="int8",
+        comm_compress="int8_residual", step_cache_interval=2,
+        step_cache_depth=1,
+    )
+    assert np.abs(allk - base).max() <= 8e-2
+    assert np.isfinite(allk).all()
+
+
+def test_hybrid_composes_with_compression():
+    """The hybrid two-program split must equal the fused loop with the
+    residual coder on — the predictor carries cross the jit boundary."""
+    dcfg, params = make_model(depth=4)
+    fused = knob_generate(dcfg, params, comm_compress="int8_residual")
+    hybrid = knob_generate(dcfg, params, comm_compress="int8_residual",
+                           hybrid_loop=True)
+    np.testing.assert_allclose(fused, hybrid, atol=2e-4)
+
+
+def test_comm_report_closed_form_bytes():
+    """The byte model pipelines.comm_plan consumes: per-hop and per-step
+    arithmetic, compression-aware, warmup always full precision."""
+    dcfg, params = make_model(depth=4)
+    n_tok, hid = dcfg.num_tokens, dcfg.hidden_size
+    raw = PipeFusionRunner(knob_config(), dcfg, params,
+                           get_scheduler("ddim"))
+    rep = raw.comm_report()
+    chunk = n_tok // 2
+    assert rep["per_hop_bytes"] == chunk * hid * 4  # fp32 chunk
+    assert rep["per_step_collective_bytes"] == 2 * rep["per_hop_bytes"]
+    assert rep["sync_step_collective_bytes"] == 2 * n_tok * hid * 4
+    assert rep["per_step_cfg_gather_bytes"] == 0  # no cfg axis here
+    comp = PipeFusionRunner(knob_config(comm_compress="int8"), dcfg,
+                            params, get_scheduler("ddim"))
+    crep = comp.comm_report()
+    assert crep["per_hop_bytes"] == chunk * hid + chunk * 4  # payload+scales
+    # warmup hops never compress: sync bytes identical across modes
+    assert crep["sync_step_collective_bytes"] == rep["sync_step_collective_bytes"]
+    sc = PipeFusionRunner(
+        knob_config(step_cache_interval=2, step_cache_depth=1), dcfg,
+        params, get_scheduler("ddim"),
+    ).comm_report()
+    # hops persist on shallow steps: the report must say bytes are equal,
+    # never imply a wire saving the schedule does not deliver
+    assert (sc["step_cache"]["shallow_per_step_collective_elems"]
+            == sc["per_step_collective_elems"])
+
+
+def test_serve_pipeline_off_rebuilds_bit_identical_to_patch():
+    """End-to-end serve acceptance: a pipefusion bucket OOM-injected at
+    execute falls down the pipeline_off rung and its rebuilt executor is
+    the patch bucket's — images bit-identical to a server that was
+    patch-parallel all along."""
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriPixArtPipeline
+    from distrifuser_tpu.serve import InferenceServer, ServeConfig
+    from distrifuser_tpu.serve.executors import pipeline_executor_factory
+    from distrifuser_tpu.serve.faults import FaultPlan, FaultRule
+    from distrifuser_tpu.utils.config import ResilienceConfig
+
+    dcfg, params = make_model(depth=4)
+    vcfg = tiny_vae_config()
+    vparams = init_vae_params(jax.random.PRNGKey(1), vcfg)
+
+    def build(key):
+        cfg = DistriConfig(
+            devices=jax.devices()[:2], height=key.height, width=key.width,
+            do_classifier_free_guidance=key.cfg, split_batch=False,
+            warmup_steps=1, parallelism=key.parallelism,
+            pipe_patches=key.pipe_patches or None,
+            batch_size=1,
+        )
+        return DistriPixArtPipeline.from_params(cfg, dcfg, params, vcfg,
+                                                vparams)
+
+    def serve_images(parallelism, fault_plan=None):
+        config = ServeConfig(
+            buckets=((128, 128),), default_steps=3, max_batch_size=1,
+            batch_window_s=0.0, parallelism=parallelism,
+            resilience=ResilienceConfig(
+                max_retries=2, backoff_base_s=0.001, backoff_max_s=0.002,
+                backoff_jitter=0.0, watchdog_timeout_s=0.0,
+            ),
+        )
+        server = InferenceServer(
+            pipeline_executor_factory(build), config, model_id="pixart",
+            scheduler="ddim", mesh_plan="dp1.cfg1.sp2",
+            fault_plan=fault_plan,
+        )
+        with server:
+            res = server.submit("a fox", height=128, width=128,
+                                guidance_scale=1.0, seed=3).result(timeout=600)
+            snap = server.metrics_snapshot()
+        return res, snap
+
+    plan = FaultPlan([FaultRule(site="execute", kind="oom", p=1.0,
+                                key_substr=":pf")])
+    degraded, dsnap = serve_images("pipefusion", fault_plan=plan)
+    assert degraded.degradations == ("pipeline_off",)
+    fresh, _ = serve_images("patch")
+    np.testing.assert_array_equal(np.asarray(degraded.output),
+                                  np.asarray(fresh.output))
+    assert dsnap["requests"]["degraded_pipeline_off"] == 1
+
+
 # CPU-compile-heavy module: the fake 8-device mesh compiles full
 # multi-device denoise loops, minutes per test on the tier-1 CPU runner.
 # Runs with `-m slow` and on real-hardware rounds.

@@ -423,6 +423,84 @@ def test_exec_key_stepwise_mode_and_short():
         key_for(exec_mode="warp")
 
 
+def test_exec_key_pipefusion_fields_and_short():
+    """parallelism/pipe_patches are compile-identity fields: distinct
+    short() tags (the per-executor ledgers key on them) and the invalid
+    combinations reject at construction."""
+    k = key_for(parallelism="pipefusion", pipe_patches=8)
+    assert k.short().endswith(":pf8")
+    assert ":pf" not in key_for().short()
+    assert key_for(parallelism="pipefusion").short().endswith(":pf")
+    with pytest.raises(ValueError, match="pipe_patches"):
+        key_for(pipe_patches=4)  # pipefusion-only field on a patch key
+    with pytest.raises(ValueError, match="pipeline_off"):
+        key_for(parallelism="pipefusion", exec_mode="stepwise")
+    with pytest.raises(ValueError, match="parallelism"):
+        key_for(parallelism="tensor")
+
+
+def test_ladder_pipefusion_routes_to_pipeline_off_not_stepwise():
+    """A failing pipefusion key degrades via pipeline_off — rebuilding as
+    EXACTLY the patch bucket's key — never via stepwise (no host-driven
+    loop exists there); once on patch, the normal program rungs resume."""
+    from distrifuser_tpu.serve.resilience import RUNG_PIPELINE_OFF
+
+    st = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    lad = ladder()
+    k = key_for(parallelism="pipefusion", pipe_patches=8)
+    rung = lad.next_rung(st, "oom", k, batch_size=1)
+    assert rung == RUNG_PIPELINE_OFF
+    st.rungs.append(rung)
+    assert lad.apply(k, st.rungs) == key_for()  # the fresh patch key
+    # the degraded key is patch now: stepwise becomes applicable
+    assert lad.next_rung(st, "compile", k, batch_size=1) == RUNG_STEPWISE
+    # rung gated off -> the ladder must NOT detour to stepwise for a
+    # still-pipefusion key; with everything else at defaults it exhausts
+    st2 = KeyResilience(breaker=CircuitBreaker(3, 1.0))
+    assert ladder(allow_pipeline_off=False).next_rung(
+        st2, "oom", k, batch_size=1) is None
+
+
+def test_pipeline_off_ladder_under_oom_isolated_to_its_key():
+    """ISSUE-7 acceptance: a pipefusion bucket that OOMs falls to the
+    patch key via the pipeline_off rung and completes, while an unrelated
+    pipefusion bucket keeps serving pipeline-parallel, untripped."""
+    import dataclasses
+
+    built = []
+
+    class PipeOOMFake(FakeExecutor):
+        def __call__(self, prompts, negatives, gs, seeds):
+            if (self.key.parallelism == "pipefusion"
+                    and self.key.height == 512):
+                raise InjectedResourceExhausted(
+                    "RESOURCE_EXHAUSTED: pipeline stage HBM")
+            return super().__call__(prompts, negatives, gs, seeds)
+
+    def factory(key):
+        built.append(key)
+        return PipeOOMFake(key, batch_size=4)
+
+    cfg = serve_config(parallelism="pipefusion", pipe_patches=4)
+    with InferenceServer(factory, cfg) as server:
+        r1 = server.submit("a", height=512, width=512).result(timeout=30)
+        r2 = server.submit("b", height=1024, width=1024).result(timeout=30)
+        snap = server.metrics_snapshot()
+        health = server.health()
+    assert r1.degradations == ("pipeline_off",)
+    assert r2.degradations == ()
+    keys_512 = [k for k in built if k.height == 512]
+    assert [k.parallelism for k in keys_512] == ["pipefusion", "patch"]
+    # the rebuilt key IS the fresh patch key for the bucket
+    assert keys_512[1] == dataclasses.replace(
+        keys_512[0], parallelism="patch", pipe_patches=0)
+    keys_1024 = [k for k in built if k.height == 1024]
+    assert [k.parallelism for k in keys_1024] == ["pipefusion"]
+    assert snap["requests"]["degraded_pipeline_off"] == 1
+    (tag,) = snap["resilience"]["degradations"].keys()
+    assert tag.endswith(":pf4") and "512" in tag
+
+
 # --------------------------------------------------------------------------
 # cache invalidation + ring log
 # --------------------------------------------------------------------------

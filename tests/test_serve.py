@@ -295,6 +295,34 @@ def test_server_warmup_then_only_hits():
     assert snap["requests"].get("requests_compile_miss", 0) == 0
 
 
+def test_fleet_mixes_patch_and_pipefusion_buckets():
+    """Per-bucket strategy map (ServeConfig.bucket_parallelism): one
+    fleet concurrently holds a patch-parallel and a pipeline-parallel
+    executor for different resolution buckets, under distinct
+    ExecKey.short() tags, and the warmup path builds the mapped keys."""
+    factory = FakeExecutorFactory(batch_size=4)
+    config = serve_config(
+        parallelism="patch", pipe_patches=4,
+        bucket_parallelism={(1024, 1024): "pipefusion"},
+        warmup_buckets=((1024, 1024, 4),),
+    )
+    with InferenceServer(factory, config) as server:
+        # warmup already built the big bucket's PIPEFUSION key
+        assert factory.built[0].parallelism == "pipefusion"
+        assert factory.built[0].pipe_patches == 4
+        r_small = server.submit("s", height=512, width=512).result(timeout=30)
+        r_big = server.submit("b", height=1024, width=1024).result(timeout=30)
+        assert r_big.compile_hit  # the warmup executor served it
+        stats = server.cache.stats()
+    assert r_small.bucket == (512, 512) and r_big.bucket == (1024, 1024)
+    assert len(stats["entries"]) == 2  # both strategies resident at once
+    pf_tags = [t for t in stats["entries"] if ":pf4" in t]
+    assert len(pf_tags) == 1 and "1024x1024" in pf_tags[0]
+    assert all(":pf" not in t for t in stats["entries"] if "512" in t)
+    built = {(k.height, k.parallelism) for k in factory.built}
+    assert built == {(1024, "pipefusion"), (512, "patch")}
+
+
 def test_server_deadline_rejects_queued_request():
     # occupy the single scheduler with a slow batch (4 steps x 0.1s), then
     # queue a request whose deadline lapses while it waits — it must be
