@@ -454,6 +454,78 @@ class _GenerationMixin:
     # the legacy families.  Instance attribute on DistriSD3Pipeline.
     _vae_shift: float = 0.0
 
+    # Per-step denoise timeline (utils/trace.py StepTimeline), attached
+    # via `attach_step_timeline`: None (the default) adds nothing to the
+    # dispatch path.
+    step_timeline = None
+
+    def attach_step_timeline(self, timeline):
+        """Record every generation's per-denoise-step wall timings
+        (tagged warmup/full/shallow by the step-cache cadence) and LIVE
+        comm-byte counters into ``timeline`` (`utils.trace.StepTimeline`).
+
+        The live byte counter adds each *executed* step's per-phase wire
+        bytes from the runner's byte model as the loop advances, so it
+        equals the closed-form `comm_plan` exactly iff the loop really
+        ran the phase sequence the plan predicts — the reconciliation
+        tests/test_observability.py pins.  Timeline-carrying generations
+        run the per-step callback dispatch path (host stepwise loop, or
+        the fused io_callback program where the jaxlib supports it):
+        per-step host visibility is that path's purpose — use for
+        profiling, not steady-state serving."""
+        self.step_timeline = timeline
+        return timeline
+
+    def _timeline_callback(self, num_inference_steps: int, callback,
+                           start_step: int = 0, end_step=None):
+        """Compose the user's per-step callback with the attached
+        timeline's recorder (no-op passthrough when none is attached).
+        Phase tags use the SAME arithmetic as the denoise loops and
+        `stepcache.phase_step_counts`: steps [start, start + n_sync) are
+        warmup, the rest follow the shallow-first cadence."""
+        tl = self.step_timeline
+        if tl is None:
+            return callback
+        from .parallel.stepcache import is_shallow_at
+
+        cfg = self.distri_config
+        steps_end = (num_inference_steps if end_step is None
+                     else min(end_step, num_inference_steps))
+        n_sync = min(cfg.warmup_steps + 1, steps_end - start_step)
+        sc = cfg.step_cache_enabled
+        interval = cfg.step_cache_interval
+
+        def phase_of(i: int) -> str:
+            if i < start_step + n_sync:
+                return "warmup"
+            if sc and is_shallow_at(i, start_step + n_sync, interval):
+                return "shallow"
+            return "full"
+
+        try:
+            plan = self.comm_plan(num_inference_steps)
+            bytes_per_step = plan["bytes_per_step"]
+        except (ValueError, AttributeError):
+            # runner without a byte model (tensor parallelism, custom):
+            # the timeline still records timings, bytes stay untracked
+            bytes_per_step = None
+        tl.begin_run(
+            steps_end - start_step, phase_of, bytes_per_step=bytes_per_step,
+            meta={"steps": num_inference_steps, "start_step": start_step,
+                  "comm_compress": cfg.comm_compress},
+        )
+
+        def cb(i, t, x):
+            tl.on_step(int(i))
+            if callback is not None:
+                callback(i, t, x)
+
+        return cb
+
+    def _timeline_end(self) -> None:
+        if self.step_timeline is not None:
+            self.step_timeline.end_run()
+
     def step_cache_plan(self, num_inference_steps: int) -> dict:
         """How the temporal step-cache cadence (docs/PERF.md) plays out over
         a run of ``num_inference_steps``: the serve executors read this for
@@ -882,11 +954,19 @@ class _DistriPipelineBase(_GenerationMixin):
 
         def run_chunk(cp, cn, cl, n_real):
             enc = self._encode(cp, cn, micro_cond)
-            cb = _wrap_chunk_callback(callback, n_real)
-            return self._denoise_chunk(
-                enc, cl, guidance_scale, num_inference_steps,
-                start_step=start_step, end_step=end_step, callback=cb,
+            # timeline recording brackets the denoise loop only (encode
+            # stays outside the per-step wall timings); one run per chunk
+            cb = self._timeline_callback(
+                num_inference_steps, _wrap_chunk_callback(callback, n_real),
+                start_step=start_step, end_step=end_step,
             )
+            try:
+                return self._denoise_chunk(
+                    enc, cl, guidance_scale, num_inference_steps,
+                    start_step=start_step, end_step=end_step, callback=cb,
+                )
+            finally:
+                self._timeline_end()
 
         # seeded noise for the whole expanded batch (diffusers passes a torch
         # Generator; the JAX analog is the integer seed); caller-supplied
@@ -1377,9 +1457,14 @@ class DistriPixArtPipeline(_GenerationMixin):
 
         def run_chunk(cp, cn, cl, n_real):
             enc = self._encode(cp, cn)
-            cb = _wrap_chunk_callback(callback, n_real)
-            return self._denoise_chunk(
-                enc, cl, guidance_scale, num_inference_steps, callback=cb)
+            cb = self._timeline_callback(
+                num_inference_steps, _wrap_chunk_callback(callback, n_real))
+            try:
+                return self._denoise_chunk(
+                    enc, cl, guidance_scale, num_inference_steps,
+                    callback=cb)
+            finally:
+                self._timeline_end()
 
         latent = _batched_generate(
             cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,
@@ -1701,11 +1786,16 @@ class DistriSD3Pipeline(_GenerationMixin):
 
         def run_chunk(cp, cn, cl, n_real):
             enc = self._encode(cp, cn)
-            cb = _wrap_chunk_callback(callback, n_real)
-            return self._denoise_chunk(
-                enc, cl, guidance_scale, num_inference_steps,
-                start_step=start_step, callback=cb,
-            )
+            cb = self._timeline_callback(
+                num_inference_steps, _wrap_chunk_callback(callback, n_real),
+                start_step=start_step)
+            try:
+                return self._denoise_chunk(
+                    enc, cl, guidance_scale, num_inference_steps,
+                    start_step=start_step, callback=cb,
+                )
+            finally:
+                self._timeline_end()
 
         latent = _batched_generate(
             cfg, self.scheduler, prompts, negs, num_images_per_prompt, seed,

@@ -27,7 +27,14 @@ closed/open-loop load generator and ``scripts/chaos_bench.py`` the same
 load under a fault plan.  Architecture notes: docs/SERVING.md.
 """
 
-from ..utils.config import DEFAULT_BUCKETS, ResilienceConfig, ServeConfig
+from ..utils.config import (
+    DEFAULT_BUCKETS,
+    ObservabilityConfig,
+    ResilienceConfig,
+    ServeConfig,
+)
+from ..utils.metrics import MetricsRegistry
+from ..utils.trace import StepTimeline, Tracer
 from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
 from .errors import (
@@ -88,8 +95,10 @@ __all__ = [
     "FaultPlan",
     "FaultRule",
     "InferenceServer",
+    "MetricsRegistry",
     "MicroBatcher",
     "NoBucketError",
+    "ObservabilityConfig",
     "PipelineExecutor",
     "QueueFullError",
     "Request",
@@ -105,6 +114,8 @@ __all__ = [
     "ServerClosedError",
     "StagePipeline",
     "StagedBatch",
+    "StepTimeline",
+    "Tracer",
     "Watchdog",
     "WatchdogTimeoutError",
     "install_fault_plan",

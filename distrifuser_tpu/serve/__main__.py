@@ -20,12 +20,14 @@ import json
 import sys
 import threading
 
-from ..utils.config import ServeConfig
+from ..utils.config import ObservabilityConfig, ServeConfig
 from .server import InferenceServer
 from .testing import FakeExecutorFactory
 
 
-def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
+def run_demo(metrics_path: str = None, verbose: bool = True,
+             metrics_port: int = None, hold_s: float = 0.0,
+             trace_out: str = None, dump_dir: str = None) -> int:
     config = ServeConfig(
         max_queue_depth=32,
         max_batch_size=4,
@@ -34,6 +36,10 @@ def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
         warmup_buckets=((512, 512, 4),),
         default_steps=4,
         cache_capacity=4,
+        observability=ObservabilityConfig(
+            trace=bool(trace_out or dump_dir),
+            metrics_port=metrics_port,
+        ),
     )
     factory = FakeExecutorFactory(
         batch_size=4, build_delay_s=0.2, step_time_s=0.02
@@ -45,6 +51,9 @@ def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
     )
     say("starting server (warmup compiles the 512x512 bucket)...")
     with server:
+        if server.metrics_endpoint is not None:
+            say(f"metrics endpoint: {server.metrics_endpoint.url}/metrics "
+                f"(+ /metrics.json, /healthz)")
         # two waves of concurrent submissions: wave 1 lands in the warmed
         # 512 bucket; wave 2 mixes in 768x640 requests that snap to the
         # 1024x1024 bucket (its first use = the only other compile)
@@ -86,6 +95,20 @@ def run_demo(metrics_path: str = None, verbose: bool = True) -> int:
         if metrics_path:
             server.export_metrics(metrics_path)
             say(f"\nmetrics JSON written to {metrics_path}")
+        if trace_out:
+            server.tracer.export(trace_out)
+            say(f"Perfetto trace written to {trace_out} "
+                "(load at https://ui.perfetto.dev)")
+        if dump_dir:
+            paths = server.dump_observability(dump_dir)
+            say(f"observability dump: {', '.join(sorted(paths))}")
+        if hold_s > 0:
+            # keep serving /metrics after the demo work finishes so an
+            # external scraper (the CI curl step) can probe a live server
+            say(f"holding {hold_s:.0f}s for metrics scrapes...")
+            import time
+
+            time.sleep(hold_s)
     say("\nmetrics snapshot:")
     say(json.dumps(snap, indent=2, sort_keys=True))
     say("\nhealth snapshot (as served while running):")
@@ -123,12 +146,28 @@ def main(argv=None) -> int:
                     help="run the end-to-end scheduler demo")
     ap.add_argument("--metrics-path", type=str, default=None,
                     help="also write the metrics JSON artifact here")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics (Prometheus), /metrics.json and "
+                         "/healthz on this port while the demo runs "
+                         "(0 = ephemeral; docs/OBSERVABILITY.md)")
+    ap.add_argument("--hold-s", type=float, default=0.0,
+                    help="keep the server (and its metrics endpoint) "
+                         "alive this long after the demo work finishes, "
+                         "so external scrapers can probe it")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="enable request-scoped tracing and write the "
+                         "Perfetto-loadable trace JSON here")
+    ap.add_argument("--dump-dir", type=str, default=None,
+                    help="write the full observability dump (metrics/"
+                         "registry/health/slo/trace) into this directory")
     args = ap.parse_args(argv)
     if not args.demo:
         ap.error("nothing to do: pass --demo (real serving is wired "
                  "through distrifuser_tpu.serve.InferenceServer + "
                  "pipeline_executor_factory; see docs/SERVING.md)")
-    return run_demo(metrics_path=args.metrics_path)
+    return run_demo(metrics_path=args.metrics_path,
+                    metrics_port=args.metrics_port, hold_s=args.hold_s,
+                    trace_out=args.trace_out, dump_dir=args.dump_dir)
 
 
 if __name__ == "__main__":

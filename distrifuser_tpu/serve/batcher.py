@@ -166,6 +166,7 @@ class MicroBatcher:
                 self.on_reject(req, exc)
                 continue
             req.bucket = (key.height, key.width)
+            req.dequeue_ts = self.clock()
             return req, key
 
     def next_batch(
@@ -196,8 +197,10 @@ class MicroBatcher:
             room = cap - len(batch)
             if room > 0:
                 more = self.queue.pop_where(compatible, room)
+                now = self.clock()
                 for m in more:
                     m.bucket = (key.height, key.width)
+                    m.dequeue_ts = now
                 batch.extend(more)
 
         take_followers()

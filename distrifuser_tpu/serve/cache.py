@@ -167,6 +167,11 @@ class ExecutorCache:
         self.build_fn = build_fn
         self.capacity = capacity
         self.on_evict = on_evict
+        # optional utils.trace.Tracer (set by the owning server when
+        # request-scoped tracing is on): hit/miss instants and build
+        # spans land on the "cache" track, so a Perfetto view shows
+        # exactly which dispatch paid a compile.  None = zero overhead.
+        self.tracer = None
         self._entries: "OrderedDict[ExecKey, Any]" = OrderedDict()
         self._lock = threading.Lock()
         # refcounts by executor identity (not key: a key may rebuild while
@@ -232,18 +237,40 @@ class ExecutorCache:
         stats reads never stall behind a multi-second compile.  With
         ``pin=True`` the returned executor carries a refcount the caller
         must drop via ``unpin``."""
+        hit_ex = None
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                ex = self._entries[key]
+                hit_ex = self._entries[key]
                 if pin:
-                    self._pin_locked(ex)
-                return ex, True
-            self.misses += 1
+                    self._pin_locked(hit_ex)
+            else:
+                self.misses += 1
+        if hit_ex is not None:
+            # trace mark OUTSIDE the cache lock: the tracer has its own
+            # lock, and nesting it inside this hot-path critical section
+            # would serialize dispatch against every other tracer user
+            if self.tracer is not None:
+                self.tracer.event("cache_hit", track="cache",
+                                  args={"key": key.short()})
+            return hit_ex, True
+        tracer = self.tracer
+        tt0 = tracer.clock() if tracer is not None else 0.0
         t0 = time.monotonic()
-        ex = self.build_fn(key)
+        try:
+            ex = self.build_fn(key)
+        except BaseException:
+            # failed builds still leave a trace mark: the retry loop's
+            # next attempt shows up as a fresh build span after it
+            if tracer is not None:
+                tracer.event("build_failed", track="cache",
+                             args={"key": key.short()})
+            raise
         dt = time.monotonic() - t0
+        if tracer is not None:
+            tracer.complete("build", tt0, tracer.clock(), track="cache",
+                            args={"key": key.short()})
         evicted: List[Tuple[ExecKey, Any]] = []
         with self._lock:
             self.build_seconds += dt
@@ -286,6 +313,9 @@ class ExecutorCache:
             ex = self._entries.pop(key, None)
             if ex is not None:
                 pair = self._evict_locked(key, ex)
+        if ex is not None and self.tracer is not None:
+            self.tracer.event("invalidate", track="cache",
+                              args={"key": key.short()})
         if pair is not None and self.on_evict:
             self.on_evict(*pair)
         return ex is not None

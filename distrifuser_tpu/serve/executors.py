@@ -81,6 +81,24 @@ class PipelineExecutor:
         report = getattr(pipeline, "weight_report", None)
         self.weight_nbytes = report()["total_bytes"] if report else None
 
+    # -- observability (utils/trace.py; docs/OBSERVABILITY.md) -------------
+
+    def attach_step_timeline(self, timeline):
+        """Record a per-denoise-step timeline (`utils.trace.StepTimeline`)
+        for every monolithic dispatch through this executor: wall time
+        per step tagged warmup/full/shallow plus live comm-byte counters
+        reconciled against `comm_plan`.  Timeline-carrying generations
+        run the per-step callback dispatch path — use for profiling
+        runs, not steady-state serving."""
+        self.pipeline.step_timeline = timeline
+        return timeline
+
+    def comm_plan(self) -> dict:
+        """The closed-form wire-byte plan for one dispatch at this
+        executor's step count (pipelines.comm_plan) — what the live
+        timeline counters are checked against."""
+        return self.pipeline.comm_plan(self.steps)
+
     def _in_channels(self) -> int:
         pipe = self.pipeline
         for attr in ("unet_config", "dit_config", "mmdit_config"):

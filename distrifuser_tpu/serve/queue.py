@@ -60,12 +60,24 @@ class Request:
     negative_prompt: str = ""
     guidance_scale: float = 5.0
     seed: int = 0
+    # SLO class this request is held to ("default" unless the caller
+    # says otherwise): completions feed the per-class rolling p50/p99
+    # windows (server.slo_snapshot()) the closed-loop controller reads.
+    slo_class: str = "default"
     request_id: int = dataclasses.field(
         default_factory=lambda: next(_REQUEST_IDS)
     )
     enqueue_ts: float = dataclasses.field(default_factory=time.monotonic)
     future: Future = dataclasses.field(default_factory=Future)
     bucket: Optional[tuple] = None  # (h, w), set by the batcher
+    # when the batcher pulled this request out of the queue into a batch
+    # (None until then): the end of the queue-wait span, stamped at the
+    # pop so tracing sees the coalesce time, not the later dispatch time
+    dequeue_ts: Optional[float] = None
+    # utils.trace.RequestTrace when request-scoped tracing is on (the
+    # tracer-local ids the lifecycle hooks close spans against); None —
+    # and completely untouched — when tracing is off
+    trace: Any = None
 
     def expired(self, now: float) -> bool:
         return now >= self.deadline

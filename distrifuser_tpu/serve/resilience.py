@@ -484,9 +484,15 @@ class ResilienceEngine:
         clock: Callable[[], float] = time.monotonic,
         sleep: Optional[Callable[[float], Any]] = None,
         staging: bool = False,
+        tracer: Any = None,
     ):
         self.config = config or ResilienceConfig()
         self.clock = clock
+        # optional utils.trace.Tracer: breaker transitions and ladder
+        # moves land as instants on the "resilience" track so a Perfetto
+        # view shows WHY a key's requests started shedding/degrading.
+        # None (default) = zero tracing overhead on the failure path.
+        self.tracer = tracer
         # sleep is injectable so (a) tests never block and (b) the server
         # passes a stop-interruptible wait, keeping stop() deterministic
         # even mid-backoff
@@ -558,8 +564,23 @@ class ResilienceEngine:
     def allow(self, key: ExecKey) -> bool:
         return self.key_state(key).breaker.allow()
 
+    def _breaker_transition(self, key: ExecKey, breaker: CircuitBreaker,
+                            mutate: Callable[[], None]) -> None:
+        """Run one breaker mutation, emitting a trace instant when the
+        effective state changed (trip, re-open, heal)."""
+        if self.tracer is None:
+            mutate()
+            return
+        before = breaker.state()
+        mutate()
+        after = breaker.state()
+        if after != before:
+            self.tracer.event(f"breaker_{after}", track="resilience",
+                              args={"key": key.short(), "from": before})
+
     def on_success(self, key: ExecKey) -> None:
-        self.key_state(key).breaker.record_success()
+        br = self.key_state(key).breaker
+        self._breaker_transition(key, br, br.record_success)
 
     def note_error(self, key: ExecKey, exc: BaseException) -> None:
         """Record an attempt failure for observability (health's
@@ -576,13 +597,15 @@ class ResilienceEngine:
         single transient blip that exhausts max_retries would also trip
         the circuit, conflating two separately-tuned policies."""
         self.note_error(key, exc)
-        self.key_state(key).breaker.record_failure()
+        br = self.key_state(key).breaker
+        self._breaker_transition(key, br, br.record_failure)
 
     def record_terminal_failure(self, key: ExecKey) -> None:
         """Breaker-only terminal mark for a failure whose error was
         already ring-logged via note_error (the retry loop's exhaustion
         branches)."""
-        self.key_state(key).breaker.record_failure()
+        br = self.key_state(key).breaker
+        self._breaker_transition(key, br, br.record_failure)
 
     def degrade(self, key: ExecKey, kind: str,
                 batch_size: int) -> Optional[str]:
@@ -597,6 +620,9 @@ class ResilienceEngine:
                                                                 cap)
         elif rung is not None:
             st.rungs.append(rung)
+        if rung is not None and self.tracer is not None:
+            self.tracer.event(f"degrade_{rung}", track="resilience",
+                              args={"key": key.short(), "kind": kind})
         return rung
 
     def retract_rung(self, key: ExecKey, rung: str) -> Optional[str]:
@@ -614,6 +640,9 @@ class ResilienceEngine:
         st.rungs.remove(rung)
         if rung not in st.inapplicable:
             st.inapplicable.append(rung)
+        if self.tracer is not None:
+            self.tracer.event(f"retract_{rung}", track="resilience",
+                              args={"key": key.short()})
         return rung
 
     def degraded_key(self, key: ExecKey) -> ExecKey:

@@ -49,7 +49,8 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.config import ServeConfig
-from ..utils.metrics import Counter, LatencyHistogram
+from ..utils.metrics import Counter, MetricsRegistry
+from ..utils.trace import RequestTrace, Tracer
 from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
 from .errors import (
@@ -119,11 +120,46 @@ class InferenceServer:
         self.cache = ExecutorCache(
             _factory, capacity=self.config.cache_capacity
         )
-        self.counters = Counter()
-        self.hist_queue_wait = LatencyHistogram()
-        self.hist_execute = LatencyHistogram()
-        self.hist_e2e = LatencyHistogram()
-        self._batch_sizes = Counter()
+        obs = self.config.observability
+        # Request-scoped tracing (utils/trace.py): None when off — every
+        # hook below is guarded, so the tracing-off request path runs no
+        # tracing code at all (the ≤2% overhead budget is met by absence)
+        self.tracer = (Tracer(clock=clock, capacity=obs.trace_capacity)
+                       if obs.trace else None)
+        self.cache.tracer = self.tracer
+        # Unified metrics plane (utils/metrics.py MetricsRegistry): every
+        # Counter/LatencyHistogram/GapTracker/RingLog the server and its
+        # sub-pieces mutate is OWNED here under hierarchical names, so
+        # /metrics (Prometheus), /metrics.json, and metrics_snapshot()
+        # all render one source of truth
+        self.registry = MetricsRegistry()
+        self.counters = self.registry.counter("serve_requests")
+        self.hist_queue_wait = self.registry.histogram(
+            "serve_latency_seconds", labels={"phase": "queue_wait"})
+        self.hist_execute = self.registry.histogram(
+            "serve_latency_seconds", labels={"phase": "execute"})
+        self.hist_e2e = self.registry.histogram(
+            "serve_latency_seconds", labels={"phase": "e2e"})
+        self._batch_sizes = self.registry.counter("serve_batch_size")
+        # SLO signal plumbing (ROADMAP item 3's controller interface):
+        # rolling-window p50/p99 per SLO class + the queue-depth and
+        # inflight gauges, all readable via slo_snapshot()
+        self._slo_window = obs.slo_window
+        self._inflight_c = Counter()  # "requests": dispatched, unresolved
+        self.registry.gauge("serve_queue_depth",
+                            lambda: float(len(self.queue)))
+        self.registry.gauge("serve_inflight_requests",
+                            lambda: float(self._inflight_c.get("requests")))
+        self.registry.gauge("serve_cache_entries",
+                            lambda: float(len(self.cache)))
+        self.registry.gauge("serve_cache_hits",
+                            lambda: float(self.cache.hits))
+        self.registry.gauge("serve_cache_misses",
+                            lambda: float(self.cache.misses))
+        self.registry.gauge(
+            "serve_retry_budget_remaining",
+            lambda: float(self.resilience.budget.remaining))
+        self.metrics_endpoint = None
         self.batcher = MicroBatcher(
             self.queue,
             BucketTable(self.config.buckets),
@@ -144,7 +180,15 @@ class InferenceServer:
             # waits out a backoff schedule
             sleep=self._stop.wait,
             staging=self.config.pipeline_stages,
+            tracer=self.tracer,
         )
+        # the resilience ring log joins the unified registry (JSON render;
+        # the Prometheus exposition skips free-text rings by design)
+        self.registry.register("serve_last_errors",
+                               self.resilience.last_errors)
+        self.registry.gauge(
+            "serve_watchdog_timeouts",
+            lambda: float(self.resilience.watchdog.timeouts))
         # Staged pipelining (serve/staging.py): three stage workers overlap
         # text-encode, denoise, and VAE-decode across micro-batches.  The
         # scheduler thread submits and drains outcome events; futures
@@ -162,6 +206,8 @@ class InferenceServer:
                 on_failure=self._staged_failure,
                 on_release=self._staged_release,
                 fault_plan=fault_plan,
+                registry=self.registry,
+                tracer=self.tracer,
             )
         self._thread: Optional[threading.Thread] = None
         self._started = False
@@ -183,6 +229,9 @@ class InferenceServer:
             )
         if warmup and self.config.warmup_buckets:
             self._warmup()
+        if (self.config.observability.metrics_port is not None
+                and self.metrics_endpoint is None):
+            self.start_metrics_endpoint()
         self._stop.clear()
         self._started = True
         self._thread = threading.Thread(
@@ -202,6 +251,7 @@ class InferenceServer:
         self._stop.set()
         for req in self.queue.close():
             self.counters.inc("rejected_server_closed")
+            self._trace_finish(req, "server_closed")
             self._resolve(req.future, exc=ServerClosedError("server stopped"))
         if self.staging is not None:
             # drain the stage queues deterministically: every staged batch
@@ -219,6 +269,9 @@ class InferenceServer:
                 self.counters.inc("stop_join_timeouts")
             else:
                 self._thread = None
+        if self.metrics_endpoint is not None:
+            self.metrics_endpoint.stop()
+            self.metrics_endpoint = None
         self._started = False
 
     def __enter__(self) -> "InferenceServer":
@@ -301,6 +354,7 @@ class InferenceServer:
         guidance_scale: float = 5.0,
         seed: int = 0,
         ttl_s: Optional[float] = None,
+        slo_class: str = "default",
     ) -> Future:
         """Admit one request; returns a Future of `ServeResult`.
 
@@ -309,7 +363,11 @@ class InferenceServer:
         bucket, circuit-breaker, and execution failures fail the *future*
         instead, since they are decided at scheduling time.  Every error
         is a `ServeError`: `RetryableError` means the same request may
-        succeed later/elsewhere, `FatalError` means it cannot."""
+        succeed later/elsewhere, `FatalError` means it cannot.
+
+        ``slo_class`` tags the request for the per-class rolling-latency
+        windows (`slo_snapshot`) — the signal the SLO controller steers
+        on; it does NOT affect scheduling today."""
         if not self._started or self._stop.is_set():
             raise ServerClosedError("server is not running")
         steps = (self.config.default_steps if num_inference_steps is None
@@ -323,16 +381,73 @@ class InferenceServer:
             num_inference_steps=steps,
             guidance_scale=guidance_scale,
             seed=seed,
+            slo_class=str(slo_class),
             deadline=self.clock() + ttl,
             enqueue_ts=self.clock(),
         )
+        if self.tracer is not None:
+            self._trace_submit(req, steps)
         self.counters.inc("submitted")
         try:
             self.queue.put(req)
         except QueueFullError:
             self.counters.inc("rejected_queue_full")
+            self._trace_finish(req, "queue_full")
             raise
         return req.future
+
+    # -- tracing hooks (all no-ops when config.observability.trace is off) --
+
+    def _trace_submit(self, req: Request, steps: int) -> None:
+        """Open the request's root + queue-wait spans (its whole track)."""
+        tr = self.tracer
+        tid = tr.new_trace()
+        track = f"req/{tid}"
+        root = tr.begin("request", track=track, trace=tid, args={
+            "requested": f"{req.height}x{req.width}",
+            "steps": steps,
+            "slo_class": req.slo_class,
+        })
+        tr.event("enqueue", track=track, trace=tid)
+        qspan = tr.begin("queue_wait", track=track, trace=tid, parent=root)
+        req.trace = RequestTrace(trace_id=tid, track=track, root=root,
+                                 queue_span=qspan)
+
+    def _trace_dequeue(self, req: Request, batch_span: int,
+                       batch_size: int) -> None:
+        """Close the queue-wait span at the batcher's pop time and mark
+        the coalesce, flow-linking the member to the batch span."""
+        rt = req.trace
+        if rt is None or rt.done:
+            return
+        tr = self.tracer
+        ts = req.dequeue_ts if req.dequeue_ts is not None else self.clock()
+        tr.end(rt.queue_span, t=ts, args={"batch_span": batch_span})
+        rt.queue_span = None
+        tr.event("coalesce", track=rt.track, trace=rt.trace_id, t=ts,
+                 args={"batch_span": batch_span, "batch_size": batch_size})
+        rt.flow_id = tr.new_flow()
+        tr.flow(rt.flow_id, "s", track="scheduler", name="member")
+
+    def _trace_finish(self, req: Request, outcome: str,
+                      args: Optional[dict] = None) -> None:
+        """Terminal mark for one request: close any still-open queue span
+        and the root span with the outcome.  Idempotent — races between
+        cancel, deadline, and stop() must not double-close."""
+        rt = req.trace
+        if rt is None or rt.done or self.tracer is None:
+            return
+        rt.done = True
+        tr = self.tracer
+        if rt.queue_span is not None:
+            tr.end(rt.queue_span, args={"outcome": outcome})
+            rt.queue_span = None
+        a = {"outcome": outcome}
+        if args:
+            a.update(args)
+        tr.event("complete" if outcome == "completed" else outcome,
+                 track=rt.track, trace=rt.trace_id)
+        tr.end(rt.root, args=a)
 
     # -- scheduling loop (single thread) ----------------------------------
 
@@ -349,8 +464,19 @@ class InferenceServer:
         except Exception:
             pass  # cancelled/raced future: the caller gave up on it
 
+    _OUTCOMES = {
+        "ServerClosedError": "server_closed",
+        "DeadlineExceededError": "deadline_exceeded",
+        "CircuitOpenError": "shed_circuit_open",
+        "NoBucketError": "no_bucket",
+        "WatchdogTimeoutError": "watchdog_timeout",
+    }
+
     def _fail_batch(self, batch: List[Request], exc: Exception) -> None:
+        outcome = self._OUTCOMES.get(type(exc).__name__,
+                                     type(exc).__name__)
         for req in batch:
+            self._trace_finish(req, outcome)
             self._resolve(req.future, exc=exc)
 
     def _reject(self, req: Request, exc: Exception) -> None:
@@ -360,6 +486,9 @@ class InferenceServer:
             self.counters.inc("rejected_no_bucket")
         else:
             self.counters.inc("rejected_other")
+        self._trace_finish(
+            req, self._OUTCOMES.get(type(exc).__name__,
+                                    type(exc).__name__))
         self._resolve(req.future, exc=exc)
 
     def _loop(self) -> None:
@@ -403,12 +532,46 @@ class InferenceServer:
         self._drain_staged_outcomes()
         base_key = self._exec_key_for(key.height, key.width, key.steps,
                                       key.cfg)
+        batch_span = None
+        if self.tracer is not None:
+            batch_span = self.tracer.begin(
+                "batch", track="scheduler", t=dispatch_ts,
+                args={"bucket": f"{key.height}x{key.width}",
+                      "n": len(batch), "key": base_key.short(),
+                      "traces": [r.trace.trace_id for r in batch
+                                 if r.trace is not None]})
+            for req in batch:
+                self._trace_dequeue(req, batch_span, len(batch))
         if not self.resilience.allow(base_key):
             self._shed(base_key, batch)
+            if self.tracer is not None:
+                self.tracer.end(batch_span, args={"outcome": "shed"})
             return
-        if self._execute_staged(key, base_key, batch, dispatch_ts):
+        # inflight gauge: dispatched-but-unresolved requests (the SLO
+        # controller's second queue signal).  Every exit path below must
+        # balance it — staged submissions hand the decrement to
+        # _staged_release, which fires exactly once per submitted batch.
+        self._inflight_c.inc("requests", len(batch))
+        staged = self._execute_staged(key, base_key, batch, dispatch_ts)
+        if staged == "submitted":
+            if self.tracer is not None:
+                self.tracer.end(batch_span, args={"outcome": "staged"})
             return
-        self._execute_resilient(key, base_key, batch, dispatch_ts)
+        if staged == "failed":
+            self._inflight_c.inc("requests", -len(batch))
+            if self.tracer is not None:
+                self.tracer.end(batch_span, args={"outcome": "failed"})
+            return
+        try:
+            self._execute_resilient(key, base_key, batch, dispatch_ts)
+        finally:
+            # batch span first, THEN the inflight decrement: a client
+            # observing inflight==0 knows the scheduler has made its
+            # last tracer/clock call for this batch (the trace
+            # determinism tests quiesce on exactly this)
+            if self.tracer is not None:
+                self.tracer.end(batch_span)
+            self._inflight_c.inc("requests", -len(batch))
 
     # -- the staged execute path -------------------------------------------
 
@@ -445,13 +608,15 @@ class InferenceServer:
                 not in self.resilience.key_state(base_key).rungs)
 
     def _execute_staged(self, key: BatchKey, base_key: ExecKey,
-                        batch: List[Request], dispatch_ts: float) -> bool:
-        """Submit the batch to the stage pipeline; True when the batch was
-        consumed (submitted or failed terminally), False to fall through
-        to the monolithic path (staging off/degraded for this key, or an
-        executor without stage programs)."""
+                        batch: List[Request], dispatch_ts: float) -> str:
+        """Submit the batch to the stage pipeline.  Returns
+        ``"submitted"`` (the pipeline owns the batch now — its inflight
+        decrement rides `_staged_release`), ``"failed"`` (consumed by a
+        terminal failure here), or ``"fallthrough"`` to the monolithic
+        path (staging off/degraded for this key, or an executor without
+        stage programs)."""
         if not self._staging_routed(base_key):
-            return False
+            return "fallthrough"
         from .staging import StagedBatch
 
         ekey = self.resilience.degraded_key(base_key)
@@ -476,12 +641,12 @@ class InferenceServer:
                     self.counters.inc("degraded_" + rung)
             self.counters.inc("failed_build", len(batch))
             self._fail_batch(batch, bexc)
-            return True
+            return "failed"
         if not hasattr(executor, "encode_stage"):
             # executor has no stage programs (plain fakes, custom
             # adapters): unpin and run monolithically
             self.cache.unpin(executor)
-            return False
+            return "fallthrough"
         sb = StagedBatch(
             batch_key=key, base_key=base_key, ekey=ekey, requests=batch,
             executor=executor, compile_hit=hit, dispatch_ts=dispatch_ts,
@@ -492,7 +657,8 @@ class InferenceServer:
             self.cache.unpin(executor)
             self.counters.inc("rejected_server_closed", len(batch))
             self._fail_batch(batch, ServerClosedError("server stopped"))
-        return True
+            return "failed"
+        return "submitted"
 
     def _staged_success(self, sb, outputs, t0: float, t1: float) -> None:
         """Decode-worker callback: resolve and record one completed staged
@@ -526,6 +692,11 @@ class InferenceServer:
         self._fail_batch(sb.requests, exc)
 
     def _staged_release(self, sb) -> None:
+        # fires exactly once per submitted staged batch, on ANY exit path
+        # (success, failure, cancel-drop, stop): the executor unpin and
+        # the inflight decrement both belong to "the batch left the
+        # pipeline"
+        self._inflight_c.inc("requests", -len(sb.requests))
         self.cache.unpin(sb.executor)
 
     def _shed(self, ekey: ExecKey, batch: List[Request]) -> None:
@@ -652,6 +823,11 @@ class InferenceServer:
                             return
                         self.counters.inc("retries")
                         self.counters.inc("degraded_split_batch")
+                        if self.tracer is not None:
+                            self.tracer.event(
+                                "split_batch", track="scheduler",
+                                args={"key": ekey.short(),
+                                      "n": len(batch)})
                         mid = (len(batch) + 1) // 2
                         self._execute_resilient(key, base_key, batch[:mid],
                                                 dispatch_ts)
@@ -675,6 +851,12 @@ class InferenceServer:
                     self._fail_batch(batch, exc)
                     return
                 self.counters.inc("retries")
+                if self.tracer is not None:
+                    self.tracer.event(
+                        "retry", track="scheduler",
+                        args={"attempt": attempts, "kind": kind,
+                              "key": ekey.short(),
+                              "error": type(exc).__name__})
                 res.sleep(res.backoff_delay(attempts))
                 continue
             except Exception as exc:
@@ -720,12 +902,29 @@ class InferenceServer:
             self.hist_queue_wait.observe(queue_wait)
             self.hist_execute.observe(exec_s)
             self.hist_e2e.observe(e2e)
+            self.slo_window(req.slo_class).observe(e2e)
             self.counters.inc("completed")
             if req.expired(t1):
                 # deadline lapsed while IN FLIGHT: deadlines gate
                 # scheduling, never abandon mesh work — the caller
                 # still gets the result, and the lateness is counted
                 self.counters.inc("completed_late")
+            if req.trace is not None and self.tracer is not None:
+                rt = req.trace
+                self.tracer.complete(
+                    "execute", t0, t1, track=rt.track, trace=rt.trace_id,
+                    parent=rt.root,
+                    args={"bucket": f"{ekey.height}x{ekey.width}",
+                          "batch_size": len(batch), "compile_hit": hit})
+                if rt.flow_id is not None:
+                    # finish the batch->member flow arrow inside the
+                    # execute slice
+                    self.tracer.flow(rt.flow_id, "f", track=rt.track,
+                                     t=t0, name="member")
+                self._trace_finish(req, "completed", args={
+                    "retries": retries,
+                    "degradations": list(degradations),
+                    "batch_size": len(batch)})
             self._resolve(req.future, result=ServeResult(
                 request_id=req.request_id,
                 output=out,
@@ -741,6 +940,90 @@ class InferenceServer:
             ))
 
     # -- observability -----------------------------------------------------
+
+    def slo_window(self, slo_class: str):
+        """The rolling e2e-latency window for one SLO class (created on
+        first use; one `RollingQuantile` per class in the registry)."""
+        return self.registry.rolling(
+            "serve_slo_e2e_seconds", window=self._slo_window,
+            labels={"slo_class": str(slo_class)})
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """THE interface the closed-loop SLO controller (ROADMAP item 3)
+        reads: current queue depth, dispatched-but-unresolved request
+        count, and per-SLO-class rolling p50/p99 over the last
+        ``observability.slo_window`` completions.  O(classes · window)
+        and any-thread-safe — poll it as fast as you like."""
+        classes = {}
+        # one family, not the whole registry: health()/the controller
+        # poll this, and a scrape must not pay for every histogram
+        for lbls, window in self.registry.family("serve_slo_e2e_seconds"):
+            classes[lbls.get("slo_class", "default")] = window.snapshot()
+        return {
+            "queue_depth": len(self.queue),
+            "inflight_requests": self._inflight_c.get("requests"),
+            "slo_window": self._slo_window,
+            "classes": classes,
+        }
+
+    def metrics_prometheus(self) -> str:
+        """The unified registry in Prometheus text exposition format —
+        what the ``--metrics_port`` endpoint serves at ``/metrics``."""
+        return self.registry.to_prometheus()
+
+    def start_metrics_endpoint(self, port: Optional[int] = None):
+        """Serve the metrics plane over stdlib HTTP: ``/metrics``
+        (Prometheus text), ``/metrics.json`` (registry JSON), and
+        ``/healthz`` (the `health()` snapshot).  Auto-started by
+        `start()` when ``observability.metrics_port`` is set; ``port=0``
+        binds ephemerally (read ``server.metrics_endpoint.port``)."""
+        from ..utils.metrics import MetricsHTTPEndpoint
+
+        if self.metrics_endpoint is not None:
+            return self.metrics_endpoint
+        if port is None:
+            port = self.config.observability.metrics_port or 0
+        self.metrics_endpoint = MetricsHTTPEndpoint(
+            prom=self.metrics_prometheus,
+            json_snapshot=lambda: self.registry.snapshot(),
+            health=self.health,
+            port=int(port),
+            host=self.config.observability.metrics_host,
+        ).start()
+        return self.metrics_endpoint
+
+    def dump_observability(self, directory: str) -> Dict[str, str]:
+        """Write the whole observability surface as files into
+        ``directory`` (created if needed): ``metrics.json`` (the serve
+        artifact snapshot), ``registry.json`` (the raw registry),
+        ``metrics.prom`` (Prometheus text), ``health.json``,
+        ``slo.json``, and — when tracing is on — ``trace.json``
+        (Perfetto-loadable).  Returns {name: path}."""
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        paths: Dict[str, str] = {}
+
+        def dump_json(name, payload):
+            path = os.path.join(directory, name)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+                f.write("\n")
+            paths[name] = path
+
+        dump_json("metrics.json", self.metrics_snapshot())
+        dump_json("registry.json", self.registry.snapshot())
+        dump_json("health.json", self.health())
+        dump_json("slo.json", self.slo_snapshot())
+        prom_path = os.path.join(directory, "metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(self.metrics_prometheus())
+        paths["metrics.prom"] = prom_path
+        if self.tracer is not None:
+            trace_path = os.path.join(directory, "trace.json")
+            self.tracer.export(trace_path)
+            paths["trace.json"] = trace_path
+        return paths
 
     def health(self) -> Dict[str, Any]:
         """Liveness/readiness snapshot (docs/SERVING.md schema): queue
@@ -817,6 +1100,14 @@ class InferenceServer:
             # fraction (None on monolithic servers)
             "staging": (self.staging.snapshot()
                         if self.staging is not None else None),
+            # the tracing + SLO plane (docs/OBSERVABILITY.md): trace ring
+            # stats (None when tracing is off) and the rolling-window SLO
+            # signals the closed-loop controller reads
+            "observability": {
+                "trace": (self.tracer.stats()
+                          if self.tracer is not None else None),
+                "slo": self.slo_snapshot(),
+            },
         }
 
     def export_metrics(self, path: str) -> Dict[str, Any]:

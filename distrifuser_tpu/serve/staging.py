@@ -153,11 +153,18 @@ class StagePipeline:
         on_failure: Optional[Callable[..., None]] = None,
         on_release: Optional[Callable[..., None]] = None,
         fault_plan=None,
+        registry=None,
+        tracer=None,
     ):
         assert max_inflight >= 1, max_inflight
         self.max_inflight = max_inflight
         self.clock = clock
         self.counters = counters if counters is not None else Counter()
+        # optional utils.trace.Tracer: each stage invocation lands as a
+        # span on its stage's track ("stage/encode" etc.) tagged with the
+        # member trace ids, so the Perfetto view shows the overlap — the
+        # measured form of "batch k+1 encodes under batch k's denoise"
+        self.tracer = tracer
         # chaos composition: the server's "execute"-site faults fire at
         # the denoise stage (the staged analog of the monolithic
         # watchdog-bounded dispatch), so a chaos run against a staged
@@ -179,9 +186,26 @@ class StagePipeline:
         self.submitted = 0
         self.completed = 0
         self.failed = 0
-        self.hist_wait = {s: LatencyHistogram() for s in STAGES}
-        self.hist_service = {s: LatencyHistogram() for s in STAGES}
-        self.denoise_gap = GapTracker()
+        # metric primitives live in the unified MetricsRegistry when the
+        # owning server passes one (hierarchical names + stage labels,
+        # rendered by /metrics); standalone pipelines (direct tests) keep
+        # private instances — the objects and snapshots are identical
+        if registry is not None:
+            self.hist_wait = {
+                s: registry.histogram("serve_stage_wait_seconds",
+                                      labels={"stage": s})
+                for s in STAGES
+            }
+            self.hist_service = {
+                s: registry.histogram("serve_stage_service_seconds",
+                                      labels={"stage": s})
+                for s in STAGES
+            }
+            self.denoise_gap = registry.gap("serve_denoise_gap")
+        else:
+            self.hist_wait = {s: LatencyHistogram() for s in STAGES}
+            self.hist_service = {s: LatencyHistogram() for s in STAGES}
+            self.denoise_gap = GapTracker()
         self._queues = {s: queue_mod.Queue() for s in STAGES}
         self._watchdogs = {s: Watchdog(watchdog_timeout_s) for s in STAGES}
         self._outcomes: "deque[Tuple[ExecKey, ExecKey, Optional[Exception]]]" = deque()
@@ -354,6 +378,11 @@ class StagePipeline:
                 fresh = (isinstance(exc, WatchdogTimeoutError)
                          and abandoned is not None
                          and abandoned is not prev_abandoned)
+                if self.tracer is not None:
+                    self.tracer.event(
+                        f"{stage}_failed", track=f"stage/{stage}",
+                        args={"key": sb.ekey.short(),
+                              "error": type(exc).__name__})
                 self._fail(sb, self._wrap(stage, sb, exc),
                            release_after=abandoned if fresh else None)
                 continue
@@ -361,6 +390,13 @@ class StagePipeline:
             if stage == "denoise":
                 self.denoise_gap.end(t1)
             self.hist_service[stage].observe(t1 - t0)
+            if self.tracer is not None:
+                self.tracer.complete(
+                    stage, t0, t1, track=f"stage/{stage}",
+                    args={"n": len(sb.requests), "key": sb.ekey.short(),
+                          "traces": [r.trace.trace_id for r in sb.requests
+                                     if r.trace is not None]},
+                )
             if stage == "encode":
                 sb.started_ts = t0
             if nxt is not None:

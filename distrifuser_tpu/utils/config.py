@@ -458,6 +458,57 @@ DEFAULT_BUCKETS = (
 
 
 @dataclasses.dataclass
+class ObservabilityConfig:
+    """Observability knobs for the serve layer (utils/trace.py +
+    utils/metrics.py; docs/OBSERVABILITY.md); lives beside ServeConfig so
+    one module owns every run-shaping knob.
+
+    * ``trace`` — request-scoped tracing on/off.  Off (the default) the
+      request path executes no tracing code at all (`InferenceServer`
+      holds no Tracer); on, every request records its whole life as
+      spans exportable via ``server.tracer.export(path)`` /
+      ``server.dump_observability(dir)`` as Perfetto-loadable JSON.
+    * ``trace_capacity`` — ring bound on retained trace records (oldest
+      dropped first, drop count reported): bounded memory no matter how
+      long the service runs, same convention as `RingLog`.
+    * ``metrics_port`` — when not None, `server.start()` serves the
+      unified `MetricsRegistry` over stdlib HTTP on this port
+      (``/metrics`` Prometheus text, ``/metrics.json``, ``/healthz``);
+      0 binds an ephemeral port (read ``server.metrics_endpoint.port``).
+    * ``metrics_host`` — bind address for that endpoint.  Loopback by
+      default (a metrics plane should not be world-readable by
+      accident); set "0.0.0.0" for containerized deployments whose
+      scraper lives outside the host.
+    * ``slo_window`` — ring size of the per-SLO-class rolling p50/p99
+      windows (`RollingQuantile`) — the signal ROADMAP item 3's
+      closed-loop controller reads via ``server.slo_snapshot()``.
+    """
+
+    trace: bool = False
+    trace_capacity: int = 8192
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
+    slo_window: int = 512
+
+    def __post_init__(self) -> None:
+        if self.trace_capacity < 1:
+            raise ValueError(
+                f"trace_capacity must be >= 1, got {self.trace_capacity}"
+            )
+        if self.metrics_port is not None and not (
+                0 <= int(self.metrics_port) <= 65535):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535], got {self.metrics_port}"
+            )
+        if not self.metrics_host:
+            raise ValueError("metrics_host must be a non-empty bind address")
+        if self.slo_window < 1:
+            raise ValueError(
+                f"slo_window must be >= 1, got {self.slo_window}"
+            )
+
+
+@dataclasses.dataclass
 class ResilienceConfig:
     """Failure-handling policy for the serve layer (serve/resilience.py);
     lives beside ServeConfig so one module owns every run-shaping knob.
@@ -701,6 +752,13 @@ class ServeConfig:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    # Tracing + metrics plane: request-scoped spans, the unified
+    # MetricsRegistry HTTP endpoint, and the per-SLO-class rolling
+    # latency windows — see ObservabilityConfig above and
+    # docs/OBSERVABILITY.md.
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig
+    )
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -779,4 +837,9 @@ class ServeConfig:
             raise ValueError(
                 "resilience must be a ResilienceConfig, got "
                 f"{type(self.resilience).__name__}"
+            )
+        if not isinstance(self.observability, ObservabilityConfig):
+            raise ValueError(
+                "observability must be an ObservabilityConfig, got "
+                f"{type(self.observability).__name__}"
             )

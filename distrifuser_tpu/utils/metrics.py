@@ -30,7 +30,7 @@ request count, JSON-friendly snapshots for `bench.py`-style artifacts.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -307,10 +307,7 @@ class LatencyHistogram:
             self.min = min(self.min, v)
             self.max = max(self.max, v)
 
-    def quantile(self, q: float) -> float:
-        """Approximate q-quantile (0 <= q <= 1) by bucket interpolation,
-        clamped to the exact observed [min, max]."""
-        assert 0.0 <= q <= 1.0, q
+    def _quantile_locked(self, q: float) -> float:
         if self.count == 0:
             return float("nan")
         rank = q * (self.count - 1)
@@ -323,23 +320,38 @@ class LatencyHistogram:
                 return float(min(max(mid, self.min), self.max))
         return float(self.max)
 
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile (0 <= q <= 1) by bucket interpolation,
+        clamped to the exact observed [min, max].  Locked like observe():
+        a reader walking ``_counts`` concurrently with a writer must not
+        see a cumulative count ahead of ``self.count`` (the PR-8
+        thread-safety audit — readers take the same lock writers do)."""
+        assert 0.0 <= q <= 1.0, q
+        with self._lock:
+            return self._quantile_locked(q)
+
     @property
     def mean(self) -> float:
-        return self.sum / self.count if self.count else float("nan")
+        with self._lock:
+            return self.sum / self.count if self.count else float("nan")
 
     def snapshot(self) -> Dict[str, float]:
-        """JSON-friendly summary (the serve artifact schema)."""
-        if self.count == 0:
-            return {"count": 0}
-        return {
-            "count": self.count,
-            "mean": self.mean,
-            "min": self.min,
-            "max": self.max,
-            "p50": self.quantile(0.50),
-            "p90": self.quantile(0.90),
-            "p99": self.quantile(0.99),
-        }
+        """JSON-friendly summary (the serve artifact schema).  One lock
+        hold for the whole read, so count/sum/min/max and the quantiles
+        all come from the same instant."""
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0}
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "mean": self.sum / self.count,
+                "min": self.min,
+                "max": self.max,
+                "p50": self._quantile_locked(0.50),
+                "p90": self._quantile_locked(0.90),
+                "p99": self._quantile_locked(0.99),
+            }
 
 
 class Counter:
@@ -463,6 +475,469 @@ class RingLog:
         """JSON-friendly ``[{"seq": n, "message": s}, ...]``, oldest first."""
         with self._lock:
             return [{"seq": n, "message": m} for n, m in self._items]
+
+
+# --------------------------------------------------------------------------
+# Unified metrics plane: registry + SLO signals + HTTP exposition
+# --------------------------------------------------------------------------
+
+
+class Gauge:
+    """A point-in-time value: either callback-backed (``fn`` sampled at
+    read time — queue depth, cache residency) or set-backed (`set`).
+    Locked for the set path; callback gauges read whatever their callable
+    reads (the callable owns its own consistency)."""
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        import threading
+
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        assert self._fn is None, "callback gauge cannot be set"
+        with self._lock:
+            self._value = float(value)
+
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — a dead callback must not
+                # take down a metrics scrape; NaN is the honest answer
+                return float("nan")
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> float:
+        return self.value()
+
+
+class RollingQuantile:
+    """Rolling-window latency quantiles over a fixed-size ring buffer.
+
+    The SLO controller (ROADMAP item 3) steers on *recent* p50/p99 per
+    SLO class — a lifetime histogram answers "how has this service ever
+    behaved", not "is the SLO holding right now".  ``observe`` is O(1)
+    (ring write + counter); ``quantile`` sorts a copy of the window
+    (O(w log w) on the rare read path — w is small and scrape-rate, not
+    request-rate).  Locked like the other serve metrics: request
+    completions land from the scheduler thread and the staged decode
+    worker concurrently."""
+
+    def __init__(self, window: int = 512):
+        import threading
+
+        assert window >= 1, window
+        self.window = window
+        self._buf = np.zeros(window, np.float64)
+        self._n = 0  # total ever observed
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._buf[self._n % self.window] = float(v)
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def _window_locked(self) -> np.ndarray:
+        return np.sort(self._buf[: min(self._n, self.window)].copy())
+
+    @staticmethod
+    def _rank(w: np.ndarray, q: float) -> float:
+        """Nearest-rank value of sorted window ``w`` — the ONE indexing
+        convention quantile() and snapshot() share."""
+        return float(w[min(int(q * (w.size - 1) + 0.5), w.size - 1)])
+
+    def quantile(self, q: float) -> float:
+        assert 0.0 <= q <= 1.0, q
+        with self._lock:
+            w = self._window_locked()
+        if w.size == 0:
+            return float("nan")
+        return self._rank(w, q)
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-friendly window summary — the SLO-signal record shape
+        (docs/OBSERVABILITY.md): total count, window fill, and the
+        rolling p50/p90/p99.  Count and window come from the same lock
+        hold, so the fields are mutually consistent."""
+        with self._lock:
+            w = self._window_locked()
+            n = self._n
+        if w.size == 0:
+            return {"count": 0, "window": 0}
+        return {
+            "count": n,
+            "window": int(w.size),
+            "mean": float(w.mean()),
+            "p50": self._rank(w, 0.50),
+            "p90": self._rank(w, 0.90),
+            "p99": self._rank(w, 0.99),
+        }
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a hierarchical metric name to the Prometheus charset."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch in "_:"
+                   else "_")
+    s = "".join(out)
+    return s if not s[:1].isdigit() else "_" + s
+
+
+def _prom_label_value(v) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r"\""))
+
+
+def _prom_value(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 2**53:
+        return str(int(f))
+    return repr(f)
+
+
+class MetricsRegistry:
+    """One owner for every serving metric, under hierarchical names with
+    labels — the unified plane `InferenceServer.metrics_snapshot()` and
+    the ``--metrics_port`` endpoint render from.
+
+    Helpers get-or-create (same name + labels returns the SAME instance,
+    so e.g. the staged pipeline and the server share one histogram
+    family); registering a different metric *object* under an existing
+    (name, labels) raises — two writers silently splitting one identity
+    is how dashboards lie.  Any object with a ``snapshot()`` (Counter,
+    LatencyHistogram, GapTracker, RingLog, RollingQuantile, Gauge)
+    registers via `register`.
+
+    Rendering: `snapshot()` is the JSON form (one entry per (name,
+    labels)); `to_prometheus()` is the text exposition format — counters
+    as counter families (the multi-key `Counter` renders one sample per
+    key under a ``key`` label), histograms and rolling windows as
+    summaries (quantile label + _sum/_count), gauges and gap trackers as
+    gauges.  RingLogs are JSON-only (free-text events have no place in
+    the numeric exposition)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        # name -> list of (labels_dict, metric); list keeps insertion
+        # order so renders are stable
+        self._families: Dict[str, list] = {}
+
+    @staticmethod
+    def _label_key(labels: Optional[Dict[str, str]]):
+        return tuple(sorted((labels or {}).items()))
+
+    def register(self, name: str, metric, labels: Optional[Dict] = None):
+        """Register (or fetch) ``metric`` under (name, labels)."""
+        assert name, "metric name must be non-empty"
+        lk = self._label_key(labels)
+        with self._lock:
+            fam = self._families.setdefault(name, [])
+            for lbls, m in fam:
+                if self._label_key(lbls) == lk:
+                    if m is not metric:
+                        raise ValueError(
+                            f"metric {name!r} with labels {dict(lk)} is "
+                            "already registered to a different object"
+                        )
+                    return m
+            fam.append((dict(labels or {}), metric))
+            return metric
+
+    def get(self, name: str, labels: Optional[Dict] = None):
+        lk = self._label_key(labels)
+        with self._lock:
+            for lbls, m in self._families.get(name, []):
+                if self._label_key(lbls) == lk:
+                    return m
+        return None
+
+    def family(self, name: str):
+        """Every (labels, metric) registered under ``name`` — lets a
+        reader snapshot ONE family (e.g. the SLO windows) without
+        rendering the whole registry."""
+        with self._lock:
+            return [(dict(lbls), m) for lbls, m in
+                    self._families.get(name, [])]
+
+    def _get_or_create(self, name, labels, factory, kind):
+        existing = self.get(name, labels)
+        if existing is None:
+            try:
+                existing = self.register(name, factory(), labels)
+            except ValueError:
+                # lost a creation race to another thread (e.g. two
+                # workers both completing the first request of a new SLO
+                # class): use whoever won
+                existing = self.get(name, labels)
+        if not isinstance(existing, kind):
+            raise ValueError(
+                f"metric {name!r} already registered as "
+                f"{type(existing).__name__}, not {kind.__name__}"
+            )
+        return existing
+
+    # typed get-or-create helpers.  A repeat call with DIFFERENT
+    # construction parameters raises instead of silently handing back
+    # the first instance — same rationale as the object-conflict check:
+    # two writers thinking they own different configurations of one
+    # identity is how dashboards lie.
+
+    @staticmethod
+    def _check_params(name, existing, requested: Dict[str, Any]) -> None:
+        for attr, want in requested.items():
+            have = getattr(existing, attr)
+            if have != want and not (have is want):
+                raise ValueError(
+                    f"metric {name!r} already registered with "
+                    f"{attr}={have!r}; a second registration requested "
+                    f"{attr}={want!r}"
+                )
+
+    def counter(self, name: str, labels: Optional[Dict] = None) -> Counter:
+        return self._get_or_create(name, labels, Counter, Counter)
+
+    def histogram(self, name: str, labels: Optional[Dict] = None,
+                  lo: float = 1e-4, hi: float = 1e3) -> LatencyHistogram:
+        h = self._get_or_create(
+            name, labels, lambda: LatencyHistogram(lo, hi), LatencyHistogram
+        )
+        self._check_params(name, h, {"lo": lo, "hi": hi})
+        return h
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              labels: Optional[Dict] = None) -> Gauge:
+        g = self._get_or_create(name, labels, lambda: Gauge(fn), Gauge)
+        if fn is not None and g._fn is not fn:
+            raise ValueError(
+                f"gauge {name!r} is already registered with a different "
+                "callback — re-registering would silently drop one of them"
+            )
+        return g
+
+    def rolling(self, name: str, window: int = 512,
+                labels: Optional[Dict] = None) -> RollingQuantile:
+        rq = self._get_or_create(
+            name, labels, lambda: RollingQuantile(window), RollingQuantile
+        )
+        self._check_params(name, rq, {"window": window})
+        return rq
+
+    def gap(self, name: str, labels: Optional[Dict] = None) -> GapTracker:
+        return self._get_or_create(name, labels, GapTracker, GapTracker)
+
+    def ring(self, name: str, capacity: int = 16,
+             labels: Optional[Dict] = None) -> RingLog:
+        r = self._get_or_create(
+            name, labels, lambda: RingLog(capacity), RingLog
+        )
+        self._check_params(name, r, {"capacity": capacity})
+        return r
+
+    # renders ---------------------------------------------------------------
+
+    def _items(self):
+        with self._lock:
+            return [
+                (name, dict(lbls), m)
+                for name, fam in sorted(self._families.items())
+                for lbls, m in fam
+            ]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON snapshot: ``{name: [{"labels": {...}, "type": ...,
+        "data": snapshot()}, ...]}`` — one stable shape for artifacts and
+        the ``/metrics.json`` endpoint."""
+        out: Dict[str, Any] = {}
+        for name, lbls, m in self._items():
+            out.setdefault(name, []).append({
+                "labels": lbls,
+                "type": type(m).__name__,
+                "data": m.snapshot(),
+            })
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines: list = []
+        typed: set = set()
+
+        def labelstr(lbls: Dict[str, str], extra: Dict[str, str] = None):
+            merged = dict(lbls)
+            if extra:
+                merged.update(extra)
+            if not merged:
+                return ""
+            body = ",".join(
+                f'{_prom_name(k)}="{_prom_label_value(v)}"'
+                for k, v in sorted(merged.items())
+            )
+            return "{" + body + "}"
+
+        def emit_type(pname: str, kind: str):
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} {kind}")
+
+        for name, lbls, m in self._items():
+            pname = _prom_name(name)
+            if isinstance(m, Counter):
+                emit_type(pname, "counter")
+                for key, v in m.snapshot().items():
+                    lines.append(
+                        f"{pname}{labelstr(lbls, {'key': key})} "
+                        f"{_prom_value(v)}"
+                    )
+            elif isinstance(m, (LatencyHistogram, RollingQuantile)):
+                emit_type(pname, "summary")
+                snap = m.snapshot()
+                for q, qv in (("0.5", "p50"), ("0.9", "p90"),
+                              ("0.99", "p99")):
+                    if qv in snap:
+                        lines.append(
+                            f"{pname}{labelstr(lbls, {'quantile': q})} "
+                            f"{_prom_value(snap[qv])}"
+                        )
+                if isinstance(m, LatencyHistogram):
+                    # _sum comes from the SAME locked snapshot as the
+                    # count/quantiles — no torn cross-field reads
+                    lines.append(f"{pname}_sum{labelstr(lbls)} "
+                                 f"{_prom_value(snap.get('sum', 0.0))}")
+                lines.append(f"{pname}_count{labelstr(lbls)} "
+                             f"{_prom_value(snap.get('count', 0))}")
+            elif isinstance(m, GapTracker):
+                snap = m.snapshot()
+                for field in ("gap_fraction", "busy_s", "span_s",
+                              "intervals"):
+                    sub = f"{pname}_{field}"
+                    emit_type(sub, "gauge")
+                    lines.append(f"{sub}{labelstr(lbls)} "
+                                 f"{_prom_value(snap[field])}")
+            elif isinstance(m, Gauge):
+                emit_type(pname, "gauge")
+                lines.append(f"{pname}{labelstr(lbls)} "
+                             f"{_prom_value(m.value())}")
+            elif isinstance(m, RingLog):
+                continue  # free-text events: JSON render only
+            else:  # generic snapshot()-bearing object: flatten numerics
+                snap = m.snapshot()
+                if isinstance(snap, dict):
+                    for k, v in snap.items():
+                        if isinstance(v, (int, float)):
+                            sub = f"{pname}_{_prom_name(str(k))}"
+                            emit_type(sub, "gauge")
+                            lines.append(f"{sub}{labelstr(lbls)} "
+                                         f"{_prom_value(v)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricsHTTPEndpoint:
+    """Stdlib-only HTTP exposition for a metrics plane:
+
+    * ``GET /metrics`` — Prometheus text (``prom()``);
+    * ``GET /metrics.json`` — the JSON snapshot (``json_snapshot()``);
+    * ``GET /healthz`` — the health callback (503 when its ``status``
+      is not "ok"/"degraded" — liveness stays cheap and JSON).
+
+    ``port=0`` binds an ephemeral port (read ``.port`` after `start`).
+    The server runs ThreadingHTTPServer on a daemon thread: scrapes never
+    touch the scheduler thread, and all three callbacks must therefore be
+    any-thread-safe (the serve snapshots are, by construction)."""
+
+    def __init__(self, *, prom: Callable[[], str],
+                 json_snapshot: Optional[Callable[[], Dict]] = None,
+                 health: Optional[Callable[[], Dict]] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self._prom = prom
+        self._json = json_snapshot
+        self._health = health
+        self.host = host
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> "MetricsHTTPEndpoint":
+        import http.server
+        import json as json_mod
+        import threading
+
+        endpoint = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: D102 — scrape spam
+                pass
+
+            def _send(self, code: int, body: str, ctype: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                try:
+                    if self.path in ("/metrics", "/metrics/"):
+                        self._send(200, endpoint._prom(),
+                                   "text/plain; version=0.0.4")
+                    elif self.path == "/metrics.json" and endpoint._json:
+                        self._send(
+                            200,
+                            json_mod.dumps(endpoint._json(), sort_keys=True),
+                            "application/json")
+                    elif self.path == "/healthz" and endpoint._health:
+                        h = endpoint._health()
+                        ok = h.get("status") in ("ok", "degraded")
+                        self._send(200 if ok else 503,
+                                   json_mod.dumps(h, sort_keys=True),
+                                   "application/json")
+                    else:
+                        self._send(404, "not found\n", "text/plain")
+                except Exception as exc:  # noqa: BLE001 — scrape != crash
+                    try:
+                        self._send(500, f"{type(exc).__name__}: {exc}\n",
+                                   "text/plain")
+                    except Exception:
+                        pass
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="distrifuser-metrics-http", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
 
 
 def fid_between_dirs(

@@ -95,11 +95,13 @@ def main():
             except Exception as e:  # noqa: BLE001
                 results["pallas_upstream"] = f"failed: {type(e).__name__}"
 
+        from common import emit_bench_line
+
         for impl, ms in results.items():
-            print(json.dumps({
+            emit_bench_line({
                 "impl": impl, "L": L, "heads": H,
                 "ms": round(ms, 3) if isinstance(ms, float) else ms,
-            }), flush=True)
+            })
 
 
 if __name__ == "__main__":

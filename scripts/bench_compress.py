@@ -151,10 +151,9 @@ def main() -> None:
             if mode == "int8":
                 ok &= (rec["stale_byte_reduction"] or 0) >= 1.9
     line["ok"] = bool(ok)
-    print(json.dumps(line), flush=True)
-    if args.out:
-        with open(args.out, "a") as f:
-            f.write(json.dumps(line) + "\n")
+    from common import emit_bench_line
+
+    emit_bench_line(line, args.out)
     if not ok:
         sys.exit(1)
 

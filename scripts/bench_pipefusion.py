@@ -198,10 +198,9 @@ def main() -> None:
     ok = bool(plan_matches and byte_ratio is not None
               and byte_ratio >= args.byte_gate)
     line["ok"] = ok
-    print(json.dumps(line), flush=True)
-    if args.out:
-        with open(args.out, "a") as f:
-            f.write(json.dumps(line) + "\n")
+    from common import emit_bench_line
+
+    emit_bench_line(line, args.out)
     if not ok:
         sys.exit(1)
 

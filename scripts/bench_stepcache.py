@@ -109,10 +109,9 @@ def main() -> None:
         "speedup": round(on / off, 3),
         "flops": runner_on._flop_estimate(),
     }
-    print(json.dumps(line), flush=True)
-    if args.out:
-        with open(args.out, "a") as f:
-            f.write(json.dumps(line) + "\n")
+    from common import emit_bench_line
+
+    emit_bench_line(line, args.out)
 
 
 if __name__ == "__main__":

@@ -212,10 +212,9 @@ def main() -> None:
         "families": per_family,
         "ok": bool(ok),
     }
-    print(json.dumps(line), flush=True)
-    if args.out:
-        with open(args.out, "a") as f:
-            f.write(json.dumps(line) + "\n")
+    from common import emit_bench_line
+
+    emit_bench_line(line, args.out)
     if not ok:
         sys.exit(1)
 

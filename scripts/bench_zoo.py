@@ -28,8 +28,11 @@ START = time.time()
 
 
 def emit(phase, **kv):
-    print(json.dumps({"phase": phase, "t": round(time.time() - START, 1),
-                      **kv}), flush=True)
+    from common import BENCH_SCHEMA_VERSION
+
+    print(json.dumps({"schema": BENCH_SCHEMA_VERSION, "phase": phase,
+                      "t": round(time.time() - START, 1), **kv}),
+          flush=True)
 
 
 def main():

@@ -36,16 +36,23 @@ from distrifuser_tpu.serve import (  # noqa: E402
     FaultPlan,
     FaultRule,
     InferenceServer,
+    ObservabilityConfig,
     ResilienceConfig,
     ServeConfig,
 )
+from common import emit_bench_line  # noqa: E402
 from distrifuser_tpu.serve.testing import FakeExecutorFactory  # noqa: E402
 
 import serve_bench  # noqa: E402  (shared load driver — 1:1 comparable runs)
 
 
-def _serve_config(args, *, breaker_threshold: int) -> ServeConfig:
+def _serve_config(args, *, breaker_threshold: int,
+                  trace: bool = False) -> ServeConfig:
     return ServeConfig(
+        # tracing only where the trace is actually exported (the mixed
+        # phase): the poison phase's gated shed-latency measurements run
+        # untraced, exactly as before this flag existed
+        observability=ObservabilityConfig(trace=trace),
         max_queue_depth=args.max_queue_depth,
         max_batch_size=args.max_batch_size,
         batch_window_s=0.01,
@@ -75,7 +82,8 @@ def run_mixed_phase(args) -> dict:
     ], seed=args.seed)
     # the breaker counts TERMINAL dispatch failures (retries exhausted),
     # not attempts, so a plain threshold of 3 is already storm-safe here
-    config = _serve_config(args, breaker_threshold=3)
+    config = _serve_config(args, breaker_threshold=3,
+                           trace=bool(getattr(args, "trace_out", None)))
     factory = FakeExecutorFactory(batch_size=args.max_batch_size,
                                   step_time_s=0.002)
     load_args = argparse.Namespace(
@@ -89,6 +97,15 @@ def run_mixed_phase(args) -> dict:
         load = serve_bench.run_load(server, load_args)
         metrics = server.metrics_snapshot()
         health = server.health()
+    # the chaos trace is the interesting one: retries, breaker trips,
+    # and degradations all land on the resilience/scheduler tracks
+    if getattr(args, "trace_out", None) and server.tracer is not None:
+        server.tracer.export(args.trace_out)
+    if getattr(args, "registry_out", None):
+        with open(args.registry_out, "w") as f:
+            json.dump(server.registry.snapshot(), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
     return {
         "load": load,
         "metrics": metrics,
@@ -177,6 +194,12 @@ def main(argv=None) -> int:
                     help="phase-2 gate: slowest post-trip poisoned request")
     ap.add_argument("--out", type=str, default=None,
                     help="write the full JSON artifact here")
+    ap.add_argument("--trace_out", type=str, default=None,
+                    help="enable request-scoped tracing for the mixed "
+                         "phase and write the Perfetto trace JSON here")
+    ap.add_argument("--registry_out", type=str, default=None,
+                    help="write the mixed phase's MetricsRegistry JSON "
+                         "snapshot here")
     args = ap.parse_args(argv)
 
     mixed = run_mixed_phase(args)
@@ -212,7 +235,7 @@ def main(argv=None) -> int:
             json.dump(artifact, f, indent=2, sort_keys=True)
             f.write("\n")
     # bench.py contract: one parseable summary line on stdout
-    print(json.dumps({
+    emit_bench_line({
         "metric": "chaos_availability",
         "value": round(availability, 4),
         "unit": "fraction",
@@ -228,7 +251,7 @@ def main(argv=None) -> int:
         "poison_shed_max_s": poison["shed_max_s"],
         "faults_fired": mixed["faults_fired"],
         "ok": ok,
-    }))
+    })
     return 0 if ok else 1
 
 

@@ -10,23 +10,38 @@ useful for latency benchmarks, which don't depend on weight values).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
+# Heavy imports (jax + the model stack) are deferred into the functions
+# that need them: scheduler-only scripts (serve_bench --dry-run,
+# chaos_bench) import this module for the flag surface and the bench-line
+# emitter, and must not pay — or depend on — a model-stack import.
 
-from distrifuser_tpu import DistriConfig
-from distrifuser_tpu.models import clip as clip_mod
-from distrifuser_tpu.models import unet as unet_mod
-from distrifuser_tpu.models import vae as vae_mod
-from distrifuser_tpu.pipelines import (
-    DistriSD3Pipeline,
-    DistriSDPipeline,
-    DistriSDXLPipeline,
-)
+
+# Version of the one-line JSON bench contract every scripts/bench_*.py
+# (and serve_bench/chaos_bench) summary line carries as ``"schema"``:
+# bump when a line's field semantics change incompatibly, so downstream
+# trajectory tooling can parse historical artifacts stably.
+BENCH_SCHEMA_VERSION = 1
+
+
+def emit_bench_line(line: dict, out: str = None, mode: str = "a") -> dict:
+    """The bench.py one-parseable-line contract, versioned: prepend
+    ``"schema": BENCH_SCHEMA_VERSION``, print exactly one JSON line to
+    stdout (flushed — a timeout must not eat it), and optionally write
+    the same line to ``out`` (append by default, matching the bench
+    scripts' historical JSON-lines artifacts).  Returns the record."""
+    rec = {"schema": BENCH_SCHEMA_VERSION}
+    rec.update(line)
+    print(json.dumps(rec), flush=True)
+    if out:
+        with open(out, mode) as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
 
 
 def add_distri_args(parser: argparse.ArgumentParser) -> None:
@@ -122,6 +137,10 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
 
 
 def config_from_args(args) -> DistriConfig:
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+
     size = args.image_size
     if isinstance(size, int):
         h = w = size
@@ -192,6 +211,13 @@ def save_images(output, args) -> None:
 
 def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler,
                           tiny: bool = False) -> DistriSDXLPipeline:
+    import jax
+
+    from distrifuser_tpu.models import clip as clip_mod
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.models import vae as vae_mod
+    from distrifuser_tpu.pipelines import DistriSDXLPipeline
+
     if tiny:
         ucfg = unet_mod.tiny_config(sdxl=True)
         vcfg = vae_mod.tiny_vae_config()
@@ -219,6 +245,13 @@ def _random_sdxl_pipeline(distri_config: DistriConfig, scheduler,
 
 def _random_sd_pipeline(distri_config: DistriConfig, scheduler,
                         tiny: bool = False) -> DistriSDPipeline:
+    import jax
+
+    from distrifuser_tpu.models import clip as clip_mod
+    from distrifuser_tpu.models import unet as unet_mod
+    from distrifuser_tpu.models import vae as vae_mod
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+
     if tiny:
         ucfg = unet_mod.tiny_config()
         vcfg = vae_mod.tiny_vae_config()
@@ -238,6 +271,8 @@ def _random_sd_pipeline(distri_config: DistriConfig, scheduler,
 
 
 def load_sdxl_pipeline(args, distri_config: DistriConfig, scheduler=None) -> DistriSDXLPipeline:
+    from distrifuser_tpu.pipelines import DistriSDXLPipeline
+
     scheduler = scheduler or args.scheduler
     if args.model_path:
         return DistriSDXLPipeline.from_pretrained(
@@ -274,7 +309,12 @@ def _random_sd3_pipeline(distri_config: DistriConfig, scheduler,
                          tiny: bool = False) -> DistriSD3Pipeline:
     import dataclasses
 
+    import jax
+
+    from distrifuser_tpu.models import clip as clip_mod
     from distrifuser_tpu.models import mmdit as mmdit_mod
+    from distrifuser_tpu.models import vae as vae_mod
+    from distrifuser_tpu.pipelines import DistriSD3Pipeline
 
     if tiny:
         mcfg = mmdit_mod.tiny_mmdit_config()
@@ -311,6 +351,8 @@ def _random_sd3_pipeline(distri_config: DistriConfig, scheduler,
 
 def load_sd3_pipeline(args, distri_config: DistriConfig,
                       scheduler=None) -> DistriSD3Pipeline:
+    from distrifuser_tpu.pipelines import DistriSD3Pipeline
+
     scheduler = scheduler or args.scheduler
     if args.model_path:
         return DistriSD3Pipeline.from_pretrained(
@@ -324,6 +366,8 @@ def load_sd3_pipeline(args, distri_config: DistriConfig,
 
 
 def load_sd_pipeline(args, distri_config: DistriConfig, scheduler=None) -> DistriSDPipeline:
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+
     scheduler = scheduler or args.scheduler
     if args.model_path:
         return DistriSDPipeline.from_pretrained(
@@ -336,4 +380,6 @@ def load_sd_pipeline(args, distri_config: DistriConfig, scheduler=None) -> Distr
 
 def is_main_process() -> bool:
     """Rank-0 gating parity (reference: distri_config.rank == 0)."""
+    import jax
+
     return jax.process_index() == 0

@@ -45,9 +45,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distrifuser_tpu.serve import (  # noqa: E402
     InferenceServer,
+    ObservabilityConfig,
     QueueFullError,
     ServeConfig,
 )
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import emit_bench_line  # noqa: E402
 
 PROMPTS = (
     "a photo of an astronaut riding a horse",
@@ -277,6 +281,13 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default=None,
                     help="write the full JSON artifact here")
+    ap.add_argument("--trace_out", type=str, default=None,
+                    help="enable request-scoped tracing and write the "
+                         "Perfetto-loadable trace JSON here (with "
+                         "--stages: the staged run's trace)")
+    ap.add_argument("--registry_out", type=str, default=None,
+                    help="write the unified MetricsRegistry JSON "
+                         "snapshot here (docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
 
     def parse_hw(spec):
@@ -284,7 +295,7 @@ def main(argv=None) -> int:
             tuple(int(x) for x in b.split("x")) for b in spec.split(",") if b
         )
 
-    def run_one(staged: bool):
+    def run_one(staged: bool, observe: bool = True):
         config = ServeConfig(
             max_queue_depth=args.max_queue_depth,
             max_batch_size=args.max_batch_size,
@@ -297,6 +308,9 @@ def main(argv=None) -> int:
             default_ttl_s=args.ttl_s,
             pipeline_stages=staged,
             max_inflight_batches=args.max_inflight,
+            observability=ObservabilityConfig(
+                trace=bool(args.trace_out) and observe,
+            ),
         )
         if args.dry_run:
             factory, mesh_plan = _make_dry_factory(args)
@@ -311,6 +325,15 @@ def main(argv=None) -> int:
         with server:
             load = run_load(server, args)
             metrics = server.metrics_snapshot()
+        # observability artifacts ride next to the bench JSON: the
+        # Perfetto trace of this run and the unified-registry snapshot
+        if observe and args.trace_out and server.tracer is not None:
+            server.tracer.export(args.trace_out)
+        if observe and args.registry_out:
+            with open(args.registry_out, "w") as f:
+                json.dump(server.registry.snapshot(), f, indent=2,
+                          sort_keys=True)
+                f.write("\n")
         return load, metrics
 
     bench_block = {
@@ -331,7 +354,7 @@ def main(argv=None) -> int:
         # so the artifact records the overlap as a measured ratio, not an
         # assertion (acceptance gate: >= --gate_ratio throughput, OR the
         # denoise-gap fraction at least halved vs the serial stage shares)
-        mono_load, mono_metrics = run_one(staged=False)
+        mono_load, mono_metrics = run_one(staged=False, observe=False)
         staged_load, staged_metrics = run_one(staged=True)
         ratio = (staged_load["throughput_rps"] / mono_load["throughput_rps"]
                  if mono_load["throughput_rps"] > 0 else 0.0)
@@ -358,7 +381,7 @@ def main(argv=None) -> int:
             with open(args.out, "w") as f:
                 json.dump(artifact, f, indent=2, sort_keys=True)
                 f.write("\n")
-        print(json.dumps({
+        emit_bench_line({
             "metric": "serve_staged_throughput_ratio",
             "value": round(ratio, 3),
             "unit": "x",
@@ -369,7 +392,7 @@ def main(argv=None) -> int:
             "availability": round(staged_load["availability"], 4),
             "peak_inflight": staging["peak_inflight"],
             "completed": staged_load["completed"],
-        }))
+        })
         if args.gate_ratio > 0:
             gap_halved = (serial_gap > 0
                           and gap_fraction <= serial_gap / 2.0)
@@ -398,7 +421,7 @@ def main(argv=None) -> int:
     # retry, and shed counts ride along so chaos_bench.py runs (same load
     # driver, a fault plan underneath) compare 1:1 with clean runs.
     reqs = metrics["requests"]
-    print(json.dumps({
+    emit_bench_line({
         "metric": f"serve_{args.mode}_loop_throughput",
         "value": round(load["throughput_rps"], 3),
         "unit": "requests/s",
@@ -411,7 +434,7 @@ def main(argv=None) -> int:
         "rejected_queue_full": load["rejected_queue_full"],
         "cache_hit_rate": round(metrics["cache"]["hit_rate"], 3),
         "mean_batch_size": round(metrics["batch_size"]["mean"], 3),
-    }))
+    })
     return 0
 
 

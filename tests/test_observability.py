@@ -1,0 +1,712 @@
+"""End-to-end tracing + unified metrics plane (PR 8, docs/OBSERVABILITY.md):
+
+* `utils.trace.Tracer` — span/event model, ring bound, Perfetto export,
+  and byte-identical determinism under an injected clock;
+* `utils.metrics.MetricsRegistry` / `RollingQuantile` / `Gauge` — the
+  registry semantics, Prometheus text render, SLO windows, and the
+  thread-safety audit (concurrent-mutation tests for every primitive);
+* `InferenceServer` integration — every completed request carries
+  enqueue -> coalesce -> execute -> complete spans, batch spans link
+  their members, retries/splits/stages/deadlines leave their marks,
+  `slo_snapshot()` exposes the controller interface, and the
+  ``--metrics_port`` endpoint serves the registry;
+* the per-step denoise timeline — live comm-byte counters reconciled
+  EXACTLY against the closed-form `pipelines.comm_plan` (the byte model
+  as a checked invariant).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distrifuser_tpu.serve import (
+    FaultPlan,
+    FaultRule,
+    InferenceServer,
+    ObservabilityConfig,
+    ResilienceConfig,
+    ServeConfig,
+)
+from distrifuser_tpu.serve.testing import (
+    FakeExecutorFactory,
+    StagedFakeExecutorFactory,
+)
+from distrifuser_tpu.utils.metrics import (
+    Counter,
+    GapTracker,
+    Gauge,
+    LatencyHistogram,
+    MetricsRegistry,
+    RollingQuantile,
+)
+from distrifuser_tpu.utils.trace import StepTimeline, Tracer
+
+
+class FakeClock:
+    """Deterministic injectable clock: every call advances by ``tick``.
+    Thread-safe so tracer/scheduler/client calls serialize cleanly."""
+
+    def __init__(self, start=100.0, tick=0.001):
+        self.t = start
+        self.tick = tick
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            self.t += self.tick
+            return self.t
+
+
+# ---------------------------------------------------------------------------
+# Tracer unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_span_and_event_roundtrip():
+    clk = FakeClock()
+    tr = Tracer(clock=clk)
+    t0 = tr.new_trace()
+    root = tr.begin("request", track="req/1", trace=t0)
+    tr.event("enqueue", track="req/1", trace=t0)
+    child = tr.begin("queue_wait", track="req/1", trace=t0, parent=root)
+    tr.end(child)
+    tr.end(root, args={"outcome": "completed"})
+    evs = tr.export()["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # ordered by start ts: the root opens before its child
+    assert [e["name"] for e in xs] == ["request", "queue_wait"]
+    req = next(e for e in xs if e["name"] == "request")
+    qw = next(e for e in xs if e["name"] == "queue_wait")
+    assert req["args"]["outcome"] == "completed"
+    assert qw["args"]["parent"] == root
+    # containment: the child lies inside the parent
+    assert req["ts"] <= qw["ts"]
+    assert qw["ts"] + qw["dur"] <= req["ts"] + req["dur"]
+    # metadata names the track
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               and e["args"]["name"] == "req/1" for e in evs)
+    assert any(e["ph"] == "i" and e["name"] == "enqueue" for e in evs)
+
+
+def test_tracer_end_is_idempotent_and_tolerant():
+    tr = Tracer(clock=FakeClock())
+    sid = tr.begin("x", track="t")
+    tr.end(sid)
+    tr.end(sid)  # double-close: no-op
+    tr.end(None)  # unknown: no-op
+    tr.end(99999)
+    assert len([e for e in tr.export()["traceEvents"]
+                if e["ph"] == "X"]) == 1
+
+
+def test_tracer_ring_capacity_drops_oldest_and_counts():
+    tr = Tracer(clock=FakeClock(), capacity=4)
+    for i in range(10):
+        tr.event(f"e{i}", track="t")
+    assert tr.dropped == 6
+    names = [e["name"] for e in tr.export()["traceEvents"]
+             if e["ph"] == "i"]
+    assert names == ["e6", "e7", "e8", "e9"]
+    assert tr.stats()["dropped"] == 6
+
+
+def test_tracer_open_spans_export_as_begin_events():
+    tr = Tracer(clock=FakeClock())
+    tr.begin("inflight", track="t")
+    evs = tr.export()["traceEvents"]
+    assert any(e["ph"] == "B" and e["name"] == "inflight" for e in evs)
+
+
+def test_tracer_export_deterministic():
+    """Same injected clock + same call sequence => byte-identical JSON."""
+
+    def run(path):
+        clk = FakeClock()
+        tr = Tracer(clock=clk)
+        for i in range(5):
+            t = tr.new_trace()
+            s = tr.begin("request", track=f"req/{t}", trace=t,
+                         args={"i": i})
+            tr.event("enqueue", track=f"req/{t}", trace=t)
+            tr.complete("execute", clk(), clk(), track=f"req/{t}",
+                        trace=t, parent=s)
+            tr.end(s)
+        tr.export(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    assert run("/tmp/_obs_det_a.json") == run("/tmp/_obs_det_b.json")
+
+
+# ---------------------------------------------------------------------------
+# StepTimeline unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_step_timeline_phases_and_bytes():
+    clk = FakeClock(tick=0.5)
+    tl = StepTimeline(clock=clk)
+    phase_of = lambda i: ("warmup" if i < 2  # noqa: E731
+                          else ("shallow" if i % 2 else "full"))
+    tl.begin_run(6, phase_of,
+                 bytes_per_step={"sync": 100, "stale": 50, "shallow": 7})
+    for i in range(6):
+        tl.on_step(i)
+    tl.end_run()
+    snap = tl.snapshot()
+    assert snap["phase_steps"] == {"warmup": 2, "full": 2, "shallow": 2}
+    assert snap["comm_bytes"] == 2 * 100 + 2 * 50 + 2 * 7
+    assert tl.comm_bytes == snap["comm_bytes"]
+    # every step's wall time is one clock tick
+    for rec in snap["per_run"][0]["steps"]:
+        assert rec["wall_s"] == pytest.approx(0.5)
+
+
+def test_step_timeline_untracked_bytes():
+    tl = StepTimeline(clock=FakeClock())
+    tl.begin_run(2, lambda i: "full", bytes_per_step=None)
+    tl.on_step(0)
+    tl.on_step(1)
+    tl.end_run()
+    snap = tl.snapshot()
+    assert snap["comm_bytes"] == 0 and snap["comm_bytes_tracked"] is False
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry / RollingQuantile / Gauge
+# ---------------------------------------------------------------------------
+
+
+def test_registry_get_or_create_returns_same_instance():
+    r = MetricsRegistry()
+    a = r.counter("serve_requests")
+    b = r.counter("serve_requests")
+    assert a is b
+    h1 = r.histogram("lat", labels={"phase": "e2e"})
+    h2 = r.histogram("lat", labels={"phase": "e2e"})
+    h3 = r.histogram("lat", labels={"phase": "exec"})
+    assert h1 is h2 and h1 is not h3
+
+
+def test_registry_rejects_conflicting_registration():
+    r = MetricsRegistry()
+    r.register("m", Counter())
+    with pytest.raises(ValueError, match="already registered"):
+        r.register("m", Counter())  # different object, same identity
+    with pytest.raises(ValueError, match="already registered as"):
+        r.histogram("m")  # same identity, different type
+
+
+def test_registry_prometheus_render():
+    r = MetricsRegistry()
+    r.counter("serve_requests").inc("completed", 7)
+    h = r.histogram("serve_latency_seconds", labels={"phase": "e2e"})
+    h.observe(0.25)
+    r.gauge("serve_queue_depth", lambda: 3)
+    r.rolling("serve_slo_e2e_seconds",
+              labels={"slo_class": "interactive"}).observe(1.5)
+    g = r.gap("serve_denoise_gap")
+    g.begin(0.0)
+    g.end(1.0)
+    r.ring("serve_last_errors").add("boom")
+    text = r.to_prometheus()
+    assert '# TYPE serve_requests counter' in text
+    assert 'serve_requests{key="completed"} 7' in text
+    assert '# TYPE serve_latency_seconds summary' in text
+    assert 'serve_latency_seconds{phase="e2e",quantile="0.5"}' in text
+    assert 'serve_latency_seconds_count{phase="e2e"} 1' in text
+    assert 'serve_queue_depth 3' in text
+    assert ('serve_slo_e2e_seconds{quantile="0.99",'
+            'slo_class="interactive"}') in text
+    assert 'serve_denoise_gap_gap_fraction 0' in text
+    assert "boom" not in text  # ring logs are JSON-only
+    snap = r.snapshot()
+    assert snap["serve_last_errors"][0]["data"][0]["message"] == "boom"
+
+
+def test_registry_gauge_callback_failure_is_nan():
+    r = MetricsRegistry()
+    r.gauge("bad", lambda: 1 / 0)
+    assert "bad NaN" in r.to_prometheus()
+
+
+def test_rolling_quantile_window_semantics():
+    rq = RollingQuantile(window=10)
+    for v in range(100):
+        rq.observe(float(v))
+    snap = rq.snapshot()
+    assert snap["count"] == 100 and snap["window"] == 10
+    # only the last 10 observations (90..99) remain
+    assert snap["p50"] >= 90.0
+    assert rq.quantile(0.0) == 90.0
+    assert rq.quantile(1.0) == 99.0
+
+
+def test_gauge_set_and_callback_modes():
+    g = Gauge()
+    g.set(4.5)
+    assert g.value() == 4.5
+    cb = Gauge(lambda: 7.0)
+    assert cb.value() == 7.0
+    with pytest.raises(AssertionError):
+        cb.set(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Thread-safety audit (PR-8 satellite): every primitive survives
+# concurrent mutation with EXACT final counts.
+# ---------------------------------------------------------------------------
+
+
+def _hammer(n_threads, fn):
+    errs = []
+
+    def run():
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    ts = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_concurrent_counter_mutation_is_exact():
+    c = Counter()
+    _hammer(8, lambda: [c.inc("x") for _ in range(2000)])
+    assert c.get("x") == 16000
+
+
+def test_concurrent_histogram_mutation_and_reads():
+    h = LatencyHistogram()
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            h.snapshot()
+            h.quantile(0.99)
+            _ = h.mean
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    try:
+        _hammer(6, lambda: [h.observe(0.01) for _ in range(2000)])
+    finally:
+        stop.set()
+        for t in readers:
+            t.join()
+    snap = h.snapshot()
+    assert snap["count"] == 12000
+    assert snap["min"] == snap["max"] == pytest.approx(0.01)
+
+
+def test_concurrent_rolling_quantile_mutation_is_exact():
+    rq = RollingQuantile(window=64)
+    _hammer(8, lambda: [rq.observe(1.0) for _ in range(1000)])
+    snap = rq.snapshot()
+    assert snap["count"] == 8000 and snap["window"] == 64
+    assert snap["p99"] == 1.0
+
+
+def test_concurrent_gap_tracker_snapshot_reads():
+    g = GapTracker()
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            g.snapshot()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(3000):  # single writer by contract
+            g.begin(float(i))
+            g.end(float(i) + 0.5)
+    finally:
+        stop.set()
+        t.join()
+    snap = g.snapshot()
+    assert snap["intervals"] == 3000
+    assert snap["busy_s"] == pytest.approx(1500.0)
+
+
+def test_concurrent_registry_creation_race():
+    r = MetricsRegistry()
+    got = []
+
+    def create():
+        got.append(r.rolling("slo", labels={"slo_class": "a"}))
+
+    _hammer(8, create)
+    assert all(g is got[0] for g in got)
+
+
+# ---------------------------------------------------------------------------
+# Server integration
+# ---------------------------------------------------------------------------
+
+
+def _traced_server(clock=None, **cfg_kw):
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("batch_window_s", 0.0)
+    cfg_kw.setdefault("buckets", ((512, 512), (1024, 1024)))
+    cfg_kw.setdefault("default_steps", 4)
+    cfg_kw.setdefault(
+        "observability", ObservabilityConfig(trace=True))
+    config = ServeConfig(**cfg_kw)
+    factory = cfg_kw.pop("_factory", None) or FakeExecutorFactory(
+        batch_size=config.max_batch_size)
+    kw = {}
+    if clock is not None:
+        kw["clock"] = clock
+    server = InferenceServer(factory, config, model_id="m",
+                             scheduler="ddim", mesh_plan="dp1.cfg1.sp1",
+                             **kw)
+    return server
+
+
+def _spans(tracer, name=None):
+    evs = tracer.export()["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    return [e for e in xs if name is None or e["name"] == name]
+
+
+def _events(tracer, name=None):
+    evs = tracer.export()["traceEvents"]
+    ins = [e for e in evs if e["ph"] == "i"]
+    return [e for e in ins if name is None or e["name"] == name]
+
+
+def test_every_completed_request_has_full_span_chain():
+    server = _traced_server()
+    with server:
+        futs = [server.submit(f"p{i}", height=512, width=512, seed=i)
+                for i in range(5)]
+        for f in futs:
+            f.result(timeout=30)
+    tr = server.tracer
+    roots = _spans(tr, "request")
+    assert len(roots) == 5
+    by_trace = {r["args"]["trace"]: r for r in roots}
+    queue_spans = {s["args"]["trace"]: s for s in _spans(tr, "queue_wait")}
+    exec_spans = {s["args"]["trace"]: s for s in _spans(tr, "execute")}
+    enq = {e["args"]["trace"] for e in _events(tr, "enqueue")}
+    coal = {e["args"]["trace"] for e in _events(tr, "coalesce")}
+    comp = {e["args"]["trace"] for e in _events(tr, "complete")}
+    for t, root in by_trace.items():
+        # the acceptance chain: enqueue -> coalesce -> execute -> complete
+        assert t in enq and t in coal and t in comp
+        assert root["args"]["outcome"] == "completed"
+        q = queue_spans[t]
+        x = exec_spans[t]
+        # parent/child integrity + time containment inside the root
+        assert q["args"]["parent"] == root["args"]["span"]
+        assert x["args"]["parent"] == root["args"]["span"]
+        assert root["ts"] <= q["ts"]
+        assert x["ts"] + x["dur"] <= root["ts"] + root["dur"]
+    # batch spans link their members by trace id
+    batch_traces = set()
+    for b in _spans(tr, "batch"):
+        batch_traces.update(b["args"]["traces"])
+    assert batch_traces == set(by_trace)
+
+
+def test_trace_determinism_byte_identical_runs():
+    """Same injected clock + same sequential load => byte-identical
+    Perfetto exports across two fresh servers."""
+
+    def run(path):
+        server = _traced_server(clock=FakeClock())
+        with server:
+            for i in range(4):
+                server.submit(f"p{i}", height=512, width=512,
+                              seed=i).result(timeout=30)
+                # quiesce: the scheduler's last clock touch for a batch
+                # precedes the inflight decrement (server contract)
+                deadline = time.monotonic() + 10
+                while (server._inflight_c.get("requests")
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+        server.tracer.export(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    a = run("/tmp/_obs_srv_a.json")
+    b = run("/tmp/_obs_srv_b.json")
+    assert a == b
+
+
+def test_trace_retry_marks_and_single_terminal_outcome():
+    plan = FaultPlan([
+        FaultRule(site="execute", kind="execute_error", at_calls=(0,)),
+    ], seed=0)
+    config = ServeConfig(
+        max_batch_size=2, batch_window_s=0.0, buckets=((512, 512),),
+        default_steps=4,
+        observability=ObservabilityConfig(trace=True),
+        resilience=ResilienceConfig(max_retries=2, backoff_base_s=0.0,
+                                    backoff_max_s=0.0, backoff_jitter=0.0),
+    )
+    server = InferenceServer(FakeExecutorFactory(batch_size=2), config,
+                             model_id="m", scheduler="ddim",
+                             mesh_plan="dp1.cfg1.sp1", fault_plan=plan)
+    with server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+    tr = server.tracer
+    retries = _events(tr, "retry")
+    assert len(retries) == 1
+    assert retries[0]["args"]["error"] == "ExecuteFailedError"
+    roots = _spans(tr, "request")
+    assert len(roots) == 1 and roots[0]["args"]["outcome"] == "completed"
+    assert roots[0]["args"]["retries"] == 1
+
+
+def test_trace_split_batch_halves_complete():
+    # every batch >= 2 OOMs: the ladder splits, halves of one succeed
+    plan = FaultPlan([
+        FaultRule(site="execute", kind="oom", p=1.0, min_batch=2),
+    ], seed=0)
+    config = ServeConfig(
+        max_batch_size=4, batch_window_s=0.3, buckets=((512, 512),),
+        default_steps=4,
+        observability=ObservabilityConfig(trace=True),
+        resilience=ResilienceConfig(max_retries=4, backoff_base_s=0.0,
+                                    backoff_max_s=0.0, backoff_jitter=0.0),
+    )
+    server = InferenceServer(FakeExecutorFactory(batch_size=4), config,
+                             model_id="m", scheduler="ddim",
+                             mesh_plan="dp1.cfg1.sp1", fault_plan=plan)
+    with server:
+        futs = [server.submit(f"p{i}", height=512, width=512, seed=i)
+                for i in range(4)]
+        results = [f.result(timeout=30) for f in futs]
+    assert all(r.output is not None for r in results)
+    tr = server.tracer
+    assert len(_events(tr, "split_batch")) >= 1
+    roots = _spans(tr, "request")
+    assert (len(roots) == 4
+            and all(r["args"]["outcome"] == "completed" for r in roots))
+
+
+def test_trace_staged_stage_spans():
+    factory = StagedFakeExecutorFactory(batch_size=4, encode_s=0.005,
+                                        step_time_s=0.001, decode_s=0.005)
+    config = ServeConfig(
+        max_batch_size=4, batch_window_s=0.0, buckets=((512, 512),),
+        default_steps=4, pipeline_stages=True,
+        observability=ObservabilityConfig(trace=True),
+    )
+    server = InferenceServer(factory, config, model_id="m",
+                             scheduler="ddim", mesh_plan="dp1.cfg1.sp1")
+    with server:
+        futs = [server.submit(f"p{i}", height=512, width=512, seed=i)
+                for i in range(3)]
+        for f in futs:
+            f.result(timeout=30)
+    tr = server.tracer
+    for stage in ("encode", "denoise", "decode"):
+        spans = _spans(tr, stage)
+        assert spans, f"no {stage} spans"
+        assert all(s["args"]["traces"] for s in spans)
+    roots = _spans(tr, "request")
+    assert (len(roots) == 3
+            and all(r["args"]["outcome"] == "completed" for r in roots))
+
+
+def test_trace_deadline_rejection_outcome():
+    server = _traced_server()
+    with server:
+        f = server.submit("late", height=512, width=512, ttl_s=1e-9)
+        with pytest.raises(Exception):
+            f.result(timeout=30)
+        # a live request afterwards still completes
+        server.submit("ok", height=512, width=512).result(timeout=30)
+    tr = server.tracer
+    outcomes = {r["args"]["outcome"] for r in _spans(tr, "request")}
+    assert "deadline_exceeded" in outcomes and "completed" in outcomes
+    assert tr.stats()["open_spans"] == 0
+
+
+def test_slo_snapshot_and_gauges():
+    clock = FakeClock()
+    server = _traced_server(clock=clock)
+    with server:
+        futs = [
+            server.submit(f"p{i}", height=512, width=512, seed=i,
+                          slo_class="interactive" if i % 2 else "batch")
+            for i in range(6)
+        ]
+        for f in futs:
+            f.result(timeout=30)
+        snap = server.slo_snapshot()
+    assert set(snap["classes"]) == {"interactive", "batch"}
+    for cls in ("interactive", "batch"):
+        data = snap["classes"][cls]
+        assert data["count"] == 3
+        assert data["p50"] > 0 and data["p99"] >= data["p50"]
+    assert snap["queue_depth"] == 0
+    assert snap["inflight_requests"] == 0
+    assert snap["slo_window"] == 512
+    # the registry carries the same signals for /metrics scrapers
+    prom = server.metrics_prometheus()
+    assert 'serve_slo_e2e_seconds' in prom
+    assert 'serve_queue_depth 0' in prom
+
+
+def test_metrics_endpoint_serves_registry():
+    server = _traced_server(
+        observability=ObservabilityConfig(trace=False, metrics_port=0))
+    with server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        ep = server.metrics_endpoint
+        assert ep is not None and ep.port > 0
+        prom = urllib.request.urlopen(
+            ep.url + "/metrics", timeout=10).read().decode()
+        assert 'serve_requests{key="completed"} 1' in prom
+        body = urllib.request.urlopen(
+            ep.url + "/metrics.json", timeout=10).read().decode()
+        assert "serve_requests" in json.loads(body)
+        health = json.loads(urllib.request.urlopen(
+            ep.url + "/healthz", timeout=10).read().decode())
+        assert health["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ep.url + "/nope", timeout=10)
+    # endpoint stops with the server
+    assert server.metrics_endpoint is None
+
+
+def test_metrics_snapshot_observability_section_and_tracing_off():
+    server = _traced_server(observability=ObservabilityConfig(trace=False))
+    with server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        snap = server.metrics_snapshot()
+    assert server.tracer is None  # tracing off = no tracer at all
+    assert snap["observability"]["trace"] is None
+    assert "default" in snap["observability"]["slo"]["classes"]
+
+
+def test_dump_observability_writes_all_artifacts(tmp_path):
+    server = _traced_server()
+    with server:
+        server.submit("p", height=512, width=512).result(timeout=30)
+        paths = server.dump_observability(str(tmp_path))
+    assert set(paths) == {"metrics.json", "registry.json", "health.json",
+                          "slo.json", "metrics.prom", "trace.json"}
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert any(e["name"] == "request" for e in trace["traceEvents"])
+    assert "serve_requests" in (tmp_path / "metrics.prom").read_text()
+
+
+# ---------------------------------------------------------------------------
+# Per-step timeline <-> comm_plan reconciliation (the byte model as a
+# checked invariant) — tiny real pipeline on the fake mesh.
+# ---------------------------------------------------------------------------
+
+
+def test_step_timeline_reconciles_with_comm_plan(devices8):
+    from test_pipelines import build_sd_pipeline
+
+    pipe, _ = build_sd_pipeline(devices8, 4, step_cache_interval=2,
+                                step_cache_depth=1)
+    tl = pipe.attach_step_timeline(StepTimeline())
+    pipe("a cat", num_inference_steps=6, seed=0, output_type="latent")
+    snap = tl.snapshot()
+    plan = pipe.comm_plan(6)
+    # live per-executed-step byte counters == closed-form plan, exactly
+    assert snap["comm_bytes"] == plan["total_bytes"]
+    assert snap["comm_bytes_tracked"] is True
+    assert snap["phase_steps"]["warmup"] == plan["steps"]["sync"]
+    assert snap["phase_steps"]["full"] == plan["steps"]["stale"]
+    assert snap["phase_steps"]["shallow"] == plan["steps"]["shallow"]
+    assert sum(snap["phase_steps"].values()) == 6
+    assert all(s["wall_s"] >= 0 for s in snap["per_run"][0]["steps"])
+
+
+@pytest.mark.slow  # secondary variant; the cache-on test above is the
+# tier-1 reconciliation gate (870s-budget headroom on the 2-core runner)
+def test_step_timeline_cache_off_all_full_steps(devices8):
+    from test_pipelines import build_sd_pipeline
+
+    pipe, _ = build_sd_pipeline(devices8, 2, split_batch=False)
+    tl = pipe.attach_step_timeline(StepTimeline())
+    pipe("a dog", num_inference_steps=4, seed=1, output_type="latent")
+    snap = tl.snapshot()
+    plan = pipe.comm_plan(4)
+    assert snap["comm_bytes"] == plan["total_bytes"]
+    assert snap["phase_steps"]["shallow"] == 0
+    assert (snap["phase_steps"]["warmup"]
+            + snap["phase_steps"]["full"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# serve_bench acceptance: a tracing-on run produces a Perfetto-loadable
+# JSON where every completed request has the full span chain.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_bench_trace_out_full_chain(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace = tmp_path / "trace.json"
+    registry = tmp_path / "registry.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "serve_bench.py"),
+         "--dry-run", "--mode", "closed", "--requests", "8",
+         "--concurrency", "4", "--steps", "4", "--fake_build_s", "0",
+         "--fake_step_s", "0.001",
+         "--trace_out", str(trace), "--registry_out", str(registry)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["schema"] == 1
+    completed = line["completed"]
+    assert completed == 8
+    payload = json.loads(trace.read_text())
+    evs = payload["traceEvents"]
+    roots = [e for e in evs if e["ph"] == "X" and e["name"] == "request"
+             and e["args"].get("outcome") == "completed"]
+    assert len(roots) == completed
+    for root in roots:
+        t = root["args"]["trace"]
+        for name, ph in (("enqueue", "i"), ("coalesce", "i"),
+                         ("execute", "X"), ("complete", "i")):
+            assert any(e["ph"] == ph and e["name"] == name
+                       and e["args"].get("trace") == t for e in evs), (
+                f"trace {t} missing {name}")
+    assert "serve_requests" in json.loads(registry.read_text())
+
+
+# ---------------------------------------------------------------------------
+# Bench-line schema contract (scripts/common.py emit helper)
+# ---------------------------------------------------------------------------
+
+
+def test_emit_bench_line_schema(tmp_path, capsys):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    try:
+        from common import BENCH_SCHEMA_VERSION, emit_bench_line
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "line.json"
+    rec = emit_bench_line({"metric": "x", "value": 1.5}, str(out))
+    printed = json.loads(capsys.readouterr().out.strip())
+    assert printed == rec
+    assert list(rec)[0] == "schema" and rec["schema"] == BENCH_SCHEMA_VERSION
+    assert json.loads(out.read_text()) == rec
