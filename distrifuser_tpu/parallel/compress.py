@@ -234,6 +234,69 @@ def asdense(x):
     return x.__jax_array__() if isinstance(x, QuantizedTensor) else x
 
 
+def refresh_period(fraction: float) -> int:
+    """``1 / fraction`` as the exact integer rotation period of the
+    partial-refresh schedule (1 when the fraction is 1.0 — full refresh).
+    ``validate_refresh_fraction`` guarantees the division is exact."""
+    return int(round(1.0 / float(fraction)))
+
+
+def validate_refresh_fraction(fraction: float) -> None:
+    """Config-time validation of a PCPP partial-refresh fraction, shared
+    by DistriConfig, ServeConfig, ExecKey, and the controller tier table.
+
+    The fraction must be ``1/k`` for an integer ``k >= 1``: each stale
+    step refreshes exactly one of ``k`` disjoint strided row groups, so
+    the per-step wire bytes are exactly ``fraction`` of the full refresh
+    and every row is at most ``k`` steps stale — both closed forms the
+    byte accounting and the staleness bound depend on being exact."""
+    f = float(fraction)
+    if not (0.0 < f <= 1.0):
+        raise ValueError(
+            f"refresh_fraction must be in (0, 1], got {fraction!r}"
+        )
+    k = round(1.0 / f)
+    if k < 1 or abs(k * f - 1.0) > 1e-6:
+        raise ValueError(
+            "refresh_fraction must be 1/k for an integer k (1, 0.5, 0.25, "
+            f"...): each stale step refreshes one of k strided row groups "
+            f"exactly — got {fraction!r}"
+        )
+
+
+def take_every_kth(x, k: int, r, *, groups: int = 1):
+    """Strided row subset along axis ``-2``: rows ``{r, r+k, r+2k, ...}``
+    of each of ``groups`` equal contiguous segments (static output shape
+    ``[..., L/k, C]``; ``r`` may be a traced index).
+
+    ``groups > 1`` handles a tiled-all-gather layout where axis ``-2``
+    concatenates per-device chunks: the stride applies within each
+    device's chunk, not across the concatenation boundary."""
+    lead, L, C = x.shape[:-2], x.shape[-2], x.shape[-1]
+    if L % (groups * k):
+        raise ValueError(
+            f"partial refresh needs the row count ({L}) divisible by "
+            f"groups*k ({groups}*{k}) — pick a refresh_fraction whose "
+            "period divides every refreshed row dimension"
+        )
+    xg = x.reshape(*lead, groups, L // (groups * k), k, C)
+    sub = lax.dynamic_index_in_dim(xg, r, axis=xg.ndim - 2, keepdims=False)
+    return sub.reshape(*lead, L // k, C)
+
+
+def scatter_every_kth(prev, rows, k: int, r, *, groups: int = 1):
+    """Inverse of `take_every_kth`: write ``rows`` [..., L/k, C] back into
+    the strided positions of ``prev`` [..., L, C] (same ``groups``
+    convention), returning the updated full buffer in prev's dtype."""
+    lead, L, C = prev.shape[:-2], prev.shape[-2], prev.shape[-1]
+    pg = prev.reshape(*lead, groups, L // (groups * k), k, C)
+    up = rows.reshape(*lead, groups, L // (groups * k), 1, C)
+    pg = lax.dynamic_update_slice_in_dim(
+        pg, up.astype(prev.dtype), r, axis=pg.ndim - 2
+    )
+    return pg.reshape(prev.shape)
+
+
 def wire_nbytes(shape: Sequence[int], itemsize: int, mode: str) -> int:
     """Bytes one exchange of a ``shape``-shaped tensor puts on the wire.
 
@@ -255,6 +318,9 @@ def refresh_gather_seq(
     mode: str,
     offset,
     axis: str = SP_AXIS,
+    *,
+    fraction: float = 1.0,
+    step=None,
 ):
     """Compressed sequence-sharded refresh all-gather (DiT/MMDiT KV path).
 
@@ -266,19 +332,53 @@ def refresh_gather_seq(
     against this device's own slice of ``prev`` at token offset ``offset``.
     The result is consumed only next step, so every op here stays on the
     deferred path.
-    """
+
+    ``fraction < 1`` is the PCPP partial-refresh path (arXiv 2412.02962):
+    with period ``k = 1/fraction``, step ``step`` refreshes only rows
+    ``{r, r+k, ...}`` (``r = step % k``) of each device's chunk — the
+    all-gather moves ``chunk/k`` rows per device, the rest of ``prev``
+    carries, and every row is at most ``k`` steps stale.  The rotation
+    index is shared by every device (``step`` is replicated), so the
+    refreshed gathered buffer stays replicated-consistent, and in
+    residual mode the delta base is the row's own ``k``-step-old
+    reconstruction — still closed-loop DPCM, just at stride ``k``."""
     tok = local.ndim - 2  # token axis of the [..., chunk, hid] layout
+    k = refresh_period(fraction)
+    if k <= 1:
+        if mode == "none":
+            return lax.all_gather(local, axis, axis=tok, tiled=True)
+        src = local.astype(jnp.float32)
+        if mode == "int8_residual":
+            start = (0,) * tok + (offset, 0)
+            my_prev = lax.dynamic_slice(prev, start, local.shape)
+            src = src - my_prev.astype(jnp.float32)
+        q, s = quantize(src, mode)
+        gq = lax.all_gather(q, axis, axis=tok, tiled=True)
+        gs = lax.all_gather(s, axis, axis=tok, tiled=True)
+        new = gq.astype(jnp.float32) * gs[..., None]
+        if mode == "int8_residual":
+            new = prev.astype(jnp.float32) + new
+        return new.astype(prev.dtype)
+    if step is None:
+        raise ValueError(
+            "partial refresh (fraction < 1) needs the traced step index "
+            "for the rotation schedule"
+        )
+    n = prev.shape[tok] // local.shape[tok]  # sp peers in the gathered axis
+    r = jnp.mod(jnp.asarray(step, jnp.int32), k)
+    sub = take_every_kth(local, k, r)  # [2, B, chunk/k, hid]
     if mode == "none":
-        return lax.all_gather(local, axis, axis=tok, tiled=True)
-    src = local.astype(jnp.float32)
+        g = lax.all_gather(sub, axis, axis=tok, tiled=True)
+        return scatter_every_kth(prev, g, k, r, groups=n)
+    src = sub.astype(jnp.float32)
     if mode == "int8_residual":
         start = (0,) * tok + (offset, 0)
         my_prev = lax.dynamic_slice(prev, start, local.shape)
-        src = src - my_prev.astype(jnp.float32)
+        src = src - take_every_kth(my_prev, k, r).astype(jnp.float32)
     q, s = quantize(src, mode)
     gq = lax.all_gather(q, axis, axis=tok, tiled=True)
     gs = lax.all_gather(s, axis, axis=tok, tiled=True)
     new = gq.astype(jnp.float32) * gs[..., None]
     if mode == "int8_residual":
-        new = prev.astype(jnp.float32) + new
-    return new.astype(prev.dtype)
+        new = take_every_kth(prev, k, r, groups=n).astype(jnp.float32) + new
+    return scatter_every_kth(prev, new, k, r, groups=n)

@@ -102,6 +102,18 @@ class PatchContext:
     # emissions below — sync-phase exchanges (ctx.emit paths) stay
     # full-precision and bit-exact.
     compress: str = "none"
+    # PCPP partial refresh (arXiv 2412.02962; DistriConfig.refresh_fraction):
+    # with fraction 1/k, each stale step refreshes only rows {r, r+k, ...}
+    # (r = step % k) of every refreshable payload — KV token rows on the
+    # gather path, halo columns on the conv path — and the rest of the
+    # carried buffer stays as-is, so per-step refresh bytes are exactly
+    # fraction x full and every row is at most k steps stale.  Applies to
+    # the same kinds compression does (attn/conv2d — GroupNorm moments are
+    # cancellation-sensitive and tiny, so they always refresh whole); sync
+    # exchanges always move everything.  ``step`` is the traced absolute
+    # step index driving the rotation (required when fraction < 1).
+    refresh_fraction: float = 1.0
+    step: Any = None
     state_in: Optional[Dict[str, Any]] = None
     state_out: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # deferred refresh emissions (batch_comm): name -> record dict with
@@ -162,6 +174,23 @@ class PatchContext:
             return None
         return self.compress
 
+    def _partial_for(self, kind: Optional[str]):
+        """Partial-refresh (period, rotation-index) for a refresh emission
+        of this kind, or None for a full refresh.  Eligibility tracks
+        COMPRESS_KINDS — the same payloads that tolerate lossy wires
+        tolerate a strided refresh; GroupNorm moments do neither."""
+        from .compress import COMPRESS_KINDS, refresh_period
+
+        k = refresh_period(self.refresh_fraction)
+        if k <= 1 or kind not in COMPRESS_KINDS:
+            return None
+        if self.step is None:
+            raise ValueError(
+                "partial refresh (refresh_fraction < 1) needs the traced "
+                "step index on PatchContext.step for the rotation schedule"
+            )
+        return k, jnp.mod(jnp.asarray(self.step, jnp.int32), k)
+
     def emit_refresh_gather(self, name: str, local: Any, kind: str = None) -> None:
         """Record `local` as this layer's next-step gathered state
         ([n, *local.shape] after the all-gather) — immediately, or deferred
@@ -176,9 +205,15 @@ class PatchContext:
             KIND_REGISTRY[name] = kind
         mode = self._compress_for(kind or KIND_REGISTRY.get(name))
         if self.batch_comm:
+            # DistriConfig rejects batch_comm x refresh_fraction < 1, so
+            # the deferred records never carry a partial subset
             if name in self._def_gather or name in self.state_out:
                 raise ValueError(f"duplicate state emission for layer {name!r}")
             self._def_gather[name] = self._gather_record(name, local, mode)
+            return
+        partial = self._partial_for(kind or KIND_REGISTRY.get(name))
+        if partial is not None:
+            self._partial_refresh_gather(name, local, mode, partial)
             return
         if mode is None:
             self.emit(name, lax.all_gather(local, self.axis))
@@ -192,6 +227,48 @@ class PatchContext:
         if rec["prev"] is not None:
             new = rec["prev"].astype(jnp.float32) + new
         self.emit(name, new.astype(rec["dtype"]))
+
+    def _partial_refresh_gather(self, name: str, local: Any,
+                                mode: Optional[str], partial) -> None:
+        """PCPP gather refresh: all-gather only this step's strided row
+        group (``local`` rows {r, r+k, ...}) and scatter it into the
+        carried gathered buffer — the other rows stay as the previous
+        reconstruction, at most k steps stale.  Composes with the
+        compression modes exactly like the full path; residual mode
+        delta-codes each row against its own k-step-old slot, which every
+        peer holds identically (closed-loop at stride k)."""
+        from .compress import (
+            dequantize,
+            quantize,
+            scatter_every_kth,
+            take_every_kth,
+            wire_nbytes,
+        )
+
+        k, r = partial
+        prev = self.stale(name)  # [n, B, L, C] gathered carry
+        sub = take_every_kth(local, k, r)
+        itemsize = jnp.dtype(local.dtype).itemsize
+        WIRE_REGISTRY[name] = self.n * wire_nbytes(
+            sub.shape, itemsize, mode or "none"
+        )
+        if mode is None:
+            g = lax.all_gather(sub, self.axis)  # [n, B, L/k, C]
+            self.emit(name, scatter_every_kth(prev, g, k, r))
+            return
+        src = sub.astype(jnp.float32)
+        if mode == "int8_residual":
+            own = jnp.take(prev, self.split_idx(), axis=0)
+            src = src - take_every_kth(own, k, r).astype(jnp.float32)
+        q, s = quantize(src, mode)
+        gq = lax.all_gather(q, self.axis)
+        gs = lax.all_gather(s, self.axis)
+        new = dequantize(gq, gs, jnp.float32)
+        if mode == "int8_residual":
+            new = take_every_kth(prev, k, r).astype(jnp.float32) + new
+        self.emit(
+            name, scatter_every_kth(prev, new.astype(local.dtype), k, r)
+        )
 
     def _gather_record(self, name: str, local: Any, mode: Optional[str]):
         """Build the deferred-emission record for one gather refresh and
@@ -228,8 +305,10 @@ class PatchContext:
         ``OWN_SUFFIX`` carry this method also refreshes)."""
         KIND_REGISTRY[name] = "conv2d"
         mode = self._compress_for("conv2d")
+        partial = self._partial_for("conv2d")
         if halo == 0 or self.n == 1:
             mode = None  # nothing real moves; keep the zero-halo semantics
+            partial = None
         top, bottom = x[:, :halo], x[:, x.shape[1] - halo :]
         if self.batch_comm:
             if name in self._def_halo or name in self.state_out:
@@ -237,6 +316,9 @@ class PatchContext:
             # halo == 0 defers zero rows, the same empty halos halo_exchange
             # returns on the unbatched path
             self._def_halo[name] = self._halo_record(name, top, bottom, mode)
+            return
+        if partial is not None:
+            self._partial_refresh_halos(name, top, bottom, mode, partial)
             return
         if mode is None:
             from .collectives import halo_exchange
@@ -300,6 +382,76 @@ class PatchContext:
             )
         return {"q": (qt, qb), "s": (st, sb), "prev": prev,
                 "dtype": top.dtype}
+
+    def _partial_refresh_halos(self, name: str, top: Any, bottom: Any,
+                               mode: Optional[str], partial) -> None:
+        """PCPP halo refresh: exchange only this step's strided COLUMN
+        group of the boundary rows (axis -2 of the [B, halo, W, C] layout
+        is W) and scatter it into the carried halo state; the other
+        columns keep their previous reconstruction, at most k steps
+        stale.  Residual mode keeps the own-rows predictor carry in
+        lockstep by scattering the same reconstructed subset into it."""
+        from .collectives import exchange_boundary_rows
+        from .compress import (
+            dequantize,
+            quantize,
+            scatter_every_kth,
+            take_every_kth,
+            wire_nbytes,
+        )
+
+        k, r = partial
+        prev = self.stale(name)  # [2, B, halo, W, C] from-prev/from-next
+        sub_t = take_every_kth(top, k, r)
+        sub_b = take_every_kth(bottom, k, r)
+        itemsize = jnp.dtype(top.dtype).itemsize
+        WIRE_REGISTRY[name] = 2 * wire_nbytes(
+            sub_t.shape, itemsize, mode or "none"
+        )
+        if mode is None:
+            from_prev, from_next = exchange_boundary_rows(
+                sub_b, sub_t, self.n, self.axis
+            )
+            self.emit(name, jnp.stack([
+                scatter_every_kth(prev[0], from_prev, k, r),
+                scatter_every_kth(prev[1], from_next, k, r),
+            ]))
+            return
+        t = sub_t.astype(jnp.float32)
+        b = sub_b.astype(jnp.float32)
+        own = None
+        if mode == "int8_residual":
+            own = self.stale(name + OWN_SUFFIX)  # my previous [top, bottom]
+            t = t - take_every_kth(own[0], k, r).astype(jnp.float32)
+            b = b - take_every_kth(own[1], k, r).astype(jnp.float32)
+        qt, st = quantize(t, mode)
+        qb, sb = quantize(b, mode)
+        if mode == "int8_residual":
+            # own-rows predictor: scatter the RECONSTRUCTED subset (prev
+            # own + dequantized delta) so sender and receivers keep the
+            # identical base — the closed-loop invariant at stride k
+            rec_t = (take_every_kth(own[0], k, r).astype(jnp.float32)
+                     + dequantize(qt, st, jnp.float32))
+            rec_b = (take_every_kth(own[1], k, r).astype(jnp.float32)
+                     + dequantize(qb, sb, jnp.float32))
+            self._emit_own_halos(
+                name,
+                scatter_every_kth(own[0], rec_t.astype(top.dtype), k, r),
+                scatter_every_kth(own[1], rec_b.astype(top.dtype), k, r),
+            )
+        q_prev, q_next = exchange_boundary_rows(qb, qt, self.n, self.axis)
+        s_prev, s_next = exchange_boundary_rows(sb, st, self.n, self.axis)
+        from_prev = dequantize(q_prev, s_prev, jnp.float32)
+        from_next = dequantize(q_next, s_next, jnp.float32)
+        if mode == "int8_residual":
+            from_prev = (take_every_kth(prev[0], k, r).astype(jnp.float32)
+                         + from_prev)
+            from_next = (take_every_kth(prev[1], k, r).astype(jnp.float32)
+                         + from_next)
+        self.emit(name, jnp.stack([
+            scatter_every_kth(prev[0], from_prev.astype(top.dtype), k, r),
+            scatter_every_kth(prev[1], from_next.astype(top.dtype), k, r),
+        ]))
 
     def _emit_own_halos(self, name: str, top: Any, bottom: Any) -> None:
         """Refresh the sender-side own-rows predictor carry for residual

@@ -59,7 +59,7 @@ from ..utils.config import (
     DistriConfig,
 )
 from .collectives import all_gather_seq
-from .compress import refresh_gather_seq, wire_nbytes
+from .compress import refresh_gather_seq, refresh_period, wire_nbytes
 from .guidance import branch_select, combine_guidance
 from .stepcache import is_shallow_at, run_cadence
 
@@ -98,6 +98,13 @@ class DiTDenoiseRunner:
                 "refresh collective to compress (ring carries the local "
                 "chunk; ulysses/usp are exact and stateless)"
             )
+        if (distri_config.refresh_fraction < 1.0
+                and distri_config.attn_impl != "gather"):
+            raise ValueError(
+                "refresh_fraction < 1 (PCPP) thins the displaced KV refresh "
+                f"gathers of attn_impl='gather'; {distri_config.attn_impl!r} "
+                "has no refresh collective to thin"
+            )
         n = distri_config.n_device_per_batch
         if (
             distri_config.attn_impl == "ulysses"
@@ -124,6 +131,13 @@ class DiTDenoiseRunner:
             raise ValueError(
                 f"token count {dit_config.num_tokens} must be divisible by "
                 f"the sp degree {n}"
+            )
+        _rk = refresh_period(distri_config.refresh_fraction)
+        if _rk > 1 and (dit_config.num_tokens // n) % _rk != 0:
+            raise ValueError(
+                f"refresh_fraction=1/{_rk} needs the per-device token chunk "
+                f"({dit_config.num_tokens // n}) divisible by {_rk} — each "
+                "stale step gathers exactly one strided row group"
             )
         if distri_config.step_cache_enabled and not (
             1 <= distri_config.step_cache_depth < dit_config.depth
@@ -313,7 +327,8 @@ class DiTDenoiseRunner:
                 fresh = kv_blk
             else:
                 fresh = refresh_gather_seq(
-                    jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset
+                    jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset,
+                    fraction=cfg.refresh_fraction, step=s,
                 )
             return h_out, fresh
 
@@ -790,20 +805,34 @@ class DiTDenoiseRunner:
         report = {"layout": cfg.attn_impl, "kv_state_elems": int(state),
                   "per_step_collective_elems": int(per_step)}
         # wire bytes: sync steps always move full precision; stale steps
-        # move the compressed payload + fp32 scales when comm_compress is on
-        # (gather layout only — the other layouts reject the knob)
+        # move the compressed payload + fp32 scales when comm_compress is
+        # on, and only 1/k of the KV rows when refresh_fraction = 1/k
+        # (gather layout only — the other layouts reject both knobs).
+        # full_refresh_* is the same closed form at fraction 1, so the
+        # PCPP reduction is a checked ratio, not a recomputation.
         itemsize = jnp.dtype(cfg.dtype).itemsize
+        kk = refresh_period(cfg.refresh_fraction)
         report["comm_compress"] = cfg.comm_compress
+        report["refresh_fraction"] = cfg.refresh_fraction
         report["sync_step_collective_bytes"] = int(per_step) * itemsize
-        if cfg.attn_impl == "gather" and cfg.comm_compress != "none":
-            refresh = depth * n * wire_nbytes(
+        if cfg.attn_impl == "gather":
+            full_refresh = depth * n * wire_nbytes(
                 (2, b, chunk, hid), itemsize, cfg.comm_compress
             )
+            part_refresh = depth * n * wire_nbytes(
+                (2, b, chunk // kk, hid), itemsize, cfg.comm_compress
+            )
             report["per_step_collective_bytes"] = int(
-                refresh + eps_gather * itemsize
+                part_refresh + eps_gather * itemsize
+            )
+            report["full_refresh_per_step_collective_bytes"] = int(
+                full_refresh + eps_gather * itemsize
             )
         else:
             report["per_step_collective_bytes"] = int(per_step) * itemsize
+            report["full_refresh_per_step_collective_bytes"] = (
+                int(per_step) * itemsize
+            )
         if cfg.step_cache_enabled:
             # shallow steps run only d_keep of depth blocks, so the
             # per-block exchange volume scales down proportionally; the

@@ -55,7 +55,7 @@ from ..ops.linear import linear
 from ..schedulers import BaseScheduler
 from ..utils.config import CFG_AXIS, DP_AXIS, SP_AXIS, DistriConfig
 from .collectives import all_gather_seq
-from .compress import refresh_gather_seq, wire_nbytes
+from .compress import refresh_gather_seq, refresh_period, wire_nbytes
 from .guidance import branch_select, combine_guidance
 from .stepcache import is_shallow_at, run_cadence
 
@@ -100,7 +100,22 @@ class MMDiTDenoiseRunner:
                 "gathers of attn_impl='gather'; 'ring' carries only the "
                 "local chunk and has no refresh collective to compress"
             )
+        if (distri_config.refresh_fraction < 1.0
+                and distri_config.attn_impl != "gather"):
+            raise ValueError(
+                "refresh_fraction < 1 (PCPP) thins the displaced image-KV "
+                "refresh gathers of attn_impl='gather'; 'ring' carries only "
+                "the local chunk and has no refresh collective to thin"
+            )
         n = distri_config.n_device_per_batch
+        _rk = refresh_period(distri_config.refresh_fraction)
+        if (_rk > 1 and mmdit_config.num_tokens % n == 0
+                and (mmdit_config.num_tokens // n) % _rk != 0):
+            raise ValueError(
+                f"refresh_fraction=1/{_rk} needs the per-device token chunk "
+                f"({mmdit_config.num_tokens // n}) divisible by {_rk} — "
+                "each stale step gathers exactly one strided row group"
+            )
         if mmdit_config.num_tokens % n != 0:
             raise ValueError(
                 f"token count {mmdit_config.num_tokens} must be divisible "
@@ -207,7 +222,8 @@ class MMDiTDenoiseRunner:
             if no_refresh:
                 return kv_blk
             return refresh_gather_seq(
-                jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset
+                jnp.stack([k, v]), kv_blk, cfg.comm_compress, offset,
+                fraction=cfg.refresh_fraction, step=s,
             )
 
         def block_body_gather(carry, xs):
@@ -785,19 +801,33 @@ class MMDiTDenoiseRunner:
         report = {"layout": layout, "kv_state_elems": int(state),
                   "per_step_collective_elems": int(per_step)}
         # wire bytes: sync full-precision always; stale compressed when
-        # comm_compress is on (gather layout only — ring rejects the knob)
+        # comm_compress is on, thinned to 1/k of the KV rows when
+        # refresh_fraction = 1/k (gather layout only — ring rejects both
+        # knobs).  full_refresh_* pins the fraction-1 closed form so the
+        # PCPP reduction is a checked ratio.
         itemsize = jnp.dtype(cfg.dtype).itemsize
+        kk = refresh_period(cfg.refresh_fraction)
         report["comm_compress"] = cfg.comm_compress
+        report["refresh_fraction"] = cfg.refresh_fraction
         report["sync_step_collective_bytes"] = int(per_step) * itemsize
-        if layout == "gather" and cfg.comm_compress != "none":
-            refresh = n_attn * n * wire_nbytes(
+        if layout == "gather":
+            full_refresh = n_attn * n * wire_nbytes(
                 (2, b, chunk, hid), itemsize, cfg.comm_compress
             )
+            part_refresh = n_attn * n * wire_nbytes(
+                (2, b, chunk // kk, hid), itemsize, cfg.comm_compress
+            )
             report["per_step_collective_bytes"] = int(
-                refresh + out_gather * itemsize
+                part_refresh + out_gather * itemsize
+            )
+            report["full_refresh_per_step_collective_bytes"] = int(
+                full_refresh + out_gather * itemsize
             )
         else:
             report["per_step_collective_bytes"] = int(per_step) * itemsize
+            report["full_refresh_per_step_collective_bytes"] = (
+                int(per_step) * itemsize
+            )
         if cfg.step_cache_enabled:
             # shallow steps run d_keep of depth joint blocks (the dual
             # prefix always runs — the cut sits past it); the output gather

@@ -150,11 +150,13 @@ class DenoiseRunner:
         return branch_select(self.cfg, enc, added)
 
     def _unet_local(self, params, x_in, t, my_enc, my_added, text_kv, phase,
-                    pstate, shallow=False):
+                    pstate, shallow=False, step=None):
         """One UNet evaluation on this device; returns (full-latent output
         for this branch-batch, new patch state).  ``shallow`` (step-cache
         cadence) skips the deep subtree and substitutes the carried deep
-        feature; a non-shallow call with the cache enabled re-emits it."""
+        feature; a non-shallow call with the cache enabled re-emits it.
+        ``step`` is the traced absolute step index — the PCPP partial-
+        refresh rotation schedule reads it off the context."""
         cfg, ucfg = self.cfg, self.ucfg
         if cfg.parallelism == "patch":
             ctx = PatchContext(
@@ -164,6 +166,8 @@ class DenoiseRunner:
                 attn_impl=cfg.attn_impl,
                 batch_comm=cfg.comm_batch,
                 compress=cfg.comm_compress,
+                refresh_fraction=cfg.refresh_fraction,
+                step=step,
                 state_in=pstate,
                 text_kv=text_kv,
             )
@@ -253,7 +257,7 @@ class DenoiseRunner:
                 pstate = {"step": i}
             out, new_pstate = self._unet_local(
                 params, x_in, t, my_enc, my_added, text_kv, phase, pstate,
-                shallow=shallow,
+                shallow=shallow, step=i,
             )
             guided = self._cfg_combine(out, gs, batch)
             x_next, sstate = sched.step(x, guided.astype(jnp.float32), i, sstate)
@@ -897,6 +901,12 @@ class DenoiseRunner:
                 self._make_step(steady, shallow=True), sync_shapes
             )
         return {"phases": phases, "bytes": bytes_,
+                # PCPP key: the stale/shallow byte rows above are already
+                # fraction-aware (WIRE_REGISTRY entries register the
+                # strided subset the emit actually gathers) — this records
+                # WHICH fraction priced them, so comm_plan and the benches
+                # can label the reduction
+                "refresh_fraction": cfg.refresh_fraction,
                 "flops": self._flop_estimate(batch_size, text_len)}
 
     def _flop_estimate(self, batch_size: int = None,

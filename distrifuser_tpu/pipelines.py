@@ -600,6 +600,12 @@ class _GenerationMixin:
         total = sum(per_step.get(ph, 0) * n for ph, n in counts.items())
         return {
             "comm_compress": cfg.comm_compress,
+            # PCPP key (docs/PERF.md "Partial refresh"): the per-step
+            # rows above are already fraction-aware — stale/shallow
+            # refresh bytes shrink to fraction x full, sync stays whole —
+            # so two plans differing only in refresh_fraction give the
+            # byte-reduction ratio in closed form
+            "refresh_fraction": cfg.refresh_fraction,
             "steps": counts,
             "bytes_per_step": per_step,
             "total_bytes": int(total),

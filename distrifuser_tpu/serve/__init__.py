@@ -29,6 +29,7 @@ load under a fault plan.  Architecture notes: docs/SERVING.md.
 
 from ..utils.config import (
     DEFAULT_BUCKETS,
+    ControllerConfig,
     ObservabilityConfig,
     ResilienceConfig,
     ServeConfig,
@@ -37,7 +38,15 @@ from ..utils.metrics import MetricsRegistry
 from ..utils.trace import StepTimeline, Tracer
 from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
+from .controller import (
+    ADMISSION,
+    DEFAULT_TIERS,
+    SLOController,
+    TierSpec,
+    apply_tier,
+)
 from .errors import (
+    AdmissionRejectedError,
     BuildFailedError,
     CircuitOpenError,
     DeadlineExceededError,
@@ -52,6 +61,7 @@ from .errors import (
     WatchdogTimeoutError,
 )
 from .faults import FaultPlan, FaultRule, install_fault_plan
+from .promptcache import PromptCache
 from .queue import Request, RequestQueue, ServeResult
 from .resilience import (
     BackoffPolicy,
@@ -79,13 +89,17 @@ def __getattr__(name):
 
 
 __all__ = [
+    "ADMISSION",
+    "AdmissionRejectedError",
     "BackoffPolicy",
     "BatchKey",
     "BucketTable",
     "BuildFailedError",
     "CircuitBreaker",
     "CircuitOpenError",
+    "ControllerConfig",
     "DEFAULT_BUCKETS",
+    "DEFAULT_TIERS",
     "DeadlineExceededError",
     "DegradationLadder",
     "ExecKey",
@@ -100,6 +114,7 @@ __all__ = [
     "NoBucketError",
     "ObservabilityConfig",
     "PipelineExecutor",
+    "PromptCache",
     "QueueFullError",
     "Request",
     "RequestQueue",
@@ -108,6 +123,7 @@ __all__ = [
     "ResourceExhaustedError",
     "RetryBudget",
     "RetryableError",
+    "SLOController",
     "ServeConfig",
     "ServeError",
     "ServeResult",
@@ -115,9 +131,11 @@ __all__ = [
     "StagePipeline",
     "StagedBatch",
     "StepTimeline",
+    "TierSpec",
     "Tracer",
     "Watchdog",
     "WatchdogTimeoutError",
+    "apply_tier",
     "install_fault_plan",
     "pipeline_executor_factory",
 ]

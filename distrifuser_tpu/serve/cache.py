@@ -72,6 +72,11 @@ class ExecKey:
     step_cache_interval: int = 1
     step_cache_depth: int = 0
     comm_compress: str = "none"
+    # PCPP partial refresh (DistriConfig.refresh_fraction semantics): the
+    # strided refresh schedule is traced into the program, so a fraction
+    # change is a different executable — the SLO controller's
+    # partial_refresh tier keys its degraded programs through this field.
+    refresh_fraction: float = 1.0
     weight_quant: str = "none"
     exec_mode: str = "fused"
     parallelism: str = "patch"
@@ -83,12 +88,23 @@ class ExecKey:
                 f"exec_mode must be 'fused' or 'stepwise', got "
                 f"{self.exec_mode!r}"
             )
-        from ..parallel.compress import COMPRESS_MODES, WEIGHT_QUANT_MODES
+        from ..parallel.compress import (
+            COMPRESS_MODES,
+            WEIGHT_QUANT_MODES,
+            validate_refresh_fraction,
+        )
 
         if self.comm_compress not in COMPRESS_MODES:
             raise ValueError(
                 f"comm_compress must be one of {COMPRESS_MODES}, got "
                 f"{self.comm_compress!r}"
+            )
+        validate_refresh_fraction(self.refresh_fraction)
+        if self.refresh_fraction < 1.0 and self.parallelism != "patch":
+            raise ValueError(
+                "refresh_fraction < 1 (PCPP) applies to displaced-patch "
+                "keys only (parallelism='patch'); a "
+                f"{self.parallelism!r} key has no stale refresh to thin"
             )
         if self.weight_quant not in WEIGHT_QUANT_MODES:
             raise ValueError(
@@ -126,6 +142,8 @@ class ExecKey:
               if self.step_cache_interval > 1 else "")
         cc = ("" if self.comm_compress == "none"
               else f":{self.comm_compress}")
+        pr = ("" if self.refresh_fraction >= 1.0
+              else f":pr{self.refresh_fraction:g}")
         wq = ("" if self.weight_quant == "none"
               else f":wq-{self.weight_quant}")
         em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
@@ -133,7 +151,7 @@ class ExecKey:
               else f":pf{self.pipe_patches or ''}")
         return (f"{self.model_id}:{self.scheduler}:{self.height}x"
                 f"{self.width}@{self.steps}st:{g}:{self.mesh_plan}"
-                f"{sc}{cc}{wq}{em}{pf}")
+                f"{sc}{cc}{pr}{wq}{em}{pf}")
 
 
 class ExecutorCache:

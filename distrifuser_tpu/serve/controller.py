@@ -1,0 +1,400 @@
+"""Closed-loop SLO controller: load-driven tier selection over the
+quality/cost lattice.
+
+The repo's serving stack has accumulated a ladder of quality/cost knobs —
+denoise step count, the temporal step cache (PR 2), stale-refresh wire
+compression (PR 4), and PCPP partial refresh (this PR) — but until now
+the only thing that moved along it was the *failure*-driven degradation
+ladder (serve/resilience.py): under heavy load every request paid full
+price until something broke.  This module closes the loop on the *load*
+side, steering on the signals PR 8 built (`server.slo_snapshot()`:
+per-slo_class rolling p50/p99 plus queue-depth/inflight gauges):
+
+* a validated, ordered **tier table** (`TierSpec`) walks the lattice from
+  full quality to progressively cheaper compiled programs — step cache →
+  wire compression → PCPP partial refresh → reduced steps — with
+  **admission control** past the last tier;
+* per SLO class, `SLOController` holds the current tier and, on every
+  scheduler tick, compares each tier's PREDICTED latency (calibrated
+  per-batch service time x the tier's cost multiplier x the queue-depth
+  load factor) against the class's p99 target, walking one rung per
+  cooldown toward the cheapest tier that holds the SLO — and back toward
+  full quality, with margin, when load subsides;
+* the scheduler maps each batch's key through the winning tier
+  (`apply_tier`) — a different `ExecKey`, so full-quality and degraded
+  executables coexist in the `ExecutorCache` like every other key family;
+* every decision is traced (PR-8 spans, track "controller") and counted
+  (MetricsRegistry: per-class tier gauges, per-tier dispatch counters,
+  transition counters).
+
+**Precedence vs the failure ladder**: the controller picks the tier and
+maps the key FIRST; the resilience engine then tracks breakers and sticky
+degradation rungs per *tier key* and applies its rungs on top
+(`ResilienceEngine.degraded_key`).  Ladder rungs therefore always win —
+a tier requesting the step cache on a key whose ladder learned
+``step_cache_off`` still dispatches with the cache off (the controller's
+knob is retracted by construction), and a tier key whose circuit opened
+sheds exactly like any other key.
+
+Determinism: every decision is a pure function of (injected clock,
+`slo_snapshot`, the calibration ring) — replayed load on the same clock
+produces the identical tier walk, which is what the load-replay tests
+pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.config import validate_step_cache_knobs
+from .cache import ExecKey
+
+# The virtual rung past the last tier: reject at admission instead of
+# dispatching work that cannot hold its SLO (serve/errors.py
+# AdmissionRejectedError).  Not a TierSpec — nothing executes there.
+ADMISSION = "admission_control"
+
+
+@dataclasses.dataclass(frozen=True)
+class TierSpec:
+    """One rung of the quality/cost lattice.
+
+    ``cost`` is the tier's predicted service-time multiplier relative to
+    the full tier (1.0) — the controller's forward model, calibrated
+    against measured completions via cost-normalized observations.  The
+    knob fields are ``None`` = leave the key's value alone; set = override
+    on `apply_tier`.  ``steps_scale`` multiplies the request's step count
+    (floor 1).  Knob overrides other than ``steps_scale`` apply to
+    displaced-patch keys only — a pipefusion bucket still benefits from
+    the step scaling, but the patch-protocol knobs don't exist there."""
+
+    name: str
+    cost: float
+    step_cache: Optional[Tuple[int, int]] = None
+    comm_compress: Optional[str] = None
+    refresh_fraction: Optional[float] = None
+    steps_scale: float = 1.0
+
+    def validate(self) -> None:
+        if not self.name or self.name == ADMISSION:
+            raise ValueError(f"invalid tier name {self.name!r}")
+        if not (0.0 < self.cost <= 1.0):
+            raise ValueError(
+                f"tier {self.name!r}: cost must be in (0, 1], got {self.cost}"
+            )
+        if self.step_cache is not None:
+            validate_step_cache_knobs(*self.step_cache)
+        if self.comm_compress is not None:
+            from ..parallel.compress import validate_mode
+
+            validate_mode(self.comm_compress)
+        if self.refresh_fraction is not None:
+            from ..parallel.compress import validate_refresh_fraction
+
+            validate_refresh_fraction(self.refresh_fraction)
+        if not (0.0 < self.steps_scale <= 1.0):
+            raise ValueError(
+                f"tier {self.name!r}: steps_scale must be in (0, 1], got "
+                f"{self.steps_scale}"
+            )
+
+
+# The default walk down the lattice (ISSUE 10 tier table): full → step
+# cache → wire compression → PCPP partial refresh → reduced steps →
+# admission control at the extreme.  Costs are the forward-model priors —
+# the closed loop corrects for a mesh where they are off, since tier
+# escalation keys off MEASURED windows too.
+DEFAULT_TIERS: Tuple[TierSpec, ...] = (
+    TierSpec("full", 1.0),
+    TierSpec("step_cache", 0.75, step_cache=(2, 1)),
+    TierSpec("comm_compress", 0.65, step_cache=(2, 1), comm_compress="int8"),
+    TierSpec("partial_refresh", 0.55, step_cache=(2, 1),
+             comm_compress="int8", refresh_fraction=0.5),
+    TierSpec("reduced_steps", 0.3, step_cache=(2, 1), comm_compress="int8",
+             refresh_fraction=0.5, steps_scale=0.5),
+)
+
+
+def normalize_tier_table(tiers: Sequence[Any]) -> Tuple[TierSpec, ...]:
+    """Validate a tier table (ControllerConfig.tiers): TierSpec instances
+    or mapping entries, unique names, the first tier the cost-1.0
+    identity, costs strictly decreasing (the walk must actually get
+    cheaper — equal-cost rungs would make the controller burn a cooldown
+    for nothing).  () resolves to `DEFAULT_TIERS`."""
+    if not tiers:
+        return DEFAULT_TIERS
+    specs: List[TierSpec] = []
+    for entry in tiers:
+        if isinstance(entry, TierSpec):
+            spec = entry
+        elif isinstance(entry, dict):
+            kw = dict(entry)
+            if kw.get("step_cache") is not None:
+                kw["step_cache"] = tuple(int(x) for x in kw["step_cache"])
+            spec = TierSpec(**kw)
+        else:
+            raise ValueError(
+                f"tier table entries must be TierSpec or dict, got "
+                f"{type(entry).__name__}"
+            )
+        spec.validate()
+        specs.append(spec)
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"tier names must be unique, got {names}")
+    if specs[0].cost != 1.0:
+        raise ValueError(
+            "the first tier is the full-quality identity and must have "
+            f"cost 1.0, got {specs[0].cost} ({specs[0].name!r})"
+        )
+    for a, b in zip(specs, specs[1:]):
+        if b.cost >= a.cost:
+            raise ValueError(
+                f"tier costs must strictly decrease along the table: "
+                f"{a.name!r} ({a.cost}) -> {b.name!r} ({b.cost})"
+            )
+    return tuple(specs)
+
+
+def apply_tier(key: ExecKey, tier: TierSpec) -> ExecKey:
+    """Map a bucket's base `ExecKey` through one tier's knob overrides.
+
+    Patch-protocol knobs (step cache, comm_compress, refresh_fraction)
+    apply to displaced-patch keys only; ``steps_scale`` applies to every
+    key.  The ladder's sticky rungs compose ON TOP of the returned key
+    (`ResilienceEngine.degraded_key`), so a rung like ``step_cache_off``
+    overrides the tier's cadence — ladder wins, controller retracts."""
+    repl: Dict[str, Any] = {}
+    if tier.steps_scale != 1.0:
+        repl["steps"] = max(1, int(round(key.steps * tier.steps_scale)))
+    if key.parallelism == "patch":
+        if tier.step_cache is not None:
+            repl["step_cache_interval"] = int(tier.step_cache[0])
+            repl["step_cache_depth"] = int(tier.step_cache[1])
+        if tier.comm_compress is not None:
+            repl["comm_compress"] = tier.comm_compress
+        if tier.refresh_fraction is not None:
+            repl["refresh_fraction"] = float(tier.refresh_fraction)
+    return dataclasses.replace(key, **repl) if repl else key
+
+
+@dataclasses.dataclass
+class _ClassState:
+    """Per-SLO-class controller state (scheduler-thread mutations; the
+    ``tier`` int is read racily by `admit` — a torn read is impossible
+    for a GIL-word int, and admission staleness is bounded by one poll)."""
+
+    tier: int = 0
+    last_change: float = 0.0
+    transitions: int = 0
+
+
+class SLOController:
+    """Per-class tier selection on the injected server clock.
+
+    ``decide``/``poll`` run on the scheduler thread only; ``admit`` and
+    ``observe_batch`` are any-thread (lock-guarded where it matters).
+    ``snapshot_fn`` is `InferenceServer.slo_snapshot` (or any callable
+    with its schema) — the ONE signal surface the controller steers on.
+    """
+
+    def __init__(
+        self,
+        config,
+        *,
+        clock: Callable[[], float],
+        batch_hint: int,
+        registry=None,
+        tracer=None,
+        prompt_cache=None,
+    ):
+        self.config = config
+        self.tiers: Tuple[TierSpec, ...] = tuple(config.tiers)
+        self.clock = clock
+        self.batch_hint = max(1, int(batch_hint))
+        self.tracer = tracer
+        self.registry = registry
+        self.prompt_cache = prompt_cache
+        self._lock = threading.Lock()
+        self._classes: Dict[str, _ClassState] = {}
+        # cost-normalized per-batch service observations (ring): a batch
+        # completing in t seconds at tier i contributes t / cost_i — the
+        # full-tier-equivalent service time the predictions scale from
+        self._service: List[float] = []
+        self._service_sum = 0.0
+        self._dispatches = (registry.counter("serve_controller_dispatches")
+                            if registry is not None else None)
+        self._transitions = (
+            registry.counter("serve_controller_transitions")
+            if registry is not None else None)
+
+    # -- shared state helpers -----------------------------------------------
+
+    def _state(self, slo_class: str) -> _ClassState:
+        with self._lock:
+            st = self._classes.get(slo_class)
+            if st is None:
+                st = _ClassState(last_change=self.clock())
+                self._classes[slo_class] = st
+                if self.registry is not None:
+                    self.registry.gauge(
+                        "serve_controller_tier",
+                        labels={"slo_class": slo_class},
+                    ).set(0.0)
+            return st
+
+    def target(self, slo_class: str) -> float:
+        slo = self.config.slo_p99_s
+        return float(slo.get(slo_class, slo["default"]))
+
+    def service_estimate(self) -> float:
+        """Calibrated full-tier-equivalent per-batch service seconds
+        (config.service_prior_s until completions arrive)."""
+        with self._lock:
+            if not self._service:
+                return float(self.config.service_prior_s)
+            return self._service_sum / len(self._service)
+
+    def observe_batch(self, tier_idx: Optional[int], exec_s: float) -> None:
+        """Record one completed batch's execute seconds, normalized by the
+        tier it ran at (any thread — staged decode workers complete
+        concurrently with the scheduler)."""
+        if tier_idx is None:
+            tier_idx = 0
+        cost = self.tiers[min(int(tier_idx), len(self.tiers) - 1)].cost
+        v = float(exec_s) / cost
+        with self._lock:
+            self._service.append(v)
+            self._service_sum += v
+            if len(self._service) > self.config.service_window:
+                self._service_sum -= self._service.pop(0)
+
+    # -- the decision loop (scheduler thread) -------------------------------
+
+    def _predicted(self, idx: int, s_full: float, load_batches: float) -> float:
+        """Forward model: a request dispatched now at tier ``idx`` waits
+        out the backlog and then its own batch — (1 + backlog-in-batches)
+        batch services at the tier's cost."""
+        return s_full * self.tiers[idx].cost * (1.0 + load_batches)
+
+    def _effective_service(self) -> float:
+        s = self.service_estimate()
+        share = self.config.encode_share
+        if share and self.prompt_cache is not None:
+            s *= 1.0 - share * self.prompt_cache.hit_rate()
+        return s
+
+    def poll(self, snapshot: Dict[str, Any]) -> None:
+        """One decision tick over every known SLO class (scheduler
+        thread): walk each class one rung toward the least-degraded tier
+        whose predicted latency holds its target, under the hysteresis
+        cooldowns.  ``snapshot`` is `slo_snapshot()`."""
+        now = self.clock()
+        cfgc = self.config
+        s_full = self._effective_service()
+        load_batches = (
+            snapshot.get("queue_depth", 0) + snapshot.get(
+                "inflight_requests", 0)
+        ) / self.batch_hint
+        with self._lock:
+            classes = set(self._classes)
+        classes.update(snapshot.get("classes", {}))
+        for cls in sorted(classes):
+            st = self._state(cls)
+            target = self.target(cls)
+            # least-degraded tier whose prediction holds the target
+            desired = len(self.tiers)
+            for idx in range(len(self.tiers)):
+                if self._predicted(idx, s_full, load_batches) <= target:
+                    desired = idx
+                    break
+            # measured breach forces at least one rung down: the forward
+            # model may flatter a mesh whose real service is slower.
+            # Only under live load — an idle server's window still holds
+            # the burst's latencies (until slo_max_age_s ages them out),
+            # and escalating on ghosts would wedge every class at
+            # admission with nothing running.
+            window = snapshot.get("classes", {}).get(cls, {})
+            if (load_batches > 0
+                    and window.get("window", 0) >= cfgc.min_samples
+                    and window.get("p99", 0.0) > target):
+                desired = max(desired, st.tier + 1)
+            desired = min(desired, len(self.tiers))  # admission is the cap
+            if desired > st.tier:
+                if now - st.last_change >= cfgc.escalate_cooldown_s:
+                    self._move(cls, st, st.tier + 1, now, "escalate")
+            elif desired < st.tier:
+                if (now - st.last_change >= cfgc.retract_cooldown_s
+                        and self._predicted(
+                            min(st.tier - 1, len(self.tiers) - 1), s_full,
+                            load_batches)
+                        <= cfgc.retract_margin * target):
+                    self._move(cls, st, st.tier - 1, now, "retract")
+
+    def _tier_name(self, idx: int) -> str:
+        return ADMISSION if idx >= len(self.tiers) else self.tiers[idx].name
+
+    def _move(self, cls: str, st: _ClassState, to: int, now: float,
+              kind: str) -> None:
+        frm = st.tier
+        st.tier = to
+        st.last_change = now
+        st.transitions += 1
+        name = self._tier_name(to)
+        if self._transitions is not None:
+            self._transitions.inc(f"{kind}:{cls}:{name}")
+        if self.registry is not None:
+            self.registry.gauge(
+                "serve_controller_tier", labels={"slo_class": cls}
+            ).set(float(to))
+        if self.tracer is not None:
+            self.tracer.event(
+                f"tier_{kind}", track="controller",
+                args={"slo_class": cls, "from": self._tier_name(frm),
+                      "to": name})
+
+    # -- scheduler-side reads ------------------------------------------------
+
+    def admit(self, slo_class: str) -> bool:
+        """Admission control (any thread, submit path): False when the
+        class currently sits past the last tier — even the cheapest
+        program cannot hold its SLO, so the request is rejected with the
+        typed 429 instead of queued into certain lateness."""
+        return self._state(str(slo_class)).tier < len(self.tiers)
+
+    def tier_for_batch(self, slo_classes: Sequence[str]) -> Tuple[int, TierSpec]:
+        """The tier one coalesced batch dispatches at: the CHEAPEST tier
+        any member class currently needs (a cheaper tier is faster for
+        everyone in the batch; a richer one would blow the tight class's
+        SLO).  Admission-parked classes clamp to the last real tier —
+        their queued survivors still execute, as cheaply as possible."""
+        idx = 0
+        for cls in slo_classes:
+            idx = max(idx, self._state(str(cls)).tier)
+        idx = min(idx, len(self.tiers) - 1)
+        return idx, self.tiers[idx]
+
+    def count_dispatch(self, tier_idx: int, n_requests: int) -> None:
+        if self._dispatches is not None:
+            self._dispatches.inc(self.tiers[tier_idx].name, n_requests)
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON state for `metrics_snapshot()["controller"]`."""
+        with self._lock:
+            classes = {
+                cls: {
+                    "tier": st.tier,
+                    "tier_name": self._tier_name(st.tier),
+                    "transitions": st.transitions,
+                }
+                for cls, st in sorted(self._classes.items())
+            }
+        return {
+            "tiers": [t.name for t in self.tiers] + [ADMISSION],
+            "service_estimate_s": self.service_estimate(),
+            "classes": classes,
+        }

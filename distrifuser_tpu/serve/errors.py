@@ -57,6 +57,16 @@ class CircuitOpenError(RetryableError):
     against a replica whose breaker for the key is closed."""
 
 
+class AdmissionRejectedError(RetryableError):
+    """The closed-loop SLO controller (serve/controller.py) rejected the
+    request at admission: under the current load, even the cheapest tier
+    of the quality/cost ladder cannot hold this SLO class's p99 target —
+    executing the request would blow its own SLO *and* everyone else's
+    queue.  HTTP-429 analog, like `QueueFullError`, but driven by
+    predicted latency rather than queue depth; retry against another
+    replica or after the load subsides."""
+
+
 class WatchdogTimeoutError(RetryableError):
     """Batch execution exceeded the watchdog wall-time bound; the batch
     was abandoned (HTTP-504 analog).  The mesh work may still be running

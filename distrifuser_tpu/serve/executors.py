@@ -22,6 +22,23 @@ from .errors import DegradationInapplicableError
 from .faults import FaultPlan
 
 
+def _tokenizer_hash(pipeline) -> int:
+    """Stable identity of a pipeline's tokenizer stack for the prompt
+    cache key: two executors of the same model share entries; a different
+    vocabulary (or tokenizer implementation) never does."""
+    import zlib
+
+    parts = []
+    for tok in getattr(pipeline, "tokenizers", ()) or ():
+        parts.append(type(tok).__name__)
+        vocab = getattr(tok, "vocab_size", None)
+        if vocab is None:
+            enc = getattr(tok, "encoder", None)
+            vocab = len(enc) if hasattr(enc, "__len__") else 0
+        parts.append(str(vocab))
+    return zlib.crc32("|".join(parts).encode())
+
+
 def _release_buffers(tree) -> None:
     """Best-effort early free of device buffers in a pytree — the staged
     pipeline's "latent donation between invocations": with up to
@@ -80,6 +97,11 @@ class PipelineExecutor:
         # surfaced per key by ExecutorCache.weight_bytes / metrics_snapshot
         report = getattr(pipeline, "weight_report", None)
         self.weight_nbytes = report()["total_bytes"] if report else None
+        # prompt/embedding LRU (serve/promptcache.py), attached by the
+        # owning server via attach_prompt_cache: None = encode always runs
+        self.prompt_cache = None
+        self._encode_cache_family = (type(pipeline).__name__,
+                                     _tokenizer_hash(pipeline))
 
     # -- observability (utils/trace.py; docs/OBSERVABILITY.md) -------------
 
@@ -138,6 +160,25 @@ class PipelineExecutor:
             seeds = list(seeds) + [seeds[-1]] * pad
         return list(prompts), list(negative_prompts), list(seeds), n_real
 
+    def attach_prompt_cache(self, cache):
+        """Use ``cache`` (serve/promptcache.py) in front of every encode:
+        repeated prompt chunks skip tokenize + text-encode.  Monolithic
+        dispatch reroutes through the stage programs (encode -> denoise ->
+        decode run serially), which are bit-identical to `generate_batch`
+        per (prompt, seed, steps) — the PR-5 staging invariant — so
+        caching changes latency, never images."""
+        self.prompt_cache = cache
+        return cache
+
+    def _encode_chunk(self, stages, p_chunk, n_chunk):
+        """One compiled-width encode, memoized by (family, tokenizer
+        hash, prompt chunk) when a prompt cache is attached."""
+        if self.prompt_cache is None:
+            return stages.encode(p_chunk, n_chunk)
+        key = (self._encode_cache_family, tuple(p_chunk), tuple(n_chunk))
+        return self.prompt_cache.get_or_encode(
+            key, lambda: stages.encode(p_chunk, n_chunk))
+
     def __call__(
         self,
         prompts: List[str],
@@ -148,6 +189,12 @@ class PipelineExecutor:
         if self.fault_plan is not None:
             self.fault_plan.check("executor.execute", key=self.key,
                                   batch_size=len(prompts))
+        if self.prompt_cache is not None:
+            # cached-encode path: the stage programs run serially (see
+            # attach_prompt_cache) so the memoized embeddings slot in
+            work = self.encode_stage(prompts, negative_prompts, seeds)
+            work = self.denoise_stage(work, guidance_scale)
+            return self.decode_stage(work)
         prompts, negative_prompts, seeds, n_real = self._pad_batch(
             prompts, negative_prompts, seeds)
         bs = self.batch_size
@@ -190,13 +237,17 @@ class PipelineExecutor:
         bs = self.batch_size
         latents = self._draw_latents(seeds)
         encoded = [
-            stages.encode(prompts[i:i + bs], negative_prompts[i:i + bs])
+            self._encode_chunk(stages, prompts[i:i + bs],
+                               negative_prompts[i:i + bs])
             for i in range(0, len(prompts), bs)
         ]
         # block so the stage's service time (and the denoise worker's
         # queue) reflects real encode compute, not async dispatch
         jax.block_until_ready((encoded, latents))
         return {"n_real": n_real, "encoded": encoded, "latents": latents,
+                # cached embeddings must NOT be "donated" after the
+                # denoise consumes them — the cache still owns the buffers
+                "encode_cached": self.prompt_cache is not None,
                 "latent": None}
 
     def denoise_stage(self, work: Dict[str, Any],
@@ -217,7 +268,12 @@ class PipelineExecutor:
         ]
         latent = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
         latent = jax.block_until_ready(latent)
-        _release_buffers((work.pop("latents"), work.pop("encoded")))
+        encoded = work.pop("encoded")
+        if not work.get("encode_cached"):
+            # prompt-cache-owned embeddings stay resident for future hits;
+            # everything else donates its HBM the moment denoise is done
+            _release_buffers(encoded)
+        _release_buffers(work.pop("latents"))
         work["latent"] = latent
         return work
 
@@ -285,6 +341,34 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
     # configure is the builder's job, like the cadence above
     if key.comm_compress == "none" and dcfg.comm_compress != "none":
         dcfg.comm_compress = "none"
+    # PCPP partial refresh: the RESET direction (key at 1.0) always
+    # forces safely, like comm_compress="none".  The partial direction
+    # also forces pre-prepare — the fraction is read at trace time, adds
+    # no weights and no carry-structure change — but ONLY onto gather-
+    # layout builders, where every family's refresh path honors it; the
+    # DiT/MMDiT ring/ulysses/usp layouts have no refresh collective to
+    # thin, and silently setting the field post-construction would skip
+    # the runner __init__ validation and cache a ':pr' key that moves
+    # full bytes while the controller costs it as degraded.  Raising
+    # makes the build fail loudly instead (the builder must construct
+    # from key.refresh_fraction, or the tier table must not request it).
+    if (key.parallelism == "patch" and dcfg.parallelism == "patch"
+            and getattr(dcfg, "refresh_fraction", 1.0)
+            != key.refresh_fraction):
+        if key.refresh_fraction >= 1.0:
+            dcfg.refresh_fraction = 1.0
+        elif getattr(dcfg, "attn_impl", "gather") == "gather":
+            from ..parallel.compress import validate_refresh_fraction
+
+            validate_refresh_fraction(key.refresh_fraction)
+            dcfg.refresh_fraction = float(key.refresh_fraction)
+        else:
+            raise ValueError(
+                f"key wants refresh_fraction={key.refresh_fraction} but "
+                f"the builder constructed attn_impl={dcfg.attn_impl!r} — "
+                "partial refresh is forcible onto the gather layout only; "
+                "build_pipeline must read key.refresh_fraction itself"
+            )
     # weight_quant inverts the convention: here the QUANTIZE direction is
     # the safe post-construction force (quantizing the built dense tree is
     # exactly what load-time quantization does), and the ladder's

@@ -54,6 +54,7 @@ from ..utils.trace import RequestTrace, Tracer
 from .batcher import BatchKey, BucketTable, MicroBatcher
 from .cache import ExecKey, ExecutorCache
 from .errors import (
+    AdmissionRejectedError,
     BuildFailedError,
     CircuitOpenError,
     DeadlineExceededError,
@@ -109,14 +110,24 @@ class InferenceServer:
         self.clock = clock
         self.fault_plan = fault_plan
         self.queue = RequestQueue(self.config.max_queue_depth)
-        if fault_plan is not None:
+        # self.prompt_cache is created below (it needs the registry); the
+        # factory wrapper reads the attribute lazily at build time, which
+        # always happens after __init__ completes (warmup/start/dispatch)
+        self.prompt_cache = None
+
+        def _factory(key, _inner=executor_factory):
             # the "build" site wraps WHATEVER factory was passed, so fake
-            # and real executors get build faults through one code path
-            def _factory(key, _inner=executor_factory):
-                fault_plan.check("build", key=key)
-                return _inner(key)
-        else:
-            _factory = executor_factory
+            # and real executors get build faults through one code path —
+            # and every built executor gets the server's prompt cache
+            # attached when it knows how to use one
+            if self.fault_plan is not None:
+                self.fault_plan.check("build", key=key)
+            ex = _inner(key)
+            if (self.prompt_cache is not None
+                    and hasattr(ex, "attach_prompt_cache")):
+                ex.attach_prompt_cache(self.prompt_cache)
+            return ex
+
         self.cache = ExecutorCache(
             _factory, capacity=self.config.cache_capacity
         )
@@ -145,6 +156,7 @@ class InferenceServer:
         # rolling-window p50/p99 per SLO class + the queue-depth and
         # inflight gauges, all readable via slo_snapshot()
         self._slo_window = obs.slo_window
+        self._slo_max_age = obs.slo_max_age_s
         self._inflight_c = Counter()  # "requests": dispatched, unresolved
         self.registry.gauge("serve_queue_depth",
                             lambda: float(len(self.queue)))
@@ -182,6 +194,34 @@ class InferenceServer:
             staging=self.config.pipeline_stages,
             tracer=self.tracer,
         )
+        # Prompt/embedding LRU cache (serve/promptcache.py): repeated
+        # prompts skip text-encode; hit rate rides the registry and feeds
+        # the controller's predicted service time
+        if self.config.prompt_cache_capacity > 0:
+            from .promptcache import PromptCache
+
+            self.prompt_cache = PromptCache(
+                self.config.prompt_cache_capacity,
+                counter=self.registry.counter("serve_prompt_cache"),
+            )
+            self.registry.register("serve_prompt_cache_state",
+                                   self.prompt_cache)
+        # Closed-loop SLO controller (serve/controller.py): per-slo_class
+        # tier selection over the quality/cost lattice, admission control
+        # at the extreme.  None when off — the controller-off dispatch
+        # path runs zero controller code, same convention as the tracer.
+        self.controller = None
+        if self.config.controller.enabled:
+            from .controller import SLOController
+
+            self.controller = SLOController(
+                self.config.controller,
+                clock=clock,
+                batch_hint=self.config.max_batch_size,
+                registry=self.registry,
+                tracer=self.tracer,
+                prompt_cache=self.prompt_cache,
+            )
         # the resilience ring log joins the unified registry (JSON render;
         # the Prometheus exposition skips free-text rings by design)
         self.registry.register("serve_last_errors",
@@ -329,6 +369,10 @@ class InferenceServer:
             step_cache_interval=self.config.step_cache_interval,
             step_cache_depth=self.config.step_cache_depth,
             comm_compress=self.config.comm_compress,
+            # the PCPP knob is a patch-protocol field: pipefusion buckets
+            # key at 1.0 (ExecKey validation would reject anything else)
+            refresh_fraction=(self.config.refresh_fraction
+                              if parallelism == "patch" else 1.0),
             weight_quant=self.config.weight_quant,
             parallelism=parallelism,
             pipe_patches=pipe_patches,
@@ -370,6 +414,17 @@ class InferenceServer:
         on; it does NOT affect scheduling today."""
         if not self._started or self._stop.is_set():
             raise ServerClosedError("server is not running")
+        if self.controller is not None and not self.controller.admit(
+                str(slo_class)):
+            # the controller's extreme rung: even the cheapest tier cannot
+            # hold this class's SLO under the current load — reject at
+            # admission (typed 429) instead of queueing certain lateness
+            self.counters.inc("rejected_admission")
+            raise AdmissionRejectedError(
+                f"slo_class {slo_class!r} is admission-controlled: the "
+                "cheapest quality tier cannot hold its p99 target at the "
+                "current load; retry later or against another replica"
+            )
         steps = (self.config.default_steps if num_inference_steps is None
                  else num_inference_steps)
         ttl = self.config.default_ttl_s if ttl_s is None else ttl_s
@@ -502,6 +557,11 @@ class InferenceServer:
                 # staged outcomes ride an event queue back here: the
                 # breaker/ladder mutating methods are scheduler-thread-only
                 self._drain_staged_outcomes()
+                if self.controller is not None:
+                    # one decision tick per scheduler round — also while
+                    # idle, so an admission-parked class can retract when
+                    # the load that parked it drains away
+                    self.controller.poll(self.slo_snapshot())
                 got = self.batcher.next_batch(timeout=0.05)
             except Exception:  # noqa: BLE001
                 self.counters.inc("scheduler_errors")
@@ -532,14 +592,28 @@ class InferenceServer:
         self._drain_staged_outcomes()
         base_key = self._exec_key_for(key.height, key.width, key.steps,
                                       key.cfg)
+        # Closed-loop tier selection (serve/controller.py): map the bucket
+        # key through the cheapest tier any member class needs BEFORE the
+        # resilience layer sees it — breakers and sticky ladder rungs then
+        # track per TIER key, and degraded_key() composes the rungs on top
+        # of the tier's knobs, so ladder rungs always win.
+        tier_idx = None
+        if self.controller is not None:
+            from .controller import apply_tier
+
+            tier_idx, tier = self.controller.tier_for_batch(
+                [r.slo_class for r in batch])
+            base_key = apply_tier(base_key, tier)
         batch_span = None
         if self.tracer is not None:
+            targs = {"bucket": f"{key.height}x{key.width}",
+                     "n": len(batch), "key": base_key.short(),
+                     "traces": [r.trace.trace_id for r in batch
+                                if r.trace is not None]}
+            if tier_idx is not None:
+                targs["tier"] = self.controller.tiers[tier_idx].name
             batch_span = self.tracer.begin(
-                "batch", track="scheduler", t=dispatch_ts,
-                args={"bucket": f"{key.height}x{key.width}",
-                      "n": len(batch), "key": base_key.short(),
-                      "traces": [r.trace.trace_id for r in batch
-                                 if r.trace is not None]})
+                "batch", track="scheduler", t=dispatch_ts, args=targs)
             for req in batch:
                 self._trace_dequeue(req, batch_span, len(batch))
         if not self.resilience.allow(base_key):
@@ -547,12 +621,18 @@ class InferenceServer:
             if self.tracer is not None:
                 self.tracer.end(batch_span, args={"outcome": "shed"})
             return
+        if tier_idx is not None:
+            # counted only past the breaker gate: a shed batch never ran
+            # at the tier, and the per-tier dispatch counters are read as
+            # tier THROUGHPUT exactly when the mesh is failing
+            self.controller.count_dispatch(tier_idx, len(batch))
         # inflight gauge: dispatched-but-unresolved requests (the SLO
         # controller's second queue signal).  Every exit path below must
         # balance it — staged submissions hand the decrement to
         # _staged_release, which fires exactly once per submitted batch.
         self._inflight_c.inc("requests", len(batch))
-        staged = self._execute_staged(key, base_key, batch, dispatch_ts)
+        staged = self._execute_staged(key, base_key, batch, dispatch_ts,
+                                      tier_idx)
         if staged == "submitted":
             if self.tracer is not None:
                 self.tracer.end(batch_span, args={"outcome": "staged"})
@@ -563,7 +643,8 @@ class InferenceServer:
                 self.tracer.end(batch_span, args={"outcome": "failed"})
             return
         try:
-            self._execute_resilient(key, base_key, batch, dispatch_ts)
+            self._execute_resilient(key, base_key, batch, dispatch_ts,
+                                    tier_idx)
         finally:
             # batch span first, THEN the inflight decrement: a client
             # observing inflight==0 knows the scheduler has made its
@@ -608,7 +689,8 @@ class InferenceServer:
                 not in self.resilience.key_state(base_key).rungs)
 
     def _execute_staged(self, key: BatchKey, base_key: ExecKey,
-                        batch: List[Request], dispatch_ts: float) -> str:
+                        batch: List[Request], dispatch_ts: float,
+                        tier_idx: Optional[int] = None) -> str:
         """Submit the batch to the stage pipeline.  Returns
         ``"submitted"`` (the pipeline owns the batch now — its inflight
         decrement rides `_staged_release`), ``"failed"`` (consumed by a
@@ -650,6 +732,7 @@ class InferenceServer:
         sb = StagedBatch(
             batch_key=key, base_key=base_key, ekey=ekey, requests=batch,
             executor=executor, compile_hit=hit, dispatch_ts=dispatch_ts,
+            tier=tier_idx,
         )
         if not self.staging.submit(sb):
             # pipeline is stopping: deterministic close, like the queued
@@ -669,7 +752,7 @@ class InferenceServer:
         self._complete_batch(
             sb.batch_key, sb.ekey, sb.requests, outputs, sb.dispatch_ts,
             t0, t1, sb.compile_hit, retries=0, degradations=degradations,
-            shallow_steps=shallow,
+            shallow_steps=shallow, tier=sb.tier,
         )
 
     def _staged_failure(self, sb, exc: Exception) -> None:
@@ -769,7 +852,8 @@ class InferenceServer:
         return outputs, t0, t1
 
     def _execute_resilient(self, key: BatchKey, base_key: ExecKey,
-                           batch: List[Request], dispatch_ts: float) -> None:
+                           batch: List[Request], dispatch_ts: float,
+                           tier_idx: Optional[int] = None) -> None:
         """Bounded retry loop around (build -> dispatch) with the
         degradation ladder on OOM/compile failures.  Splitting recurses
         with fresh attempt budgets (depth is bounded by log2(batch));
@@ -830,9 +914,9 @@ class InferenceServer:
                                       "n": len(batch)})
                         mid = (len(batch) + 1) // 2
                         self._execute_resilient(key, base_key, batch[:mid],
-                                                dispatch_ts)
+                                                dispatch_ts, tier_idx)
                         self._execute_resilient(key, base_key, batch[mid:],
-                                                dispatch_ts)
+                                                dispatch_ts, tier_idx)
                         return
                     if rung is not None:
                         self.counters.inc("degraded_" + rung)
@@ -874,18 +958,24 @@ class InferenceServer:
                 retries=attempts,
                 degradations=tuple(res.key_state(base_key).rungs),
                 shallow_steps=int(getattr(executor, "shallow_steps", 0)),
+                tier=tier_idx,
             )
             return
 
     def _complete_batch(self, key: BatchKey, ekey: ExecKey,
                         batch: List[Request], outputs, dispatch_ts: float,
                         t0: float, t1: float, hit: bool, *, retries: int,
-                        degradations: tuple, shallow_steps: int) -> None:
+                        degradations: tuple, shallow_steps: int,
+                        tier: Optional[int] = None) -> None:
         """Per-request success bookkeeping shared by the monolithic and
         staged dispatch paths: counters, latency histograms, and future
         resolution.  Thread-safe (staged batches complete on the decode
         worker while the scheduler thread completes monolithic ones)."""
         self.counters.inc("batches")
+        if self.controller is not None:
+            # calibrate the controller's forward model: one cost-
+            # normalized batch-service observation per completed batch
+            self.controller.observe_batch(tier, t1 - t0)
         self.counters.inc("requests_compile_hit" if hit
                           else "requests_compile_miss", len(batch))
         self._batch_sizes.inc(f"size_{len(batch)}")
@@ -943,10 +1033,14 @@ class InferenceServer:
 
     def slo_window(self, slo_class: str):
         """The rolling e2e-latency window for one SLO class (created on
-        first use; one `RollingQuantile` per class in the registry)."""
+        first use; one `RollingQuantile` per class in the registry).
+        Samples age out after ``observability.slo_max_age_s`` on the
+        server clock — without the bound the windows are time-blind and
+        an idle server pins minutes-old p99s into the controller."""
         return self.registry.rolling(
             "serve_slo_e2e_seconds", window=self._slo_window,
-            labels={"slo_class": str(slo_class)})
+            labels={"slo_class": str(slo_class)},
+            clock=self.clock, max_age_s=self._slo_max_age)
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """THE interface the closed-loop SLO controller (ROADMAP item 3)
@@ -1108,6 +1202,12 @@ class InferenceServer:
                           if self.tracer is not None else None),
                 "slo": self.slo_snapshot(),
             },
+            # the closed-loop SLO controller's tier state (None when off)
+            "controller": (self.controller.snapshot()
+                           if self.controller is not None else None),
+            # prompt/embedding cache in front of text-encode (None when off)
+            "prompt_cache": (self.prompt_cache.snapshot()
+                             if self.prompt_cache is not None else None),
         }
 
     def export_metrics(self, path: str) -> Dict[str, Any]:

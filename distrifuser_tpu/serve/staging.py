@@ -92,10 +92,11 @@ class StagedBatch:
 
     __slots__ = ("batch_key", "base_key", "ekey", "requests",
                  "guidance_scale", "executor", "compile_hit", "dispatch_ts",
-                 "started_ts", "stage_ready_ts", "work")
+                 "started_ts", "stage_ready_ts", "work", "tier")
 
     def __init__(self, *, batch_key, base_key: ExecKey, ekey: ExecKey,
-                 requests, executor, compile_hit: bool, dispatch_ts: float):
+                 requests, executor, compile_hit: bool, dispatch_ts: float,
+                 tier: Optional[int] = None):
         self.batch_key = batch_key
         self.base_key = base_key
         self.ekey = ekey
@@ -107,6 +108,9 @@ class StagedBatch:
         self.started_ts: Optional[float] = None  # encode-stage entry
         self.stage_ready_ts = dispatch_ts  # when the next stage could start
         self.work: Any = None
+        # SLO-controller tier index this batch dispatched at (None when
+        # the controller is off) — rides to _complete_batch's calibration
+        self.tier = tier
 
     @property
     def prompts(self) -> List[str]:

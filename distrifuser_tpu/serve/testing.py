@@ -36,7 +36,19 @@ class FakeExecutor:
 
     ``batch_sizes`` records the *real* (unpadded) size of every invocation
     — what tests assert coalescing against.
+
+    The simulated service time honors the key's quality/cost knobs
+    (`effective_service_s`) so controller-driven tiers are measurably
+    cheaper on fakes, with the knob-free key costing EXACTLY
+    ``step_time_s * steps`` as before: shallow cadence steps cost a 0.35
+    FLOP fraction (the PR-2 measured ratio), wire compression and PCPP
+    partial refresh model a comm-bound mesh with multiplicative discounts.
+    Deterministic — the SLO-bench goodput numbers reproduce.
     """
+
+    # cost-model constants, shared with the docs' tier-table discussion
+    SHALLOW_FRACTION = 0.35
+    COMPRESS_DISCOUNT = 0.85
 
     def __init__(self, key: ExecKey, batch_size: int = 8,
                  step_time_s: float = 0.0):
@@ -52,6 +64,20 @@ class FakeExecutor:
             key.steps, warmup_steps=0, interval=key.step_cache_interval
         )
 
+    def effective_service_s(self) -> float:
+        """Key-aware simulated batch service time (see class docstring)."""
+        key = self.key
+        full = key.steps - self.shallow_steps
+        eff = full + self.SHALLOW_FRACTION * self.shallow_steps
+        m = 1.0
+        if key.comm_compress != "none":
+            m *= self.COMPRESS_DISCOUNT
+        if key.refresh_fraction < 1.0:
+            # refresh bytes scale with the fraction; comm is a ~40% share
+            # of the modeled stale step, so half the refresh ≈ 0.8x
+            m *= 0.6 + 0.4 * key.refresh_fraction
+        return self.step_time_s * eff * m
+
     def __call__(self, prompts: List[str], negative_prompts: List[str],
                  guidance_scale: float, seeds: List[int]) -> List[Any]:
         assert len(prompts) == len(negative_prompts) == len(seeds)
@@ -59,7 +85,7 @@ class FakeExecutor:
         if self.step_time_s:
             # batched invocation costs one pass regardless of batch size —
             # the whole point of coalescing
-            time.sleep(self.step_time_s * self.key.steps)
+            time.sleep(self.effective_service_s())
         return [fake_image(p, s, self.key) for p, s in zip(prompts, seeds)]
 
 
@@ -149,6 +175,15 @@ class StagedFakeExecutor(FakeExecutor):
         self.fail_times = fail_times
         self.fail_exc = fail_exc
         self.stage_calls = {"encode": 0, "denoise": 0, "decode": 0}
+        # serve/promptcache.py contract (the server attaches its cache to
+        # any executor exposing attach_prompt_cache): a hit skips the
+        # simulated encode sleep, mirroring the real executor's skipped
+        # tokenize + text-encode
+        self.prompt_cache = None
+
+    def attach_prompt_cache(self, cache):
+        self.prompt_cache = cache
+        return cache
 
     def _stage(self, name: str, sleep_s: float) -> None:
         self.stage_calls[name] += 1
@@ -182,7 +217,13 @@ class StagedFakeExecutor(FakeExecutor):
                      seeds: List[int]):
         if self.tracker is not None:
             self.tracker.enter()
-        self._stage("encode", self.encode_s)
+        if self.prompt_cache is not None:
+            key = (("fake", self.key.model_id), tuple(prompts),
+                   tuple(negative_prompts))
+            self.prompt_cache.get_or_encode(
+                key, lambda: self._stage("encode", self.encode_s) or True)
+        else:
+            self._stage("encode", self.encode_s)
         return {"prompts": list(prompts), "seeds": list(seeds)}
 
     def denoise_stage(self, work, guidance_scale: float):

@@ -194,6 +194,21 @@ class DistriConfig:
     # cadence run different XLA programs (serve keys them separately).
     step_cache_interval: int = 1
     step_cache_depth: int = 0
+    # PCPP partial refresh (Partially Conditioned Patch Parallelism,
+    # arXiv 2412.02962; parallel/context.py): fraction 1/k of each stale
+    # step's refresh payload actually moves — step i refreshes only the
+    # strided row group {i%k, i%k + k, ...} of every KV slab (token rows)
+    # and conv halo (columns), the rest of the carried buffer stays as the
+    # previous reconstruction (at most k steps stale).  Per-step refresh
+    # bytes are exactly fraction x full; GroupNorm moments always refresh
+    # whole (tiny, cancellation-sensitive — same exclusion as
+    # comm_compress).  1.0 (default) is the exact DistriFusion protocol.
+    # Composes with comm_compress and the step cache; requires
+    # parallelism="patch" (the displaced-patch families) and is mutually
+    # exclusive with comm_batch (the flat batched exchange assumes
+    # whole-buffer records).  The fraction is part of the compiled
+    # program's identity (serve ExecKey.refresh_fraction).
+    refresh_fraction: float = 1.0
     # PipeFusion only (parallelism="pipefusion"): how many token-chunks
     # ("patches") stream through the pipeline stages.  None = one per
     # stage (the minimum); more patches shrink the per-hop payload and
@@ -257,6 +272,24 @@ class DistriConfig:
                 f"inter-stage activation hops; {self.parallelism!r} has "
                 "no stale refresh traffic to compress"
             )
+        from ..parallel.compress import validate_refresh_fraction
+
+        validate_refresh_fraction(self.refresh_fraction)
+        if self.refresh_fraction < 1.0:
+            if self.parallelism != "patch":
+                raise ValueError(
+                    "refresh_fraction < 1 (PCPP partial refresh) rides the "
+                    "displaced-patch stale-refresh exchanges "
+                    f"(parallelism='patch'); {self.parallelism!r} has no "
+                    "per-step refresh traffic to thin"
+                )
+            if self.comm_batch:
+                raise ValueError(
+                    "refresh_fraction < 1 and comm_batch are mutually "
+                    "exclusive: the flat batched exchange defers whole-"
+                    "buffer records — use the per-layer deferred path for "
+                    "partial refresh"
+                )
         validate_weight_mode(self.weight_quant)
         validate_weight_mode(self.weight_quant_aux)
         if self.weight_quant != "none" and self.parallelism == "tensor":
@@ -482,6 +515,13 @@ class ObservabilityConfig:
     * ``slo_window`` — ring size of the per-SLO-class rolling p50/p99
       windows (`RollingQuantile`) — the signal ROADMAP item 3's
       closed-loop controller reads via ``server.slo_snapshot()``.
+    * ``slo_max_age_s`` — maximum age of a sample in those windows
+      (server clock).  Without it the windows are time-blind: completions
+      from minutes ago keep steering the SLO controller long after the
+      load that produced them is gone — an idle server would pin its old
+      p99 forever.  Samples older than this are excluded from every
+      quantile/snapshot read (the ring still holds them; they simply stop
+      counting).  None disables aging.
     """
 
     trace: bool = False
@@ -489,6 +529,7 @@ class ObservabilityConfig:
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
     slo_window: int = 512
+    slo_max_age_s: Optional[float] = 300.0
 
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
@@ -505,6 +546,10 @@ class ObservabilityConfig:
         if self.slo_window < 1:
             raise ValueError(
                 f"slo_window must be >= 1, got {self.slo_window}"
+            )
+        if self.slo_max_age_s is not None and self.slo_max_age_s <= 0:
+            raise ValueError(
+                f"slo_max_age_s must be > 0 or None, got {self.slo_max_age_s}"
             )
 
 
@@ -652,6 +697,105 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass
+class ControllerConfig:
+    """Closed-loop SLO controller policy (serve/controller.py); lives
+    beside ServeConfig so one module owns every run-shaping knob.
+
+    The controller walks an ordered *tier table* over the quality/cost
+    lattice per SLO class — full quality first, then progressively
+    cheaper compiled programs (step cache, wire compression, PCPP partial
+    refresh, reduced steps), with admission control past the last tier —
+    and dispatches each batch at the least-degraded tier whose PREDICTED
+    latency holds the class's p99 target under the current queue depth
+    and rolling windows (``server.slo_snapshot()``).  All decisions run
+    on the injected server clock, so replayed load produces identical
+    tier walks.
+
+    Knobs:
+      * ``enabled`` — off (default) keeps today's behavior exactly: no
+        controller object is built, no per-dispatch work added.
+      * ``slo_p99_s`` — {slo_class: p99 target seconds}.  Classes absent
+        from the map use the ``"default"`` entry (one is required).
+      * ``tiers`` — the tier table (serve/controller.py TierSpec list);
+        () uses the built-in DEFAULT_TIERS.  Validated: unique names,
+        strictly decreasing predicted-cost multipliers, first tier cost
+        1.0 (the identity/full tier).
+      * ``escalate_cooldown_s`` / ``retract_cooldown_s`` — minimum time
+        between tier moves per class, one rung per move (the hysteresis
+        that keeps a boundary load from flapping).  Retraction (back
+        toward full quality) additionally requires the richer tier's
+        predicted latency to hold with ``retract_margin`` headroom.
+      * ``min_samples`` — observed-p99 breach checks wait for this many
+        live window samples (prediction steers from the first dispatch).
+      * ``service_prior_s`` — per-batch service-time estimate used until
+        real completions calibrate it (``service_window`` ring).
+      * ``encode_share`` — fraction of a batch's service time spent in
+        text-encode: with a prompt cache attached, predicted service
+        scales by ``1 - encode_share * hit_rate`` (a cache hit is a
+        cheaper tier input).
+    """
+
+    enabled: bool = False
+    slo_p99_s: Any = dataclasses.field(
+        default_factory=lambda: {"default": 2.0}
+    )
+    tiers: Sequence[Any] = ()
+    escalate_cooldown_s: float = 0.25
+    retract_cooldown_s: float = 1.0
+    retract_margin: float = 0.6
+    min_samples: int = 4
+    service_prior_s: float = 0.05
+    service_window: int = 32
+    encode_share: float = 0.0
+
+    def __post_init__(self) -> None:
+        slo = dict(self.slo_p99_s or {})
+        if "default" not in slo:
+            raise ValueError(
+                "slo_p99_s needs a 'default' entry — classes absent from "
+                "the map fall back to it"
+            )
+        for cls, target in slo.items():
+            if float(target) <= 0:
+                raise ValueError(
+                    f"slo_p99_s[{cls!r}] must be > 0, got {target}"
+                )
+        self.slo_p99_s = {str(c): float(t) for c, t in slo.items()}
+        if self.escalate_cooldown_s < 0 or self.retract_cooldown_s < 0:
+            raise ValueError(
+                "cooldowns must be >= 0, got escalate="
+                f"{self.escalate_cooldown_s}, retract="
+                f"{self.retract_cooldown_s}"
+            )
+        if not (0.0 < self.retract_margin <= 1.0):
+            raise ValueError(
+                f"retract_margin must be in (0, 1], got {self.retract_margin}"
+            )
+        if self.min_samples < 1:
+            raise ValueError(
+                f"min_samples must be >= 1, got {self.min_samples}"
+            )
+        if self.service_prior_s <= 0:
+            raise ValueError(
+                f"service_prior_s must be > 0, got {self.service_prior_s}"
+            )
+        if self.service_window < 1:
+            raise ValueError(
+                f"service_window must be >= 1, got {self.service_window}"
+            )
+        if not (0.0 <= self.encode_share < 1.0):
+            raise ValueError(
+                f"encode_share must be in [0, 1), got {self.encode_share}"
+            )
+        # Lazy import, same convention as BucketTable below: the serve
+        # package imports this module at load time.  Normalization owns
+        # the tier-table invariants (ordering, knob validity) in ONE place.
+        from ..serve.controller import normalize_tier_table
+
+        self.tiers = normalize_tier_table(self.tiers)
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Configuration block for ``distrifuser_tpu.serve`` (the long-lived
     inference service).  Kept here, beside DistriConfig, so one module owns
@@ -715,6 +859,15 @@ class ServeConfig:
     # The aux-model sub-knob (weight_quant_aux) stays a builder decision:
     # it is fixed per builder, so it needs no per-key identity.
     weight_quant: str = "none"
+    # Service-wide PCPP partial-refresh fraction (DistriConfig.
+    # refresh_fraction semantics): threaded into every ExecKey — the
+    # strided refresh schedule is traced into the program, so a fraction
+    # change is a different executable.  1.0 (default) is the exact
+    # protocol; the SLO controller's partial_refresh tier overrides this
+    # per dispatch.  The pipeline builder behind executor_factory must
+    # construct its DistriConfig from key.refresh_fraction
+    # (serve.executors.apply_key_policy forces the field pre-prepare).
+    refresh_fraction: float = 1.0
     # Service-wide parallelization strategy (DistriConfig.parallelism
     # semantics, "patch" or "pipefusion"): threaded into every ExecKey —
     # patch-parallel and pipeline-parallel executors are different XLA
@@ -746,6 +899,20 @@ class ServeConfig:
     # including the staging_off rung — handle repeat offenders).
     pipeline_stages: bool = False
     max_inflight_batches: int = 2
+    # Prompt/embedding LRU cache in front of the text-encode stage
+    # (serve/promptcache.py): repeated prompts — the dominant production
+    # pattern — skip text-encode entirely.  Keyed by (family, tokenizer
+    # hash, prompt chunk); hit rate lands in the MetricsRegistry
+    # (serve_prompt_cache) and feeds the SLO controller's predicted
+    # service time (ControllerConfig.encode_share).  0 (default) disables.
+    prompt_cache_capacity: int = 0
+    # Closed-loop SLO controller (serve/controller.py, docs/SERVING.md
+    # "Closed-loop SLO control"): load-driven tier selection over the
+    # quality/cost lattice per slo_class, with admission control at the
+    # extreme.  Off by default — see ControllerConfig above.
+    controller: "ControllerConfig" = dataclasses.field(
+        default_factory=ControllerConfig
+    )
     # Failure handling: retries/backoff, per-key circuit breakers, the
     # execution watchdog, and the graceful-degradation ladder — see
     # ResilienceConfig above and docs/SERVING.md "Failure modes & tuning".
@@ -786,11 +953,21 @@ class ServeConfig:
                 "max_inflight_batches must be >= 1, got "
                 f"{self.max_inflight_batches}"
             )
+        if self.prompt_cache_capacity < 0:
+            raise ValueError(
+                "prompt_cache_capacity must be >= 0, got "
+                f"{self.prompt_cache_capacity}"
+            )
         validate_step_cache_knobs(self.step_cache_interval,
                                   self.step_cache_depth)
-        from ..parallel.compress import validate_mode, validate_weight_mode
+        from ..parallel.compress import (
+            validate_mode,
+            validate_refresh_fraction,
+            validate_weight_mode,
+        )
 
         validate_mode(self.comm_compress)
+        validate_refresh_fraction(self.refresh_fraction)
         validate_weight_mode(self.weight_quant)
         _SERVE_PARALLELISMS = ("patch", "pipefusion")
         if self.parallelism not in _SERVE_PARALLELISMS:
@@ -837,6 +1014,11 @@ class ServeConfig:
             raise ValueError(
                 "resilience must be a ResilienceConfig, got "
                 f"{type(self.resilience).__name__}"
+            )
+        if not isinstance(self.controller, ControllerConfig):
+            raise ValueError(
+                "controller must be a ControllerConfig, got "
+                f"{type(self.controller).__name__}"
             )
         if not isinstance(self.observability, ObservabilityConfig):
             raise ValueError(

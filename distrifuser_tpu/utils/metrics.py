@@ -524,20 +524,39 @@ class RollingQuantile:
     (O(w log w) on the rare read path — w is small and scrape-rate, not
     request-rate).  Locked like the other serve metrics: request
     completions land from the scheduler thread and the staged decode
-    worker concurrently."""
+    worker concurrently.
 
-    def __init__(self, window: int = 512):
+    ``max_age_s`` (with ``clock``) bounds how long a sample steers the
+    reads: a count-only ring is time-blind — after a burst, entries from
+    minutes ago keep pinning the p99 an idle server reports, and a
+    closed-loop controller would keep steering on load that no longer
+    exists.  Observations older than ``max_age_s`` at read time are
+    excluded from every quantile/snapshot (the ring still holds them;
+    ``count`` stays the lifetime total, the snapshot's ``window`` is the
+    LIVE sample count)."""
+
+    def __init__(self, window: int = 512,
+                 clock: Optional[Callable[[], float]] = None,
+                 max_age_s: Optional[float] = None):
         import threading
+        import time as _time
 
         assert window >= 1, window
+        assert max_age_s is None or max_age_s > 0, max_age_s
         self.window = window
+        self.max_age_s = max_age_s
+        self.clock = clock if clock is not None else _time.monotonic
         self._buf = np.zeros(window, np.float64)
+        self._ts = np.zeros(window, np.float64)
         self._n = 0  # total ever observed
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
+        t = self.clock() if self.max_age_s is not None else 0.0
         with self._lock:
-            self._buf[self._n % self.window] = float(v)
+            i = self._n % self.window
+            self._buf[i] = float(v)
+            self._ts[i] = t
             self._n += 1
 
     @property
@@ -546,7 +565,11 @@ class RollingQuantile:
             return self._n
 
     def _window_locked(self) -> np.ndarray:
-        return np.sort(self._buf[: min(self._n, self.window)].copy())
+        n = min(self._n, self.window)
+        vals = self._buf[:n]
+        if self.max_age_s is not None and n:
+            vals = vals[self._ts[:n] >= self.clock() - self.max_age_s]
+        return np.sort(vals.copy())
 
     @staticmethod
     def _rank(w: np.ndarray, q: float) -> float:
@@ -571,7 +594,10 @@ class RollingQuantile:
             w = self._window_locked()
             n = self._n
         if w.size == 0:
-            return {"count": 0, "window": 0}
+            # every sample may have AGED out of the window while the
+            # lifetime total keeps counting — a monotonic counter must
+            # never go backwards on an idle server
+            return {"count": n, "window": 0}
         return {
             "count": n,
             "window": int(w.size),
@@ -730,11 +756,22 @@ class MetricsRegistry:
         return g
 
     def rolling(self, name: str, window: int = 512,
-                labels: Optional[Dict] = None) -> RollingQuantile:
+                labels: Optional[Dict] = None,
+                clock: Optional[Callable[[], float]] = None,
+                max_age_s: Optional[float] = None) -> RollingQuantile:
         rq = self._get_or_create(
-            name, labels, lambda: RollingQuantile(window), RollingQuantile
+            name, labels,
+            lambda: RollingQuantile(window, clock=clock, max_age_s=max_age_s),
+            RollingQuantile,
         )
-        self._check_params(name, rq, {"window": window})
+        self._check_params(name, rq, {"window": window,
+                                      "max_age_s": max_age_s})
+        if clock is not None and rq.clock is not clock:
+            raise ValueError(
+                f"rolling window {name!r} is already registered with a "
+                "different clock — two time bases under one identity is "
+                "how aging lies"
+            )
         return rq
 
     def gap(self, name: str, labels: Optional[Dict] = None) -> GapTracker:

@@ -104,6 +104,11 @@ def add_distri_args(parser: argparse.ArgumentParser) -> None:
                         "wire (int8/fp8 + per-tile fp32 scales; "
                         "int8_residual delta-codes against the carried "
                         "stale value — docs/PERF.md)")
+    parser.add_argument("--refresh_fraction", type=float, default=1.0,
+                        help="PCPP partial refresh (docs/PERF.md): each "
+                        "stale step refreshes only this fraction (1/k) of "
+                        "every KV slab / conv halo, rotating the strided "
+                        "row group per step; 1.0 = the exact protocol")
     parser.add_argument("--weight_quant", type=str, default="none",
                         choices=["none", "int8", "fp8"],
                         help="hold the denoiser's matmul/conv kernels as "
@@ -167,6 +172,7 @@ def config_from_args(args) -> DistriConfig:
         ulysses_degree=args.ulysses_degree,
         comm_batch=args.comm_batch,
         comm_compress=args.comm_compress,
+        refresh_fraction=getattr(args, "refresh_fraction", 1.0),
         weight_quant=getattr(args, "weight_quant", "none"),
         weight_quant_aux=getattr(args, "weight_quant_aux", "none"),
         hybrid_loop=args.hybrid_loop,

@@ -1,0 +1,288 @@
+"""Closed-loop SLO controller bench: sustainable goodput at a fixed p99.
+
+Ramped open-loop load against an `InferenceServer` on the deterministic
+weightless fakes (serve/testing.py), run twice — controller OFF
+(today's behavior: every request at full quality) and controller ON
+(serve/controller.py: per-class tier walk over the quality/cost lattice
+with admission control at the extreme).  For each arrival-rate rung the
+bench measures the completed-request p99 and the **goodput**: requests
+completed *within the SLO* per second of wall time.  A rung "holds" when
+its measured p99 is <= the SLO target; the **sustainable goodput** is the
+best goodput over the holding rungs.
+
+Gates (exit 1 on failure):
+  * ``--gate``       — controller-on sustainable goodput must be >= this
+    multiple of controller-off (acceptance: 1.3x).  The uncontrolled
+    server saturates at full-quality capacity and then blows its p99;
+    the controller keeps the SLO by walking tiers and shedding the
+    overflow at admission, so its within-SLO throughput keeps climbing.
+  * ``--pcpp_gate``  — the PCPP tier must be real model work, not a fake
+    knob: closed-form `pipelines.comm_plan` on the tiny UNet at
+    ``refresh_fraction=0.5`` must show >= this stale-refresh byte
+    reduction vs the fraction-1 plan (acceptance: 1.5x; the live-counter
+    reconciliation of the same closed form is pinned in
+    tests/test_pcpp.py).
+
+Emits ONE ``"schema": 1`` JSON line (scripts/common.py) and, with
+``--trace_out``, the controller-on overload rung's Perfetto trace —
+tier escalations/retractions land on the "controller" track.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/slo_bench.py \
+        [--rates 6,12,24,40,60,80] [--duration 2.0] [--slo_p99 0.35] \
+        [--gate 1.3] [--pcpp_gate 1.5] [--out FILE] [--trace_out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import emit_bench_line  # noqa: E402
+
+PROMPTS = ("an astronaut", "a skyline at dusk", "a dew-covered leaf")
+
+
+def run_rung(rate: float, duration: float, args, controlled: bool,
+             trace: bool = False):
+    """One open-loop rung on a fresh server; returns the measurement."""
+    from distrifuser_tpu.serve import (
+        ControllerConfig,
+        InferenceServer,
+        ObservabilityConfig,
+        RetryableError,
+        ServeConfig,
+    )
+    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=args.batch_window_s,
+        buckets=((512, 512),),
+        warmup_buckets=((512, 512, args.steps),),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        controller=ControllerConfig(
+            enabled=controlled,
+            slo_p99_s={"default": args.slo_p99},
+            escalate_cooldown_s=args.escalate_cooldown_s,
+            retract_cooldown_s=args.retract_cooldown_s,
+            service_prior_s=args.fake_step_s * args.steps,
+        ),
+        observability=ObservabilityConfig(trace=trace),
+    )
+    factory = FakeExecutorFactory(
+        batch_size=args.max_batch_size, build_delay_s=0.0,
+        step_time_s=args.fake_step_s,
+    )
+    server = InferenceServer(factory, config, model_id="slo-bench")
+    futures = []
+    rejected = 0
+    t0 = time.monotonic()
+    with server:
+        interval = 1.0 / rate
+        n = int(rate * duration)
+        for i in range(n):
+            try:
+                futures.append(server.submit(
+                    PROMPTS[i % len(PROMPTS)], height=512, width=512,
+                    seed=i, ttl_s=args.ttl_s,
+                ))
+            except RetryableError:
+                rejected += 1  # queue-full backpressure or admission
+            time.sleep(interval)
+        lat = []
+        failed = 0
+        for f in futures:
+            try:
+                r = f.result(timeout=args.ttl_s + 30)
+                lat.append(r.e2e_s)
+            except Exception:
+                failed += 1
+        wall = time.monotonic() - t0
+        ctl = server.metrics_snapshot()["controller"]
+        if trace and server.tracer is not None and args.trace_out:
+            server.tracer.export(args.trace_out)
+    lat.sort()
+    p99 = lat[max(0, int(0.99 * (len(lat) - 1)))] if lat else float("inf")
+    within = sum(1 for v in lat if v <= args.slo_p99)
+    return {
+        "rate_rps": rate,
+        "offered": n,
+        "rejected": rejected,
+        "completed": len(lat),
+        "failed": failed,
+        "p99_s": p99,
+        "holds_slo": bool(lat) and p99 <= args.slo_p99,
+        "goodput_rps": within / wall if wall > 0 else 0.0,
+        "controller": ctl,
+    }
+
+
+def sustainable_goodput(rungs) -> float:
+    """Best within-SLO throughput over the rungs whose measured p99
+    holds the target (0.0 when none hold)."""
+    return max((r["goodput_rps"] for r in rungs if r["holds_slo"]),
+               default=0.0)
+
+
+def pcpp_closed_form(args) -> dict:
+    """Closed-form `comm_plan` byte reduction of the PCPP tier on the
+    tiny UNet pipeline: fraction 0.5 vs 1.0, eval_shape only (no device
+    work, no compile)."""
+    import jax
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.clip import init_clip_params, tiny_clip_config
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+
+    def plan(fraction: float) -> dict:
+        dcfg = DistriConfig(
+            devices=jax.devices()[: args.pcpp_devices], height=128,
+            width=128, warmup_steps=1, split_batch=False,
+            refresh_fraction=fraction,
+        )
+        tc = tiny_clip_config(hidden=32)
+        ucfg = tiny_config(cross_attention_dim=32, sdxl=False)
+        vcfg = tiny_vae_config()
+        pipe = DistriSDPipeline.from_params(
+            dcfg, ucfg, init_unet_params(jax.random.PRNGKey(0), ucfg),
+            vcfg, init_vae_params(jax.random.PRNGKey(1), vcfg),
+            [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+            scheduler="ddim",
+        )
+        return pipe.comm_plan(args.pcpp_steps)
+
+    full, half = plan(1.0), plan(0.5)
+    # the stale phase carries the refresh traffic the PCPP tier thins;
+    # sync (warmup) bytes must be identical by construction
+    stale_full = full["bytes_per_step"]["stale"]
+    stale_half = half["bytes_per_step"]["stale"]
+    return {
+        "refresh_fraction": half["refresh_fraction"],
+        "stale_bytes_per_step_full": stale_full,
+        "stale_bytes_per_step_half": stale_half,
+        "sync_bytes_identical": (full["bytes_per_step"]["sync"]
+                                 == half["bytes_per_step"]["sync"]),
+        "stale_byte_reduction": (stale_full / stale_half
+                                 if stale_half else 0.0),
+        "total_bytes_full": full["total_bytes"],
+        "total_bytes_half": half["total_bytes"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rates", type=str, default="6,12,24,40,60,80",
+                    help="comma-separated open-loop arrival rates (rps)")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds of offered load per rung")
+    ap.add_argument("--slo_p99", type=float, default=0.35,
+                    help="the fixed p99 SLO target (seconds, e2e)")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--ttl_s", type=float, default=8.0)
+    ap.add_argument("--fake_step_s", type=float, default=0.02,
+                    help="simulated per-step latency of the fakes")
+    ap.add_argument("--max_batch_size", type=int, default=4)
+    ap.add_argument("--batch_window_s", type=float, default=0.005)
+    ap.add_argument("--max_queue_depth", type=int, default=256)
+    ap.add_argument("--escalate_cooldown_s", type=float, default=0.05)
+    ap.add_argument("--retract_cooldown_s", type=float, default=0.5)
+    ap.add_argument("--gate", type=float, default=0.0,
+                    help="fail unless on/off sustainable-goodput ratio "
+                         ">= this (0 disables; acceptance gate: 1.3)")
+    ap.add_argument("--pcpp_gate", type=float, default=0.0,
+                    help="fail unless the closed-form PCPP stale-byte "
+                         "reduction at fraction 0.5 >= this (0 disables; "
+                         "acceptance gate: 1.5)")
+    ap.add_argument("--pcpp_devices", type=int, default=2)
+    ap.add_argument("--pcpp_steps", type=int, default=8)
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--trace_out", type=str, default=None,
+                    help="write the controller-on overload rung's "
+                         "Perfetto trace here")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.pcpp_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.pcpp_devices}"
+            ).strip()
+
+    rates = [float(r) for r in args.rates.split(",") if r]
+    results = {"off": [], "on": []}
+    for mode, controlled in (("off", False), ("on", True)):
+        for i, rate in enumerate(rates):
+            trace = (controlled and bool(args.trace_out)
+                     and i == len(rates) - 1)
+            results[mode].append(
+                run_rung(rate, args.duration, args, controlled, trace))
+    sus_off = sustainable_goodput(results["off"])
+    sus_on = sustainable_goodput(results["on"])
+    ratio = sus_on / sus_off if sus_off > 0 else 0.0
+    pcpp = pcpp_closed_form(args)
+
+    artifact = {
+        "bench": {
+            "slo_p99_s": args.slo_p99,
+            "rates_rps": rates,
+            "duration_s": args.duration,
+            "steps": args.steps,
+            "fake_step_s": args.fake_step_s,
+            "max_batch_size": args.max_batch_size,
+            "gate": args.gate,
+            "pcpp_gate": args.pcpp_gate,
+        },
+        "uncontrolled": results["off"],
+        "controlled": results["on"],
+        "sustainable_goodput_off_rps": sus_off,
+        "sustainable_goodput_on_rps": sus_on,
+        "goodput_ratio": ratio,
+        "pcpp": pcpp,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    emit_bench_line({
+        "metric": "slo_controller_goodput_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "slo_p99_s": args.slo_p99,
+        "sustainable_goodput_off_rps": round(sus_off, 3),
+        "sustainable_goodput_on_rps": round(sus_on, 3),
+        "pcpp_stale_byte_reduction": round(pcpp["stale_byte_reduction"], 3),
+        "pcpp_sync_bytes_identical": pcpp["sync_bytes_identical"],
+        "final_tier_on_overload": results["on"][-1]["controller"][
+            "classes"].get("default", {}).get("tier_name"),
+    })
+    fail = []
+    if args.gate > 0 and ratio < args.gate:
+        fail.append(f"goodput ratio {ratio:.3f}x < gate {args.gate}x")
+    if args.pcpp_gate > 0 and (
+            pcpp["stale_byte_reduction"] < args.pcpp_gate
+            or not pcpp["sync_bytes_identical"]):
+        fail.append(
+            f"PCPP stale-byte reduction "
+            f"{pcpp['stale_byte_reduction']:.3f}x < gate {args.pcpp_gate}x "
+            f"(sync identical: {pcpp['sync_bytes_identical']})")
+    if fail:
+        print("GATE FAILED: " + "; ".join(fail), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""PCPP partial refresh (DistriConfig.refresh_fraction): validation, the
+strided take/scatter helpers, three-family stale parity at pinned
+tolerances, warmup bit-exactness, stepwise==fused replay, byte-accurate
+accounting (eval_shape only — no compiles for the acceptance mesh), the
+closed-form comm_report/comm_plan keys, and the live StepTimeline
+reconciliation at refresh_fraction < 1 (the PR-8 exact-reconciliation pin
+extended to the partial-refresh byte model)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distrifuser_tpu.models.dit as dit_mod
+import distrifuser_tpu.models.mmdit as mm
+from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+from distrifuser_tpu.parallel.compress import (
+    refresh_period,
+    scatter_every_kth,
+    take_every_kth,
+    validate_refresh_fraction,
+)
+from distrifuser_tpu.parallel.dit_sp import DiTDenoiseRunner
+from distrifuser_tpu.parallel.mmdit_sp import MMDiTDenoiseRunner
+from distrifuser_tpu.parallel.runner import DenoiseRunner
+from distrifuser_tpu.schedulers import get_scheduler
+from distrifuser_tpu.utils.config import DistriConfig
+
+
+# ---------------------------------------------------------------------------
+# validation + helpers (no devices)
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_fraction_validation():
+    validate_refresh_fraction(1.0)
+    validate_refresh_fraction(0.5)
+    validate_refresh_fraction(0.25)
+    assert refresh_period(0.5) == 2
+    assert refresh_period(1.0) == 1
+    for bad in (0.0, -0.5, 1.5, 0.3, 0.6):
+        with pytest.raises(ValueError):
+            validate_refresh_fraction(bad)
+
+    kw = dict(devices=jax.devices()[:1], height=128, width=128)
+    with pytest.raises(ValueError, match="refresh_fraction"):
+        DistriConfig(refresh_fraction=0.3, **kw)
+    with pytest.raises(ValueError, match="refresh traffic to thin"):
+        DistriConfig(refresh_fraction=0.5, parallelism="tensor", **kw)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        DistriConfig(refresh_fraction=0.5, comm_batch=True, **kw)
+    # pipefusion has no stale refresh to thin either
+    with pytest.raises(ValueError, match="refresh traffic to thin"):
+        DistriConfig(refresh_fraction=0.5, parallelism="pipefusion", **kw)
+
+
+def test_dit_rejects_partial_refresh_off_gather():
+    dcfg = dit_mod.tiny_dit_config()
+    dparams = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+    cfg = DistriConfig(devices=jax.devices()[:2],
+                       height=dcfg.sample_size * 8,
+                       width=dcfg.sample_size * 8, split_batch=False,
+                       refresh_fraction=0.5, attn_impl="ring")
+    with pytest.raises(ValueError, match="refresh collective to thin"):
+        DiTDenoiseRunner(cfg, dcfg, dparams, get_scheduler("ddim"))
+
+
+def test_take_scatter_helpers_roundtrip():
+    x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+    sub = take_every_kth(x, 2, jnp.asarray(1))
+    np.testing.assert_array_equal(np.asarray(sub), np.asarray(x[:, 1::2]))
+    back = scatter_every_kth(jnp.zeros_like(x), sub, 2, jnp.asarray(1))
+    np.testing.assert_array_equal(np.asarray(back[:, 1::2]), np.asarray(sub))
+    assert float(jnp.abs(back[:, 0::2]).sum()) == 0.0
+    # grouped (tiled-all-gather layout): the stride applies within each
+    # contiguous per-device segment
+    xg = jnp.arange(2 * 12 * 3, dtype=jnp.float32).reshape(2, 12, 3)
+    subg = take_every_kth(xg, 2, jnp.asarray(0), groups=2)
+    exp = np.concatenate(
+        [np.asarray(xg[:, 0:6:2]), np.asarray(xg[:, 6:12:2])], axis=1)
+    np.testing.assert_array_equal(np.asarray(subg), exp)
+    full = scatter_every_kth(xg, subg, 2, jnp.asarray(0), groups=2)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(xg))
+    with pytest.raises(ValueError, match="divisible"):
+        take_every_kth(jnp.zeros((2, 7, 3)), 2, jnp.asarray(0))
+
+
+# ---------------------------------------------------------------------------
+# UNet family: parity / warmup exactness / stepwise replay (2-dev compiles)
+# ---------------------------------------------------------------------------
+
+
+def _unet_runner(n, **kw):
+    kw.setdefault("warmup_steps", 1)
+    kw.setdefault("split_batch", False)
+    cfg = DistriConfig(devices=jax.devices()[:n], height=128, width=128,
+                       parallelism="patch", **kw)
+    ucfg = tiny_config(sdxl=False)
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    return DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim")), cfg, ucfg
+
+
+def _unet_inputs(cfg, ucfg):
+    k = jax.random.PRNGKey(42)
+    lat = jax.random.normal(
+        k, (1, cfg.latent_height, cfg.latent_width, ucfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 7, ucfg.cross_attention_dim))
+    return lat, enc
+
+
+# Pinned partial-refresh parity tolerances (relative max vs the
+# full-refresh run), measured on the tiny config at 2-dev sp2, 5 steps:
+# f=0.5 1.18e-2 alone and with int8 / int8_residual stacked (the extra
+# staleness dominates the quantization error).  ~4x margin for platform
+# variation; far below the 0.35 displaced-mode gate in test_runner.py.
+PCPP_UNET_TOL = 0.05
+
+
+def test_unet_partial_refresh_parity_and_stepwise():
+    r_off, cfg, ucfg = _unet_runner(2)
+    lat, enc = _unet_inputs(cfg, ucfg)
+    a = np.asarray(r_off.generate(lat, enc, num_inference_steps=5))
+    r_half, _, _ = _unet_runner(2, refresh_fraction=0.5)
+    b = np.asarray(r_half.generate(lat, enc, num_inference_steps=5))
+    assert np.isfinite(b).all()
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    assert 0 < rel < PCPP_UNET_TOL, f"f=0.5 drift {rel}"
+    # the host-driven stepwise loop replays the exact rotation schedule
+    r_sw, _, _ = _unet_runner(2, refresh_fraction=0.5, use_cuda_graph=False)
+    c = np.asarray(r_sw.generate(lat, enc, num_inference_steps=5))
+    np.testing.assert_allclose(b, c, atol=2e-4)
+
+
+@pytest.mark.slow  # secondary compiles: the fused-vs-stepwise pair above
+# is the tier-1 gate; residual composition and warmup exactness add two
+# more 2-dev fused programs to the 870s budget
+def test_unet_partial_refresh_residual_and_warmup():
+    r_off, cfg, ucfg = _unet_runner(2)
+    lat, enc = _unet_inputs(cfg, ucfg)
+    a = np.asarray(r_off.generate(lat, enc, num_inference_steps=5))
+    # composition with the closed-loop residual coder stays bounded
+    r_res, _, _ = _unet_runner(2, refresh_fraction=0.5,
+                               comm_compress="int8_residual")
+    d = np.asarray(r_res.generate(lat, enc, num_inference_steps=5))
+    assert np.isfinite(d).all()
+    rel = np.abs(a - d).max() / (np.abs(a).max() + 1e-6)
+    assert 0 < rel < PCPP_UNET_TOL, f"f=0.5+residual drift {rel}"
+    # a run that never leaves warmup is bit-identical: partial refresh
+    # touches only the stale phase, sync exchanges always move whole
+    r_w0, _, _ = _unet_runner(2, warmup_steps=4)
+    r_w1, _, _ = _unet_runner(2, warmup_steps=4, refresh_fraction=0.5)
+    w0 = np.asarray(r_w0.generate(lat, enc, num_inference_steps=3))
+    w1 = np.asarray(r_w1.generate(lat, enc, num_inference_steps=3))
+    np.testing.assert_array_equal(w0, w1)
+
+
+# ---------------------------------------------------------------------------
+# byte-accurate accounting (eval_shape only — the acceptance mesh runs in
+# tier-1 without compiles)
+# ---------------------------------------------------------------------------
+
+
+def _bytes_report(devices8, **kw):
+    cfg = DistriConfig(devices=devices8, height=128, width=128,
+                       warmup_steps=1, parallelism="patch", **kw)
+    ucfg = tiny_config(sdxl=False)
+    params = init_unet_params(jax.random.PRNGKey(0), ucfg)
+    r = DenoiseRunner(cfg, ucfg, params, get_scheduler("ddim"))
+    return r.comm_volume_report(per_phase=True)
+
+
+def test_bytes_report_partial_refresh_reduction(devices8):
+    """Acceptance: >= 1.5x stale-refresh BYTE reduction at fraction 0.5
+    on the tiny config (the GN moments never thin, so the ratio lands
+    between 1.5x and 2x), sync bytes identical, gn bytes identical."""
+    off = _bytes_report(devices8)
+    on = _bytes_report(devices8, refresh_fraction=0.5)
+    assert off["bytes"]["sync"] == on["bytes"]["sync"]
+    assert off["phases"] == on["phases"]  # carry shapes are fraction-blind
+    s_off = sum(off["bytes"]["stale"].values())
+    s_on = sum(on["bytes"]["stale"].values())
+    assert s_off / s_on >= 1.5, (off["bytes"]["stale"], on["bytes"]["stale"])
+    for kind in ("attn", "conv2d"):
+        assert on["bytes"]["stale"][kind] < off["bytes"]["stale"][kind]
+    assert on["bytes"]["stale"]["gn"] == off["bytes"]["stale"]["gn"]
+    assert on["refresh_fraction"] == 0.5
+    assert off["refresh_fraction"] == 1.0
+
+
+def test_bytes_report_partial_composes_with_int8(devices8):
+    """Fraction and quantization stack: int8 at fraction 0.5 spends less
+    stale wire than either alone."""
+    int8 = _bytes_report(devices8, comm_compress="int8")
+    both = _bytes_report(devices8, comm_compress="int8",
+                         refresh_fraction=0.5)
+    half = _bytes_report(devices8, refresh_fraction=0.5)
+    s = lambda rep: sum(rep["bytes"]["stale"].values())  # noqa: E731
+    assert s(both) < s(int8)
+    assert s(both) < s(half)
+
+
+def test_dit_mmdit_closed_form_partial_keys():
+    """The DiT/MMDiT closed forms carry the partial-refresh keys:
+    full_refresh_* equals the fraction-1 report, the thinned per-step
+    bytes shrink, sync stays whole."""
+    dcfg = dit_mod.tiny_dit_config()
+    dparams = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+
+    def dit_rep(**kw):
+        cfg = DistriConfig(devices=jax.devices()[:2],
+                           height=dcfg.sample_size * 8,
+                           width=dcfg.sample_size * 8, split_batch=False,
+                           **kw)
+        return DiTDenoiseRunner(cfg, dcfg, dparams,
+                                get_scheduler("ddim")).comm_report()
+
+    full, half = dit_rep(), dit_rep(refresh_fraction=0.5)
+    assert half["refresh_fraction"] == 0.5
+    assert (half["full_refresh_per_step_collective_bytes"]
+            == full["per_step_collective_bytes"])
+    assert (half["per_step_collective_bytes"]
+            < full["per_step_collective_bytes"])
+    assert (half["sync_step_collective_bytes"]
+            == full["sync_step_collective_bytes"])
+
+    mcfg = mm.tiny_mmdit_config()
+    mparams = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+
+    def mm_rep(**kw):
+        cfg = DistriConfig(devices=jax.devices()[:2],
+                           height=mcfg.sample_size * 8,
+                           width=mcfg.sample_size * 8, split_batch=False,
+                           **kw)
+        return MMDiTDenoiseRunner(cfg, mcfg, mparams,
+                                  get_scheduler("flow-euler")).comm_report()
+
+    mfull, mhalf = mm_rep(), mm_rep(refresh_fraction=0.5)
+    assert (mhalf["full_refresh_per_step_collective_bytes"]
+            == mfull["per_step_collective_bytes"])
+    assert (mhalf["per_step_collective_bytes"]
+            < mfull["per_step_collective_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# DiT / MMDiT numeric parity (2-dev compiles, 5 steps)
+# ---------------------------------------------------------------------------
+
+# Measured drifts on the tiny configs (2-dev, 5 steps): DiT 9.0e-5,
+# MMDiT 8.0e-4 — an order below the compress-mode pins in
+# test_compress.py.  ~10x margin.
+PCPP_DIT_TOL = 5e-3
+PCPP_MMDIT_TOL = 2e-2
+
+
+def test_dit_partial_refresh_parity():
+    dcfg = dit_mod.tiny_dit_config()
+    params = dit_mod.init_dit_params(jax.random.PRNGKey(0), dcfg)
+    k = jax.random.PRNGKey(3)
+    lat = jax.random.normal(
+        k, (1, dcfg.sample_size, dcfg.sample_size, dcfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 8, dcfg.caption_dim))
+
+    def mk(**kw):
+        cfg = DistriConfig(devices=jax.devices()[:2],
+                           height=dcfg.sample_size * 8,
+                           width=dcfg.sample_size * 8, warmup_steps=1,
+                           split_batch=False, **kw)
+        return DiTDenoiseRunner(cfg, dcfg, params, get_scheduler("ddim"))
+
+    a = np.asarray(mk().generate(lat, enc, num_inference_steps=5))
+    b = np.asarray(mk(refresh_fraction=0.5).generate(
+        lat, enc, num_inference_steps=5))
+    assert np.isfinite(b).all()
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    assert 0 < rel < PCPP_DIT_TOL, f"DiT f=0.5 drift {rel}"
+
+
+def test_mmdit_partial_refresh_parity():
+    mcfg = mm.tiny_mmdit_config()
+    params = mm.init_mmdit_params(jax.random.PRNGKey(0), mcfg)
+    k = jax.random.PRNGKey(7)
+    lat = jax.random.normal(
+        k, (1, mcfg.sample_size, mcfg.sample_size, mcfg.in_channels))
+    enc = jax.random.normal(
+        jax.random.fold_in(k, 1), (2, 1, 5, mcfg.joint_attention_dim))
+    pooled = jax.random.normal(
+        jax.random.fold_in(k, 2), (2, 1, mcfg.pooled_projection_dim))
+
+    def mk(**kw):
+        cfg = DistriConfig(devices=jax.devices()[:2],
+                           height=mcfg.sample_size * 8,
+                           width=mcfg.sample_size * 8, warmup_steps=1,
+                           split_batch=False, **kw)
+        return MMDiTDenoiseRunner(cfg, mcfg, params,
+                                  get_scheduler("flow-euler"))
+
+    a = np.asarray(mk().generate(lat, enc, pooled, num_inference_steps=5))
+    b = np.asarray(mk(refresh_fraction=0.5).generate(
+        lat, enc, pooled, num_inference_steps=5))
+    assert np.isfinite(b).all()
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    assert 0 < rel < PCPP_MMDIT_TOL, f"MMDiT f=0.5 drift {rel}"
+
+
+# ---------------------------------------------------------------------------
+# live StepTimeline <-> closed-form comm_plan reconciliation at f < 1
+# (the PR-8 exact-reconciliation pin, extended to the PCPP byte model)
+# ---------------------------------------------------------------------------
+
+
+def test_comm_plan_partial_refresh_reconciles_live(devices8):
+    from test_pipelines import build_sd_pipeline
+
+    from distrifuser_tpu.utils.trace import StepTimeline
+
+    pipe, _ = build_sd_pipeline(devices8, 2, split_batch=False,
+                                refresh_fraction=0.5)
+    tl = pipe.attach_step_timeline(StepTimeline())
+    pipe("a cat", num_inference_steps=5, seed=0, output_type="latent")
+    snap = tl.snapshot()
+    plan = pipe.comm_plan(5)
+    assert plan["refresh_fraction"] == 0.5
+    # live per-executed-step byte counters == closed-form plan, exactly
+    assert snap["comm_bytes"] == plan["total_bytes"]
+    assert snap["comm_bytes_tracked"] is True
+    # the half-refresh plan undercuts the full-refresh plan on the stale
+    # phase by >= 1.5x (acceptance; GN moments never thin)
+    pipe_full, _ = build_sd_pipeline(devices8, 2, split_batch=False)
+    plan_full = pipe_full.comm_plan(5)
+    assert (plan_full["bytes_per_step"]["sync"]
+            == plan["bytes_per_step"]["sync"])
+    ratio = (plan_full["bytes_per_step"]["stale"]
+             / plan["bytes_per_step"]["stale"])
+    assert ratio >= 1.5, ratio
