@@ -19,7 +19,12 @@ star: heavy traffic, mesh never idle):
   ``ServeConfig.pipeline_stages``): overlap text-encode, denoise, and
   VAE-decode across micro-batches, bit-identical to monolithic dispatch;
 * `PipelineExecutor` — adapter from the repo's pipelines
-  (serve/executors.py); `serve.testing` has the weightless fakes.
+  (serve/executors.py); `serve.testing` has the weightless fakes;
+* `Replica` + `FleetRouter` — the multi-replica control plane
+  (serve/replica.py, serve/fleet.py): lifecycle-managed replicas
+  (starting → warming → serving → draining → stopped) behind a
+  health-scored, failover-capable front router; a 1-replica fleet is
+  behaviorally the bare `InferenceServer`.
 
 ``python -m distrifuser_tpu.serve --demo`` runs a CPU-only end-to-end
 demonstration (serve/__main__.py); ``scripts/serve_bench.py`` is the
@@ -30,6 +35,7 @@ load under a fault plan.  Architecture notes: docs/SERVING.md.
 from ..utils.config import (
     DEFAULT_BUCKETS,
     ControllerConfig,
+    FleetConfig,
     ObservabilityConfig,
     ResilienceConfig,
     ServeConfig,
@@ -53,6 +59,7 @@ from .errors import (
     ExecuteFailedError,
     FatalError,
     NoBucketError,
+    NoHealthyReplicaError,
     QueueFullError,
     ResourceExhaustedError,
     RetryableError,
@@ -61,8 +68,18 @@ from .errors import (
     WatchdogTimeoutError,
 )
 from .faults import FaultPlan, FaultRule, install_fault_plan
+from .fleet import FleetRouter, build_fleet, routing_weight
 from .promptcache import PromptCache
 from .queue import Request, RequestQueue, ServeResult
+from .replica import (
+    REPLICA_DRAINING,
+    REPLICA_SERVING,
+    REPLICA_STARTING,
+    REPLICA_STATES,
+    REPLICA_STOPPED,
+    REPLICA_WARMING,
+    Replica,
+)
 from .resilience import (
     BackoffPolicy,
     CircuitBreaker,
@@ -108,14 +125,24 @@ __all__ = [
     "FatalError",
     "FaultPlan",
     "FaultRule",
+    "FleetConfig",
+    "FleetRouter",
     "InferenceServer",
     "MetricsRegistry",
     "MicroBatcher",
     "NoBucketError",
+    "NoHealthyReplicaError",
     "ObservabilityConfig",
     "PipelineExecutor",
     "PromptCache",
     "QueueFullError",
+    "REPLICA_DRAINING",
+    "REPLICA_SERVING",
+    "REPLICA_STARTING",
+    "REPLICA_STATES",
+    "REPLICA_STOPPED",
+    "REPLICA_WARMING",
+    "Replica",
     "Request",
     "RequestQueue",
     "ResilienceConfig",
@@ -136,6 +163,8 @@ __all__ = [
     "Watchdog",
     "WatchdogTimeoutError",
     "apply_tier",
+    "build_fleet",
     "install_fault_plan",
     "pipeline_executor_factory",
+    "routing_weight",
 ]

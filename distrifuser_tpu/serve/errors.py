@@ -67,6 +67,14 @@ class AdmissionRejectedError(RetryableError):
     replica or after the load subsides."""
 
 
+class NoHealthyReplicaError(RetryableError):
+    """The fleet router (serve/fleet.py) found no replica able to admit
+    this request right now: every replica is draining, stopped, faulted,
+    or rejecting at its own admission boundary.  HTTP-503 analog, like
+    `CircuitOpenError` but fleet-scoped; retry after backoff — a probe or
+    restart may return capacity."""
+
+
 class WatchdogTimeoutError(RetryableError):
     """Batch execution exceeded the watchdog wall-time bound; the batch
     was abandoned (HTTP-504 analog).  The mesh work may still be running

@@ -20,7 +20,12 @@ Injection sites (the convention — sites are plain strings):
   / `PipelineExecutor.__call__` for direct (server-less) executor use;
 * ``"runner.compile"`` — `DenoiseRunner.compiled_handle` before building a
   fused-loop program (reads the process-global plan, see
-  `install_fault_plan`, because the runner has no serve-layer plumbing).
+  `install_fault_plan`, because the runner has no serve-layer plumbing);
+* ``"replica"`` — `serve.replica.Replica` at the top of every monolithic
+  executor dispatch.  The site's key stringifies to the REPLICA NAME, so
+  ``key_substr`` targets a named replica; combined with ``after_calls``
+  a rule kills / hangs / degrades that replica deterministically
+  mid-load (fleet failover is what the site exists to exercise).
 
 Fault kinds:
 
@@ -32,7 +37,14 @@ Fault kinds:
 * ``hang`` — sleeps ``hang_s`` then returns normally, modelling a stalled
   device that eventually recovers.  Under a watchdog the call is abandoned
   at the timeout; the sleeping thread finishes in the background and its
-  result is discarded.
+  result is discarded;
+* ``kill`` — raises `InjectedReplicaKilled`, modelling a replica process
+  dying mid-dispatch.  Only meaningful at the ``"replica"`` site: the
+  `Replica` catches it in its executor wrapper, transitions to STOPPED,
+  SYNCHRONOUSLY signals the server's shutdown (queued futures fail with
+  `ServerClosedError`; the blocking scheduler join runs in the
+  background), and re-raises so the in-flight batch fails terminally —
+  the fleet router then fails the whole replica's load over.
 
 Only the ``execute`` sites run under the watchdog.  A ``hang`` injected
 at a build/compile site blocks its caller for the full ``hang_s`` —
@@ -52,7 +64,7 @@ import time
 import zlib
 from typing import Dict, Optional, Sequence, Tuple
 
-FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang")
+FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang", "kill")
 
 
 class InjectedFault(Exception):
@@ -74,6 +86,12 @@ class InjectedResourceExhausted(RuntimeError, InjectedFault):
     real faults."""
 
 
+class InjectedReplicaKilled(RuntimeError, InjectedFault):
+    """The ``kill`` kind at the ``"replica"`` site: the replica process
+    "died" — its in-flight dispatch fails with this, and the `Replica`
+    handle shuts its server down (see serve/replica.py)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultRule:
     """One injection rule: WHERE (site + filters), WHAT (kind), WHEN
@@ -89,6 +107,7 @@ class FaultRule:
     kind: str
     p: float = 0.0  # per-eligible-call probability
     at_calls: Tuple[int, ...] = ()  # exact site-call indices (0-based)
+    after_calls: int = 0  # eligible only once the site saw >= this many calls
     min_batch: int = 0  # only fire when batch_size >= min_batch
     key_substr: str = ""  # only fire when ExecKey.short() contains this
     max_fires: int = -1  # -1 = unbounded
@@ -107,6 +126,10 @@ class FaultRule:
                 "explicit at_calls indices — a rule that can never fire is a "
                 "misconfigured plan, not a no-op"
             )
+        if self.after_calls < 0:
+            raise ValueError(
+                f"after_calls must be >= 0, got {self.after_calls}"
+            )
 
 
 def _raise_fault(rule: FaultRule, site: str) -> None:
@@ -120,6 +143,8 @@ def _raise_fault(rule: FaultRule, site: str) -> None:
             f"RESOURCE_EXHAUSTED: {msg} (simulated out-of-memory while "
             "allocating device buffers)"
         )
+    if rule.kind == "kill":
+        raise InjectedReplicaKilled(msg)
     raise AssertionError(rule.kind)  # hang handled by the caller
 
 
@@ -169,6 +194,10 @@ class FaultPlan:
             self._site_calls[site] = call_idx + 1
             for i, rule in enumerate(self.rules):
                 if rule.site != site:
+                    continue
+                if call_idx < rule.after_calls:
+                    # index-gated like at_calls: the rule's RNG stream
+                    # does not advance on calls before its window opens
                     continue
                 if not self._eligible(rule, key, batch_size):
                     continue

@@ -102,6 +102,15 @@ class ServeResult:
     # (serve/resilience.py) were active for its executor key
     retries: int = 0
     degradations: tuple = ()
+    # quality/placement audit trail: the ExecKey the request ACTUALLY
+    # executed at (short tag — carries every compile-identity knob incl.
+    # tier overrides and ladder rungs), the SLO-controller tier name it
+    # dispatched under (None when the controller is off), and which fleet
+    # replica served it (None on a bare single server).  Clients and
+    # benches read these to audit quality degradation per request.
+    exec_key: str = ""
+    tier: Optional[str] = None
+    replica: Optional[str] = None
 
 
 class RequestQueue:

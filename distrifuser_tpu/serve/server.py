@@ -102,6 +102,8 @@ class InferenceServer:
         mesh_plan: str = "dp1.cfg1.sp1",
         clock: Callable[[], float] = time.monotonic,
         fault_plan: Optional[FaultPlan] = None,
+        registry: Optional[MetricsRegistry] = None,
+        replica_name: Optional[str] = None,
     ):
         self.config = config or ServeConfig()
         self.model_id = model_id
@@ -109,6 +111,7 @@ class InferenceServer:
         self.mesh_plan = mesh_plan
         self.clock = clock
         self.fault_plan = fault_plan
+        self.replica_name = replica_name
         self.queue = RequestQueue(self.config.max_queue_depth)
         # self.prompt_cache is created below (it needs the registry); the
         # factory wrapper reads the attribute lazily at build time, which
@@ -142,8 +145,14 @@ class InferenceServer:
         # Counter/LatencyHistogram/GapTracker/RingLog the server and its
         # sub-pieces mutate is OWNED here under hierarchical names, so
         # /metrics (Prometheus), /metrics.json, and metrics_snapshot()
-        # all render one source of truth
-        self.registry = MetricsRegistry()
+        # all render one source of truth.  A fleet (serve/fleet.py)
+        # passes one SHARED registry plus a replica_name: every metric
+        # this server creates then carries a {"replica": name} label, so
+        # two replicas' otherwise-identical gauges are distinct label
+        # sets in the shared plane instead of a registration collision.
+        base_registry = registry if registry is not None else MetricsRegistry()
+        self.registry = (base_registry.scoped({"replica": replica_name})
+                         if replica_name is not None else base_registry)
         self.counters = self.registry.counter("serve_requests")
         self.hist_queue_wait = self.registry.histogram(
             "serve_latency_seconds", labels={"phase": "queue_wait"})
@@ -280,6 +289,20 @@ class InferenceServer:
         self._thread.start()
         return self
 
+    def request_stop(self) -> None:
+        """Non-blocking shutdown signal, safe from ANY thread — including
+        from inside a dispatch (the replica kill path), where a full
+        `stop()` would deadlock on the scheduler join.  Stops admitting,
+        fails every still-queued future with `ServerClosedError`, and
+        marks the scheduler so the in-flight retry loop fails its batch
+        terminally at the next check.  A later `stop()` completes the
+        shutdown (join, staging drain, endpoint teardown)."""
+        self._stop.set()
+        for req in self.queue.close():
+            self.counters.inc("rejected_server_closed")
+            self._trace_finish(req, "server_closed")
+            self._resolve(req.future, exc=ServerClosedError("server stopped"))
+
     def stop(self, timeout: float = 30.0) -> None:
         """Graceful, deterministic shutdown: stop admitting, fail EVERY
         still-queued future with `ServerClosedError` (including batches
@@ -288,11 +311,7 @@ class InferenceServer:
         in flight on the mesh completes normally (its wall-time is
         bounded by the watchdog), so `stop()` returns within roughly
         ``max(timeout, one batch)`` with no future left unresolved."""
-        self._stop.set()
-        for req in self.queue.close():
-            self.counters.inc("rejected_server_closed")
-            self._trace_finish(req, "server_closed")
-            self._resolve(req.future, exc=ServerClosedError("server stopped"))
+        self.request_stop()
         if self.staging is not None:
             # drain the stage queues deterministically: every staged batch
             # not yet through decode fails with ServerClosedError (the
@@ -972,6 +991,12 @@ class InferenceServer:
         resolution.  Thread-safe (staged batches complete on the decode
         worker while the scheduler thread completes monolithic ones)."""
         self.counters.inc("batches")
+        # tier pinning (ServeResult audit trail): resolve the tier index
+        # to its name once per batch — None when the controller is off
+        tier_name = (self.controller.tiers[tier].name
+                     if tier is not None and self.controller is not None
+                     else None)
+        ekey_short = ekey.short()
         if self.controller is not None:
             # calibrate the controller's forward model: one cost-
             # normalized batch-service observation per completed batch
@@ -1027,6 +1052,9 @@ class InferenceServer:
                 compile_hit=hit,
                 retries=retries,
                 degradations=degradations,
+                exec_key=ekey_short,
+                tier=tier_name,
+                replica=self.replica_name,
             ))
 
     # -- observability -----------------------------------------------------
@@ -1041,6 +1069,12 @@ class InferenceServer:
             "serve_slo_e2e_seconds", window=self._slo_window,
             labels={"slo_class": str(slo_class)},
             clock=self.clock, max_age_s=self._slo_max_age)
+
+    def pending(self) -> int:
+        """Queued + dispatched-but-unresolved request count — the cheap
+        load signal the fleet router reads per dispatch (unlike
+        `slo_snapshot`, no class windows are rendered)."""
+        return len(self.queue) + int(self._inflight_c.get("requests"))
 
     def slo_snapshot(self) -> Dict[str, Any]:
         """THE interface the closed-loop SLO controller (ROADMAP item 3)
@@ -1154,6 +1188,8 @@ class InferenceServer:
             "model_id": self.model_id,
             "scheduler": self.scheduler,
             "mesh_plan": self.mesh_plan,
+            # which fleet replica this server is (None on a bare server)
+            "replica": self.replica_name,
             "config": {
                 "max_queue_depth": self.config.max_queue_depth,
                 "max_batch_size": self.config.max_batch_size,

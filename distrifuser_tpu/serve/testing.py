@@ -104,18 +104,106 @@ class FakeExecutorFactory:
         self.built: List[ExecKey] = []
         self.executors: List[FakeExecutor] = []
 
+    def _new_executor(self, key: ExecKey) -> FakeExecutor:
+        """Construction hook: subclasses override THIS (not __call__) so
+        the build-delay simulation and built/executors bookkeeping live
+        in exactly one place."""
+        return FakeExecutor(key, batch_size=self.batch_size,
+                            step_time_s=self.step_time_s)
+
     def __call__(self, key: ExecKey) -> FakeExecutor:
         if self.build_delay_s:
             time.sleep(self.build_delay_s)
         self.built.append(key)
-        ex = FakeExecutor(key, batch_size=self.batch_size,
-                          step_time_s=self.step_time_s)
+        ex = self._new_executor(key)
         self.executors.append(ex)
         return ex
 
     def batch_sizes(self) -> List[int]:
         """Every invocation's real batch size, across all executors."""
         return [n for ex in self.executors for n in ex.batch_sizes]
+
+
+class ExecutionLedger:
+    """Fleet-wide completed-execution counter keyed by (prompt, seed).
+
+    The fleet failover invariant — a request is re-dispatched only after
+    its prior replica's outcome is terminal, so a dispatch that failed
+    before completing never runs twice — is asserted by sharing one
+    ledger across every replica's `LedgerFakeExecutorFactory`: each
+    successful executor return records its requests, and
+    ``max_count() <= 1`` proves no double execution (a dispatch
+    killed/failed before returning never records).  Caveat: a
+    watchdog-ABANDONED dispatch (``hang`` faults) may still finish in
+    the background and record — its result is discarded by the watchdog,
+    but the ledger honestly counts the physical execution, so assert
+    ``max_count() == 1`` only under fault kinds that fail before
+    completion (kill / errors / oom).  Thread-safe: replicas execute
+    concurrently."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+
+    def record(self, prompt: str, seed: int, replica: str = "") -> None:
+        with self._lock:
+            key = (prompt, int(seed))
+            entry = self._counts.setdefault(key, [])
+            entry.append(replica)
+
+    def count(self, prompt: str, seed: int) -> int:
+        with self._lock:
+            return len(self._counts.get((prompt, int(seed)), []))
+
+    def max_count(self) -> int:
+        with self._lock:
+            return max((len(v) for v in self._counts.values()), default=0)
+
+    def snapshot(self) -> dict:
+        """{(prompt, seed): [replica, ...]} of completed executions."""
+        with self._lock:
+            return {k: list(v) for k, v in self._counts.items()}
+
+
+class LedgerFakeExecutor(FakeExecutor):
+    """`FakeExecutor` recording every COMPLETED execution in a shared
+    `ExecutionLedger` (faults injected before/at the call never record —
+    exactly the semantics of work that died before producing output)."""
+
+    def __init__(self, key: ExecKey, ledger: ExecutionLedger,
+                 replica: str = "", batch_size: int = 8,
+                 step_time_s: float = 0.0):
+        super().__init__(key, batch_size=batch_size, step_time_s=step_time_s)
+        self.ledger = ledger
+        self.replica = replica
+
+    def __call__(self, prompts: List[str], negative_prompts: List[str],
+                 guidance_scale: float, seeds: List[int]) -> List[Any]:
+        out = super().__call__(prompts, negative_prompts, guidance_scale,
+                               seeds)
+        for p, s in zip(prompts, seeds):
+            self.ledger.record(p, s, self.replica)
+        return out
+
+
+class LedgerFakeExecutorFactory(FakeExecutorFactory):
+    """Per-replica factory building `LedgerFakeExecutor`s against one
+    shared ledger; ``replica`` tags which replica executed what."""
+
+    def __init__(self, ledger: ExecutionLedger, replica: str = "",
+                 batch_size: int = 8, build_delay_s: float = 0.0,
+                 step_time_s: float = 0.0):
+        super().__init__(batch_size=batch_size, build_delay_s=build_delay_s,
+                         step_time_s=step_time_s)
+        self.ledger = ledger
+        self.replica = replica
+
+    def _new_executor(self, key: ExecKey) -> LedgerFakeExecutor:
+        return LedgerFakeExecutor(key, self.ledger, replica=self.replica,
+                                  batch_size=self.batch_size,
+                                  step_time_s=self.step_time_s)
 
 
 class StageTracker:
@@ -258,16 +346,11 @@ class StagedFakeExecutorFactory(FakeExecutorFactory):
         self.fail_exc = fail_exc
         self.tracker = StageTracker()
 
-    def __call__(self, key: ExecKey) -> StagedFakeExecutor:
-        if self.build_delay_s:
-            time.sleep(self.build_delay_s)
-        self.built.append(key)
-        ex = StagedFakeExecutor(
+    def _new_executor(self, key: ExecKey) -> StagedFakeExecutor:
+        return StagedFakeExecutor(
             key, batch_size=self.batch_size, step_time_s=self.step_time_s,
             encode_s=self.encode_s, denoise_s=self.denoise_s,
             decode_s=self.decode_s, tracker=self.tracker,
             fail_stage=self.fail_stage, fail_times=self.fail_times,
             fail_exc=self.fail_exc,
         )
-        self.executors.append(ex)
-        return ex

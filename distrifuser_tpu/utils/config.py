@@ -796,6 +796,107 @@ class ControllerConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Multi-replica fleet policy (serve/fleet.py `FleetRouter`); lives
+    beside ServeConfig so one module owns every run-shaping knob.
+
+    Routing and health scoring:
+      * Each replica is scored in [0, 1] from its own serve signals
+        (`Replica.health_score`): open-circuit share, SLO-controller tier
+        depth, and rolling p99 vs ``p99_ref_s`` (None skips the latency
+        term).  The router dispatches to the serving replica maximizing
+        ``score * capacity_weight / (1 + queue_depth + inflight)`` —
+        weighted least-degraded, so mixed-capability replicas
+        (``Replica.capacity_weight``) are held to one SLO by steering
+        load toward spare healthy capacity.
+
+    Failover:
+      * A replica's TERMINAL dispatch failure (retries exhausted,
+        circuit open, watchdog, replica killed) re-dispatches the request
+        onto a different replica, at most ``max_failovers`` times per
+        request, each drawing from the fleet-wide `RetryBudget`
+        (``failover_budget`` + ``failover_budget_refill_per_s`` — the
+        same storm-bounding token bucket the in-server retry loop uses).
+        A request is only ever re-dispatched after its prior replica's
+        outcome is terminal, so its result is delivered exactly once and
+        a dispatch that failed before completing never runs twice (a
+        watchdog-ABANDONED dispatch may still finish in the background
+        with its result discarded — the single-server watchdog caveat,
+        unchanged).  When no replica can take the request right now it
+        is PARKED in the router and re-dispatched from the housekeeping
+        tick.
+
+    Fleet-level graceful degradation (the per-key `CircuitBreaker`
+    semantics lifted one level up):
+      * ``health_floor`` — a serving replica whose score reaches this
+        floor is auto-DRAINED (stops admitting, finishes in-flight);
+        so is one that accumulates ``drain_failure_threshold``
+        consecutive terminal failures.
+      * ``probe_cooldown_s`` later the drained replica is probed
+        half-open style: exactly one live request routes to it; success
+        returns it to serving, failure re-drains and re-arms the
+        cooldown.
+      * ``auto_restart`` (+ ``restart_cooldown_s``) — a replica whose
+        server STOPPED (e.g. the ``"replica"`` fault site's kill) is
+        rebuilt and re-warmed in the background instead of probed.
+
+    ``tick_s`` is the housekeeping cadence (auto-drain checks, probe
+    arming, parked re-dispatch); 0 disables the tick thread — tests
+    drive `FleetRouter.tick()` manually on an injected clock.
+    """
+
+    health_floor: float = 0.05
+    drain_failure_threshold: int = 3
+    probe_cooldown_s: float = 5.0
+    max_failovers: int = 3
+    failover_budget: int = 10_000
+    failover_budget_refill_per_s: float = 1.0
+    tick_s: float = 0.05
+    p99_ref_s: Optional[float] = None
+    auto_restart: bool = False
+    restart_cooldown_s: float = 10.0
+
+    def __post_init__(self) -> None:
+        if not (0.0 <= self.health_floor < 1.0):
+            raise ValueError(
+                f"health_floor must be in [0, 1), got {self.health_floor}"
+            )
+        if self.drain_failure_threshold < 1:
+            raise ValueError(
+                "drain_failure_threshold must be >= 1, got "
+                f"{self.drain_failure_threshold}"
+            )
+        if self.probe_cooldown_s < 0:
+            raise ValueError(
+                f"probe_cooldown_s must be >= 0, got {self.probe_cooldown_s}"
+            )
+        if self.max_failovers < 0:
+            raise ValueError(
+                f"max_failovers must be >= 0, got {self.max_failovers}"
+            )
+        if self.failover_budget < 0:
+            raise ValueError(
+                f"failover_budget must be >= 0, got {self.failover_budget}"
+            )
+        if self.failover_budget_refill_per_s < 0:
+            raise ValueError(
+                "failover_budget_refill_per_s must be >= 0, got "
+                f"{self.failover_budget_refill_per_s}"
+            )
+        if self.tick_s < 0:
+            raise ValueError(f"tick_s must be >= 0, got {self.tick_s}")
+        if self.p99_ref_s is not None and self.p99_ref_s <= 0:
+            raise ValueError(
+                f"p99_ref_s must be > 0 or None, got {self.p99_ref_s}"
+            )
+        if self.restart_cooldown_s < 0:
+            raise ValueError(
+                "restart_cooldown_s must be >= 0, got "
+                f"{self.restart_cooldown_s}"
+            )
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Configuration block for ``distrifuser_tpu.serve`` (the long-lived
     inference service).  Kept here, beside DistriConfig, so one module owns

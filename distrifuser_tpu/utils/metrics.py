@@ -787,6 +787,57 @@ class MetricsRegistry:
 
     # renders ---------------------------------------------------------------
 
+    def scoped(self, labels: Dict[str, str]) -> "ScopedRegistry":
+        """A label-scoping view over this registry: every metric created
+        or fetched through the view carries ``labels`` merged in.  The
+        fleet layer (serve/fleet.py) gives each replica's server a
+        ``{"replica": name}`` scope over ONE shared registry, so two
+        replicas' otherwise-identical gauges land as distinct label sets
+        instead of colliding."""
+        return ScopedRegistry(self, labels)
+
+    def unregister(self, name: str, labels: Optional[Dict] = None) -> bool:
+        """Remove ONE (name, labels) registration; True if it existed.
+        For callback-backed gauges being handed to a successor owner
+        (e.g. a rebuilt FleetRouter over the same shared registry) —
+        get-or-create would return the predecessor's stale closure, and
+        re-registering would conflict."""
+        lk = self._label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if not fam:
+                return False
+            keep = [(l, m) for l, m in fam if self._label_key(l) != lk]
+            if len(keep) == len(fam):
+                return False
+            if keep:
+                self._families[name] = keep
+            else:
+                del self._families[name]
+            return True
+
+    def prune(self, labels: Dict[str, str]) -> int:
+        """Unregister every metric whose labels carry ALL of ``labels``;
+        returns how many were removed.  A restarted fleet replica prunes
+        its previous server generation's scope here — without this, each
+        generation's gauges (whose closures pin the dead server) would
+        accumulate in the shared registry forever."""
+        want = {str(k): str(v) for k, v in labels.items()}
+        removed = 0
+        with self._lock:
+            for name in list(self._families):
+                fam = self._families[name]
+                keep = [
+                    (lbls, m) for lbls, m in fam
+                    if not all(lbls.get(k) == v for k, v in want.items())
+                ]
+                removed += len(fam) - len(keep)
+                if keep:
+                    self._families[name] = keep
+                else:
+                    del self._families[name]
+        return removed
+
     def _items(self):
         with self._lock:
             return [
@@ -880,6 +931,91 @@ class MetricsRegistry:
                             lines.append(f"{sub}{labelstr(lbls)} "
                                          f"{_prom_value(v)}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+class ScopedRegistry:
+    """A label-injecting proxy over one `MetricsRegistry`.
+
+    Every typed helper (`counter`/`histogram`/`gauge`/`rolling`/`gap`/
+    `ring`/`register`/`get`) merges the scope labels into the call's
+    labels before delegating, so code written against a plain registry
+    (the server, the staged pipeline, the controller) namespaces itself
+    per replica without knowing the fleet exists.  `family` filters to
+    entries whose labels carry the scope, so per-replica readers (e.g.
+    `InferenceServer.slo_snapshot`) never see a sibling replica's
+    windows.  `snapshot`/`to_prometheus` render the WHOLE base registry —
+    one scrape surface for the fleet, which is the point of sharing it.
+    """
+
+    def __init__(self, base: "MetricsRegistry", labels: Dict[str, str]):
+        # flatten nested scopes so .base is always the real registry
+        scope: Dict[str, str] = {}
+        while isinstance(base, ScopedRegistry):
+            merged = dict(base.scope)
+            merged.update(scope)
+            scope = merged
+            base = base.base
+        scope.update({str(k): str(v) for k, v in (labels or {}).items()})
+        self.base = base
+        self.scope = scope
+
+    def _merged(self, labels: Optional[Dict]) -> Dict[str, str]:
+        merged = dict(self.scope)
+        merged.update(labels or {})
+        return merged
+
+    def scoped(self, labels: Dict[str, str]) -> "ScopedRegistry":
+        return ScopedRegistry(self, labels)
+
+    def register(self, name: str, metric, labels: Optional[Dict] = None):
+        return self.base.register(name, metric, self._merged(labels))
+
+    def get(self, name: str, labels: Optional[Dict] = None):
+        return self.base.get(name, self._merged(labels))
+
+    def counter(self, name: str, labels: Optional[Dict] = None) -> Counter:
+        return self.base.counter(name, self._merged(labels))
+
+    def histogram(self, name: str, labels: Optional[Dict] = None,
+                  lo: float = 1e-4, hi: float = 1e3) -> LatencyHistogram:
+        return self.base.histogram(name, self._merged(labels), lo=lo, hi=hi)
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              labels: Optional[Dict] = None) -> Gauge:
+        return self.base.gauge(name, fn, self._merged(labels))
+
+    def rolling(self, name: str, window: int = 512,
+                labels: Optional[Dict] = None,
+                clock: Optional[Callable[[], float]] = None,
+                max_age_s: Optional[float] = None) -> RollingQuantile:
+        return self.base.rolling(name, window, self._merged(labels),
+                                 clock=clock, max_age_s=max_age_s)
+
+    def gap(self, name: str, labels: Optional[Dict] = None) -> GapTracker:
+        return self.base.gap(name, self._merged(labels))
+
+    def ring(self, name: str, capacity: int = 16,
+             labels: Optional[Dict] = None) -> RingLog:
+        return self.base.ring(name, capacity, self._merged(labels))
+
+    def family(self, name: str):
+        """Only the base-family entries carrying this scope's labels."""
+        return [
+            (lbls, m) for lbls, m in self.base.family(name)
+            if all(lbls.get(k) == v for k, v in self.scope.items())
+        ]
+
+    def unregister(self, name: str, labels: Optional[Dict] = None) -> bool:
+        return self.base.unregister(name, self._merged(labels))
+
+    def prune(self, labels: Optional[Dict] = None) -> int:
+        return self.base.prune(self._merged(labels))
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self.base.snapshot()
+
+    def to_prometheus(self) -> str:
+        return self.base.to_prometheus()
 
 
 class MetricsHTTPEndpoint:

@@ -1,0 +1,278 @@
+"""Fleet failover benchmark: availability through replica loss + recovery.
+
+Open-loop load over a 3-replica `FleetRouter` (serve/fleet.py) of
+deterministic weightless fakes, run twice:
+
+1. **baseline** — no faults: every replica healthy start to finish.
+2. **loss-and-recovery** — the ``"replica"`` fault site's ``kill`` rule
+   stops one named replica mid-load (deterministically: the rule arms
+   after ``--kill_after_batches`` site calls and fires once); the killed
+   replica's in-flight and queued work fails over onto the survivors,
+   and at ``--restart_at`` of the way through the load the bench calls
+   `restart_replica` — a fresh warmed server generation rejoins the
+   pool.
+
+Both runs share one `ExecutionLedger` per run: every COMPLETED executor
+invocation records its requests, so ``executed_twice == 0`` proves the
+failover invariant (a request is re-dispatched only after its prior
+replica's outcome is terminal — no request executes to completion
+twice).
+
+Gates (exit 1 on failure):
+  * ``--min_availability`` — completed / submitted in the
+    loss-and-recovery run (acceptance: 0.99).
+  * ``--p99_gate`` — fault-run e2e p99 <= gate x baseline p99
+    (acceptance: 2.0 — bounded p99 inflation through the window).
+  * no request executed twice (always on).
+
+Emits ONE ``"schema": 1`` JSON line (scripts/common.py); ``--out``
+writes the full artifact, ``--trace_out`` the fault run's Perfetto
+trace (failovers/drains/restarts land on the "fleet" track).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/fleet_bench.py \
+        [--requests 120] [--rate 40] [--min_availability 0.99] \
+        [--p99_gate 2.0] [--out FILE] [--trace_out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import emit_bench_line  # noqa: E402
+
+PROMPTS = ("a lighthouse at dawn", "a mossy forest floor", "a paper crane")
+
+
+def run_load(args, *, kill: bool, trace: bool = False) -> dict:
+    """One open-loop run over a fresh 3-replica fleet; returns the
+    measurement (and exports the trace when asked)."""
+    from distrifuser_tpu.serve import (
+        FaultPlan,
+        FaultRule,
+        FleetConfig,
+        FleetRouter,
+        Replica,
+        ResilienceConfig,
+        RetryableError,
+        ServeConfig,
+    )
+    from distrifuser_tpu.serve.testing import (
+        ExecutionLedger,
+        LedgerFakeExecutorFactory,
+    )
+    from distrifuser_tpu.utils.metrics import MetricsRegistry
+    from distrifuser_tpu.utils.trace import Tracer
+
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=args.batch_window_s,
+        buckets=((512, 512),),
+        warmup_buckets=((512, 512, args.steps),),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        resilience=ResilienceConfig(
+            max_retries=1, backoff_base_s=0.005, backoff_max_s=0.05,
+            seed=args.seed,
+        ),
+    )
+    plan = None
+    if kill:
+        plan = FaultPlan([
+            FaultRule(site="replica", kind="kill", key_substr=args.victim,
+                      p=1.0, max_fires=1,
+                      after_calls=args.kill_after_batches),
+        ], seed=args.seed)
+    registry = MetricsRegistry()
+    tracer = Tracer() if trace else None
+    ledger = ExecutionLedger()
+    replicas = [
+        Replica(
+            name,
+            LedgerFakeExecutorFactory(
+                ledger, replica=name, batch_size=args.max_batch_size,
+                step_time_s=args.fake_step_s,
+            ),
+            config,
+            capacity_weight=1.0,
+            model_id="fleet-bench",
+            fault_plan=plan,
+            registry=registry,
+        )
+        for name in ("r0", args.victim, "r2")
+    ]
+    fleet = FleetRouter(
+        replicas,
+        FleetConfig(tick_s=0.02, probe_cooldown_s=1.0),
+        tracer=tracer,
+        registry=registry,
+    )
+    n = args.requests
+    restart_at = int(args.restart_at * n)
+    interval = 1.0 / args.rate
+    futures = []
+    rejected = 0
+    restarted = False
+    t0 = time.monotonic()
+    with fleet:
+        for i in range(n):
+            try:
+                futures.append(fleet.submit(
+                    PROMPTS[i % len(PROMPTS)] + f" #{i}",
+                    height=512, width=512, seed=i, ttl_s=args.ttl_s,
+                ))
+            except RetryableError:
+                rejected += 1
+            if kill and not restarted and i >= restart_at:
+                # recovery: the killed replica rejoins as a fresh warmed
+                # generation (a no-op if the kill has not fired yet —
+                # restart_replica on a serving replica still rebuilds it)
+                fleet.restart_replica(args.victim)
+                restarted = True
+            time.sleep(interval)
+        lat = []
+        failed = 0
+        for f in futures:
+            try:
+                r = f.result(timeout=args.ttl_s + 30)
+                lat.append(r.e2e_s)
+            except Exception:  # noqa: BLE001 — counted, gated below
+                failed += 1
+        wall = time.monotonic() - t0
+        snap = fleet.metrics_snapshot()
+        health = fleet.health()
+        if trace and tracer is not None and args.trace_out:
+            tracer.export(args.trace_out)
+    lat.sort()
+    p99 = lat[max(0, int(0.99 * (len(lat) - 1)))] if lat else float("inf")
+    executed_twice = sum(
+        1 for execs in ledger.snapshot().values() if len(execs) > 1)
+    return {
+        "offered": n,
+        "rejected": rejected,
+        "completed": len(lat),
+        "failed": failed,
+        "availability": len(lat) / n if n else 0.0,
+        "p99_e2e_s": p99,
+        "wall_s": wall,
+        "executed_twice": executed_twice,
+        "faults_fired": plan.fired() if plan is not None else {},
+        "fleet_counters": snap["fleet"]["requests"],
+        "replica_states": {
+            name: {"state": entry["state"],
+                   "generation": entry["generation"]}
+            for name, entry in snap["fleet"]["replicas"].items()
+        },
+        "health_status": health["status"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=120,
+                    help="open-loop submissions per run")
+    ap.add_argument("--rate", type=float, default=40.0,
+                    help="open-loop arrival rate (rps)")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--fake_step_s", type=float, default=0.01,
+                    help="simulated per-step latency of the fakes")
+    ap.add_argument("--max_batch_size", type=int, default=4)
+    ap.add_argument("--batch_window_s", type=float, default=0.005)
+    ap.add_argument("--max_queue_depth", type=int, default=256)
+    ap.add_argument("--ttl_s", type=float, default=20.0)
+    ap.add_argument("--victim", type=str, default="r1",
+                    help="name of the replica the kill rule targets")
+    ap.add_argument("--kill_after_batches", type=int, default=8,
+                    help="'replica' site calls before the kill rule arms "
+                         "(deterministic mid-load trigger)")
+    ap.add_argument("--restart_at", type=float, default=0.6,
+                    help="fraction of the load after which the victim "
+                         "is restarted (the recovery edge)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min_availability", type=float, default=0.99,
+                    help="loss-and-recovery availability gate "
+                         "(0 disables)")
+    ap.add_argument("--p99_gate", type=float, default=2.0,
+                    help="fault-run p99 <= gate x baseline p99 "
+                         "(0 disables)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the full JSON artifact here")
+    ap.add_argument("--trace_out", type=str, default=None,
+                    help="write the fault run's Perfetto trace here")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    baseline = run_load(args, kill=False)
+    fault = run_load(args, kill=True, trace=bool(args.trace_out))
+
+    p99_ratio = (fault["p99_e2e_s"] / baseline["p99_e2e_s"]
+                 if baseline["p99_e2e_s"] > 0 else float("inf"))
+    artifact = {
+        "bench": {
+            "requests": args.requests,
+            "rate_rps": args.rate,
+            "steps": args.steps,
+            "fake_step_s": args.fake_step_s,
+            "victim": args.victim,
+            "kill_after_batches": args.kill_after_batches,
+            "restart_at": args.restart_at,
+            "min_availability": args.min_availability,
+            "p99_gate": args.p99_gate,
+            "seed": args.seed,
+        },
+        "baseline": baseline,
+        "loss_and_recovery": fault,
+        "p99_inflation": p99_ratio,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    emit_bench_line({
+        "metric": "fleet_availability_under_replica_loss",
+        "value": round(fault["availability"], 4),
+        "unit": "fraction",
+        "baseline_p99_s": round(baseline["p99_e2e_s"], 4),
+        "fault_p99_s": round(fault["p99_e2e_s"], 4),
+        "p99_inflation": round(p99_ratio, 3),
+        "failovers": fault["fleet_counters"].get("failovers", 0),
+        "restarts": fault["fleet_counters"].get("restarts", 0),
+        "executed_twice": fault["executed_twice"],
+        "faults_fired": fault["faults_fired"],
+        "victim_generation": fault["replica_states"][args.victim][
+            "generation"],
+    })
+    fail = []
+    if fault["executed_twice"] or baseline["executed_twice"]:
+        fail.append(
+            f"{fault['executed_twice']} request(s) executed twice — the "
+            "failover invariant is broken")
+    if fault["faults_fired"].get("replica/kill", 0) != 1:
+        fail.append(
+            f"kill fired {fault['faults_fired'].get('replica/kill', 0)} "
+            "times (want exactly 1) — the run did not test replica loss")
+    if (args.min_availability > 0
+            and fault["availability"] < args.min_availability):
+        fail.append(
+            f"availability {fault['availability']:.4f} < gate "
+            f"{args.min_availability}")
+    if args.p99_gate > 0 and p99_ratio > args.p99_gate:
+        fail.append(
+            f"p99 inflation {p99_ratio:.3f}x > gate {args.p99_gate}x")
+    if fail:
+        print("GATE FAILED: " + "; ".join(fail), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,814 @@
+"""Multi-replica fleet tests (serve/replica.py + serve/fleet.py) on the
+deterministic weightless fakes: lifecycle state machine, weighted
+routing math, failover without double execution, auto-drain + half-open
+re-probe, heterogeneous capacity weights, drain semantics, deterministic
+stop (including the stop-during-failover race), the ``"replica"`` fault
+site, metrics namespacing, and 1-replica parity with the bare server."""
+
+import threading
+import time
+import types
+
+import pytest
+
+from distrifuser_tpu.serve import (
+    DeadlineExceededError,
+    FaultPlan,
+    FaultRule,
+    FleetConfig,
+    FleetRouter,
+    InferenceServer,
+    NoHealthyReplicaError,
+    REPLICA_DRAINING,
+    REPLICA_SERVING,
+    REPLICA_STARTING,
+    REPLICA_STOPPED,
+    REPLICA_WARMING,
+    Replica,
+    ServeConfig,
+    ServerClosedError,
+    build_fleet,
+    routing_weight,
+)
+from distrifuser_tpu.serve.faults import InjectedReplicaKilled
+from distrifuser_tpu.serve.testing import (
+    ExecutionLedger,
+    FakeExecutorFactory,
+    LedgerFakeExecutorFactory,
+    fake_image,
+)
+from distrifuser_tpu.utils.config import ControllerConfig, ResilienceConfig
+from distrifuser_tpu.utils.metrics import MetricsRegistry
+
+
+class ManualClock:
+    """Injectable clock driven by tests (same pattern as test_resilience)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def serve_config(**kw):
+    kw.setdefault("max_queue_depth", 64)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_window_s", 0.0)
+    kw.setdefault("buckets", ((512, 512),))
+    kw.setdefault("default_steps", 4)
+    kw.setdefault("warmup_buckets", ((512, 512, 4),))
+    return ServeConfig(**kw)
+
+
+def mk_fleet(replicas, fleet_config=None, *, config=None, clock=None,
+             fault_plans=None, step_time_s=0.0, ledger=None):
+    """Hand-built fleet (per-replica fault plans, shared registry)."""
+    registry = MetricsRegistry()
+    ledger = ledger if ledger is not None else ExecutionLedger()
+    reps = []
+    for name, weight in replicas:
+        factory = LedgerFakeExecutorFactory(
+            ledger, replica=name, batch_size=4, step_time_s=step_time_s)
+        reps.append(Replica(
+            name, factory, config or serve_config(),
+            capacity_weight=weight,
+            clock=clock or time.monotonic,
+            fault_plan=(fault_plans or {}).get(name),
+            registry=registry,
+        ))
+    fleet = FleetRouter(reps, fleet_config or FleetConfig(tick_s=0),
+                        clock=clock or time.monotonic, registry=registry)
+    return fleet, ledger
+
+
+# --------------------------------------------------------------------------
+# routing math (pure)
+# --------------------------------------------------------------------------
+
+
+def test_routing_weight_math():
+    # healthy + idle: capacity weight dominates
+    assert routing_weight(1.0, 2.0, 0) == 2.0
+    # load discounts linearly in outstanding work
+    assert routing_weight(1.0, 2.0, 3) == pytest.approx(0.5)
+    # a degraded light replica loses to a loaded healthy heavy one
+    assert routing_weight(0.2, 1.0, 0) < routing_weight(1.0, 4.0, 3)
+    # score 0 (not serving) can never win
+    assert routing_weight(0.0, 100.0, 0) == 0.0
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="health_floor"):
+        FleetConfig(health_floor=1.5)
+    with pytest.raises(ValueError, match="drain_failure_threshold"):
+        FleetConfig(drain_failure_threshold=0)
+    with pytest.raises(ValueError, match="max_failovers"):
+        FleetConfig(max_failovers=-1)
+    with pytest.raises(ValueError, match="p99_ref_s"):
+        FleetConfig(p99_ref_s=0.0)
+    with pytest.raises(ValueError, match="tick_s"):
+        FleetConfig(tick_s=-1.0)
+
+
+def test_fault_rule_after_calls():
+    plan = FaultPlan([FaultRule(site="s", kind="execute_error", p=1.0,
+                                after_calls=2)], seed=0)
+    plan.check("s")  # call 0: window closed
+    plan.check("s")  # call 1: window closed
+    with pytest.raises(Exception):
+        plan.check("s")  # call 2: fires
+    with pytest.raises(ValueError, match="after_calls"):
+        FaultRule(site="s", kind="oom", p=1.0, after_calls=-1)
+
+
+def test_kill_kind_raises_injected_replica_killed():
+    plan = FaultPlan([FaultRule(site="replica", kind="kill", p=1.0)], seed=0)
+    with pytest.raises(InjectedReplicaKilled):
+        plan.check("replica")
+
+
+# --------------------------------------------------------------------------
+# replica lifecycle state machine
+# --------------------------------------------------------------------------
+
+
+def test_replica_lifecycle_walk():
+    rep = Replica("r", FakeExecutorFactory(batch_size=4), serve_config())
+    assert rep.state == REPLICA_STARTING
+    rep.start()
+    assert rep.state == REPLICA_SERVING
+    # starting walked through warming (warmup compiles before traffic)
+    assert [t for _, _, t in rep.history] == [REPLICA_WARMING,
+                                              REPLICA_SERVING]
+    assert rep.server.cache.stats()["misses"] == 1  # the warmup build
+    rep.drain()
+    assert rep.state == REPLICA_DRAINING
+    with pytest.raises(ServerClosedError):
+        rep.submit("p", height=512, width=512)  # draining: not admitting
+    rep.resume()
+    assert rep.state == REPLICA_SERVING
+    rep.stop()
+    assert rep.state == REPLICA_STOPPED
+    rep.stop()  # idempotent
+    assert rep.state == REPLICA_STOPPED
+    # restart: a fresh server generation over the same handle
+    rep.start()
+    assert rep.state == REPLICA_SERVING and rep.generation == 2
+    r = rep.submit("p", height=512, width=512, seed=3).result(timeout=30)
+    assert r.replica == "r"
+    rep.stop()
+
+
+def test_replica_illegal_transitions_raise():
+    rep = Replica("r", FakeExecutorFactory(batch_size=4), serve_config())
+    rep.start()
+    with pytest.raises(RuntimeError, match="cannot start"):
+        rep.start()  # serving -> warming is not a legal start
+    rep.stop()
+
+
+def test_replica_probe_submit_path():
+    rep = Replica("r", FakeExecutorFactory(batch_size=4),
+                  serve_config()).start()
+    rep.drain()
+    # the half-open probe path: a DRAINING replica takes exactly the
+    # probe-flagged submit
+    r = rep.submit("probe", height=512, width=512, probe=True).result(
+        timeout=30)
+    assert r.output is not None
+    rep.stop()
+
+
+def test_replica_drain_completes_inflight_work():
+    rep = Replica("r", FakeExecutorFactory(batch_size=4, step_time_s=0.05),
+                  serve_config()).start()
+    futs = [rep.submit(f"p{i}", height=512, width=512, seed=i)
+            for i in range(3)]
+    rep.drain()  # stop admitting; queued + in-flight work must FINISH
+    results = [f.result(timeout=30) for f in futs]
+    assert all(r.output is not None for r in results)
+    deadline = time.monotonic() + 10
+    while not rep.drained and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rep.drained
+    rep.stop()
+
+
+def test_replica_capacity_weight_validation():
+    with pytest.raises(ValueError, match="capacity_weight"):
+        Replica("r", FakeExecutorFactory(), capacity_weight=0.0)
+    with pytest.raises(ValueError, match="name"):
+        Replica("", FakeExecutorFactory())
+
+
+# --------------------------------------------------------------------------
+# result pinning (tier / exec key / replica)
+# --------------------------------------------------------------------------
+
+
+def test_serve_result_pins_exec_key_tier_and_replica():
+    factory = FakeExecutorFactory(batch_size=4)
+    config = serve_config(
+        controller=ControllerConfig(enabled=True,
+                                    slo_p99_s={"default": 30.0}))
+    with InferenceServer(factory, config) as server:
+        r = server.submit("p", height=512, width=512).result(timeout=30)
+    # bare server: tier pinned to the controller's choice, replica None
+    assert r.tier == "full"
+    assert r.exec_key == factory.built[0].short()
+    assert r.replica is None
+
+
+def test_fleet_result_pins_replica_name():
+    fleet, _ = mk_fleet((("alpha", 1.0),))
+    with fleet:
+        r = fleet.submit("p", height=512, width=512).result(timeout=30)
+    assert r.replica == "alpha"
+    assert r.exec_key  # the audit trail always names the executed key
+
+
+# --------------------------------------------------------------------------
+# metrics namespacing (shared registry, per-replica labels)
+# --------------------------------------------------------------------------
+
+
+def test_shared_registry_replica_labels_do_not_collide():
+    registry = MetricsRegistry()
+    factory_a = FakeExecutorFactory(batch_size=4)
+    factory_b = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(factory_a, serve_config(), registry=registry,
+                         replica_name="a") as sa, \
+            InferenceServer(factory_b, serve_config(), registry=registry,
+                            replica_name="b") as sb:
+        sa.submit("p", height=512, width=512).result(timeout=30)
+        sb.submit("p", height=512, width=512).result(timeout=30)
+        # each server's SLO view sees only its OWN class windows
+        assert set(sa.slo_snapshot()["classes"]) == {"default"}
+        assert sa.registry.family("serve_slo_e2e_seconds")[0][0][
+            "replica"] == "a"
+    fam = registry.family("serve_requests")
+    labels = sorted(lbls.get("replica") for lbls, _ in fam)
+    assert labels == ["a", "b"]  # two distinct counters, one registry
+    # the same metric name without the replica label would have collided
+    with pytest.raises(ValueError, match="already registered"):
+        registry.gauge("serve_queue_depth", lambda: 0.0,
+                       labels={"replica": "a"})
+
+
+def test_shared_registry_without_replica_name_collides_loudly():
+    registry = MetricsRegistry()
+    InferenceServer(FakeExecutorFactory(), serve_config(), registry=registry)
+    with pytest.raises(ValueError):
+        InferenceServer(FakeExecutorFactory(), serve_config(),
+                        registry=registry)
+
+
+def test_scoped_registry_nesting_and_family_filter():
+    base = MetricsRegistry()
+    scoped = base.scoped({"replica": "r1"}).scoped({"generation": "2"})
+    c = scoped.counter("x")
+    c.inc("k")
+    assert base.get("x", {"replica": "r1", "generation": "2"}) is c
+    base.counter("x", labels={"replica": "r2"}).inc("k")
+    assert len(base.family("x")) == 2
+    assert len(scoped.family("x")) == 1  # filtered to the scope's labels
+
+
+# --------------------------------------------------------------------------
+# fleet routing + failover
+# --------------------------------------------------------------------------
+
+
+def test_one_replica_fleet_parity_with_bare_server():
+    """The degenerate 1-replica fleet is behaviorally the bare
+    `InferenceServer`: identical outputs for identical (prompt, seed),
+    same completion counters, same typed post-stop rejection."""
+    prompts = [(f"p{i}", i) for i in range(6)]
+    bare_factory = FakeExecutorFactory(batch_size=4)
+    with InferenceServer(bare_factory, serve_config()) as server:
+        bare = [server.submit(p, height=512, width=512, seed=s).result(
+            timeout=30) for p, s in prompts]
+    fleet, _ = mk_fleet((("r0", 1.0),))
+    with fleet:
+        fr = [fleet.submit(p, height=512, width=512, seed=s).result(
+            timeout=30) for p, s in prompts]
+    for b, f in zip(bare, fr):
+        assert (b.output == f.output).all()  # bit-identical generations
+        assert b.bucket == f.bucket and b.batch_size >= 1
+    snap = fleet.metrics_snapshot()
+    assert snap["fleet"]["requests"]["completed"] == len(prompts)
+    assert snap["replicas"]["r0"]["requests"]["completed"] == len(prompts)
+    with pytest.raises(ServerClosedError):
+        fleet.submit("late", height=512, width=512)
+    fleet.stop()  # idempotent
+
+
+def test_failover_executes_exactly_once():
+    """A terminal dispatch failure on one replica re-dispatches onto a
+    different replica — and the request executes TO COMPLETION exactly
+    once, asserted by the shared execution ledger."""
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0, max_fires=1)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(max_retries=0))
+    fleet, ledger = mk_fleet(
+        (("heavy", 10.0), ("light", 1.0)),  # first dispatch goes to heavy
+        config=cfg, fault_plans={"heavy": plan})
+    with fleet:
+        r = fleet.submit("only", height=512, width=512,
+                         seed=7).result(timeout=30)
+    assert r.replica == "light"  # failed over off the faulted replica
+    assert ledger.count("only", 7) == 1  # never executed twice
+    assert ledger.snapshot()[("only", 7)] == ["light"]
+    snap = fleet.metrics_snapshot()["fleet"]
+    assert snap["requests"]["failovers"] == 1
+    assert snap["requests"]["replica_failures"] == 1
+
+
+def test_failover_budget_exhaustion_surfaces_the_error():
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(max_retries=0))
+    fleet, _ = mk_fleet(
+        (("r0", 1.0),), config=cfg, fault_plans={"r0": plan},
+        fleet_config=FleetConfig(tick_s=0, failover_budget=0,
+                                 failover_budget_refill_per_s=0.0,
+                                 drain_failure_threshold=100))
+    with fleet:
+        fut = fleet.submit("p", height=512, width=512)
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+    snap = fleet.metrics_snapshot()["fleet"]["requests"]
+    assert snap.get("failover_budget_exhausted", 0) == 1
+
+
+def test_heterogeneous_weights_balance_one_slo():
+    """Mixed-capability replicas under one fleet: the weighted router
+    steers most load to the heavy replica but spills to the light one as
+    queues build, and EVERY request completes within its deadline."""
+    fleet, ledger = mk_fleet((("heavy", 4.0), ("light", 1.0)),
+                             step_time_s=0.01)
+    with fleet:
+        futs = [fleet.submit(f"p{i}", height=512, width=512, seed=i,
+                             ttl_s=30.0) for i in range(20)]
+        results = [f.result(timeout=60) for f in futs]
+    assert all(r.output is not None for r in results)  # one SLO held
+    by_replica = {}
+    for executions in ledger.snapshot().values():
+        assert len(executions) == 1
+        by_replica[executions[0]] = by_replica.get(executions[0], 0) + 1
+    # both capacities used, the heavier one more
+    assert by_replica.get("heavy", 0) > by_replica.get("light", 0) > 0
+
+
+def test_auto_drain_and_half_open_reprobe():
+    """Fleet-level breaker semantics: a replica failing consecutively is
+    auto-drained; after the cooldown exactly one probe routes to it —
+    failure re-drains and re-arms, success resumes it."""
+    clock = ManualClock()
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0, max_fires=3)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(
+        max_retries=0, breaker_failure_threshold=100))
+    fleet, ledger = mk_fleet(
+        (("flaky", 10.0), ("steady", 1.0)), config=cfg, clock=clock,
+        fault_plans={"flaky": plan},
+        fleet_config=FleetConfig(tick_s=0, probe_cooldown_s=10.0,
+                                 drain_failure_threshold=2,
+                                 max_failovers=4))
+    with fleet:
+        # two terminal failures on "flaky" trip the fleet-level drain;
+        # both requests fail over to "steady"
+        for i in range(2):
+            r = fleet.submit(f"p{i}", height=512, width=512,
+                             seed=i).result(timeout=30)
+            assert r.replica == "steady"
+        assert fleet.replica("flaky").state == REPLICA_DRAINING
+        snap = fleet.metrics_snapshot()["fleet"]
+        assert snap["requests"]["auto_drains"] == 1
+        assert snap["replicas"]["flaky"]["faulted"]
+        # cooldown not elapsed: no probe, traffic stays on "steady"
+        r = fleet.submit("p2", height=512, width=512, seed=2).result(
+            timeout=30)
+        assert r.replica == "steady"
+        assert fleet.metrics_snapshot()["fleet"]["requests"].get(
+            "probes", 0) == 0
+        # cooldown elapsed: the next submit is the half-open probe — it
+        # fails (one injected fire left), re-drains, and the request
+        # still completes elsewhere
+        clock.advance(11.0)
+        r = fleet.submit("p3", height=512, width=512, seed=3).result(
+            timeout=30)
+        assert r.replica == "steady"
+        snap = fleet.metrics_snapshot()["fleet"]["requests"]
+        assert snap["probes"] == 1 and snap["probe_failures"] == 1
+        # faults exhausted now: the next probe succeeds and the replica
+        # returns to serving
+        clock.advance(11.0)
+        r = fleet.submit("p4", height=512, width=512, seed=4).result(
+            timeout=30)
+        assert r.replica == "flaky"
+        assert fleet.replica("flaky").state == REPLICA_SERVING
+        snap = fleet.metrics_snapshot()["fleet"]["requests"]
+        assert snap["probe_successes"] == 1
+        # healed: normal traffic routes to it again (heaviest weight)
+        r = fleet.submit("p5", height=512, width=512, seed=5).result(
+            timeout=30)
+        assert r.replica == "flaky"
+    assert ledger.max_count() == 1  # across all the failovers and probes
+
+
+def test_parked_request_redispatches_after_recovery():
+    """With no routable replica a failed-over request PARKS in the
+    router and re-dispatches from the tick once capacity returns."""
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0, max_fires=1)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(max_retries=0))
+    fleet, ledger = mk_fleet(
+        (("r0", 1.0), ("r1", 1.0)), config=cfg, fault_plans={"r0": plan},
+        fleet_config=FleetConfig(tick_s=0, drain_failure_threshold=1,
+                                 probe_cooldown_s=1000.0))
+    with fleet:
+        fleet.drain_replica("r1")  # manual drain: r0 is the only target
+        fut = fleet.submit("p", height=512, width=512, seed=1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fleet.metrics_snapshot()["fleet"]["parked"] == 1:
+                break
+            time.sleep(0.01)
+        assert fleet.metrics_snapshot()["fleet"]["parked"] == 1
+        assert not fut.done()
+        fleet.resume_replica("r1")
+        fleet.tick()  # housekeeping re-dispatches the parked request
+        r = fut.result(timeout=30)
+        assert r.replica == "r1"
+    assert ledger.count("p", 1) == 1
+
+
+def test_no_healthy_replica_is_typed_rejection():
+    fleet, _ = mk_fleet((("r0", 1.0),))
+    with fleet:
+        fleet.drain_replica("r0")
+        with pytest.raises(NoHealthyReplicaError):
+            fleet.submit("p", height=512, width=512)
+
+
+def test_fleet_stop_resolves_everything_deterministically():
+    """stop() is idempotent and resolves every future: in-flight work
+    completes, queued work gets ServerClosedError — across replicas."""
+    fleet, _ = mk_fleet((("r0", 1.0), ("r1", 1.0)), step_time_s=0.05)
+    fleet.start()
+    futs = [fleet.submit(f"p{i}", height=512, width=512, seed=i)
+            for i in range(8)]
+    fleet.stop(timeout=10.0)
+    fleet.stop(timeout=1.0)  # idempotent
+    resolved = 0
+    for f in futs:
+        assert f.done()
+        try:
+            assert f.result(timeout=0).output is not None
+            resolved += 1
+        except ServerClosedError:
+            pass
+    assert resolved >= 1  # the in-flight batches were never abandoned
+    with pytest.raises(ServerClosedError):
+        fleet.submit("late", height=512, width=512)
+
+
+def test_stop_during_failover_race():
+    """A request mid-failover when stop() lands must still resolve —
+    the parked/re-dispatch path checks the stopping flag under the fleet
+    lock, so nothing leaks unresolved (the stop-hardening satellite)."""
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(max_retries=0))
+    fleet, _ = mk_fleet(
+        (("r0", 10.0), ("r1", 1.0)), config=cfg, fault_plans={"r0": plan},
+        fleet_config=FleetConfig(tick_s=0, drain_failure_threshold=100))
+    entered = threading.Event()
+    release = threading.Event()
+    orig = FleetRouter._failover
+
+    def gated_failover(self, fr, exc):
+        entered.set()
+        release.wait(10.0)
+        orig(self, fr, exc)
+
+    fleet._failover = types.MethodType(gated_failover, fleet)
+    fleet.start()
+    fleet.drain_replica("r1")  # failover will find nowhere to go -> park
+    fut = fleet.submit("p", height=512, width=512)
+    assert entered.wait(10.0)  # r0 failed; the failover is now gated
+    stopper = threading.Thread(target=fleet.stop, kwargs={"timeout": 10.0})
+    stopper.start()
+    time.sleep(0.1)  # let stop() set the stopping flag
+    release.set()
+    stopper.join(timeout=20.0)
+    assert not stopper.is_alive()
+    assert fut.done()
+    with pytest.raises(ServerClosedError):
+        fut.result(timeout=0)
+
+
+def test_parked_request_expires_at_deadline():
+    clock = ManualClock()
+    plan = FaultPlan([FaultRule(site="execute", kind="execute_error",
+                                p=1.0, max_fires=1)], seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(max_retries=0))
+    fleet, _ = mk_fleet(
+        (("r0", 1.0),), config=cfg, clock=clock, fault_plans={"r0": plan},
+        fleet_config=FleetConfig(tick_s=0, drain_failure_threshold=1,
+                                 probe_cooldown_s=1000.0))
+    with fleet:
+        fut = fleet.submit("p", height=512, width=512, ttl_s=5.0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fleet.metrics_snapshot()["fleet"]["parked"] == 1:
+                break
+            time.sleep(0.01)
+        clock.advance(6.0)  # past the request deadline
+        fleet.tick()
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=5)
+
+
+# --------------------------------------------------------------------------
+# the "replica" fault site: kill + recovery
+# --------------------------------------------------------------------------
+
+
+def test_replica_kill_fails_over_and_restart_recovers():
+    """The ``kill`` fault stops a replica mid-load: its in-flight and
+    queued work fails over (no double execution), the fleet adopts the
+    body via auto-drain, and `restart_replica` returns a fresh warmed
+    generation to the pool."""
+    ledger = ExecutionLedger()
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="victim", p=1.0, max_fires=1,
+                                after_calls=2)], seed=0)
+    cfg = serve_config(max_batch_size=2,
+                       resilience=ResilienceConfig(max_retries=0))
+    registry = MetricsRegistry()
+    reps = [
+        Replica(name, LedgerFakeExecutorFactory(
+            ledger, replica=name, batch_size=2, step_time_s=0.005),
+            cfg, capacity_weight=w, fault_plan=plan, registry=registry)
+        for name, w in (("victim", 1.0), ("survivor", 1.0))
+    ]
+    fleet = FleetRouter(reps, FleetConfig(tick_s=0.02), registry=registry)
+    with fleet:
+        futs = []
+        for i in range(16):
+            futs.append(fleet.submit(f"p{i}", height=512, width=512, seed=i))
+            time.sleep(0.01)
+        results = [f.result(timeout=30) for f in futs]
+        assert plan.fired() == {"replica/kill": 1}
+        assert fleet.replica("victim").killed
+        assert fleet.replica("victim").state == REPLICA_STOPPED
+        # recovery: a fresh generation, warmed, back in the pool
+        fleet.restart_replica("victim")
+        assert fleet.replica("victim").state == REPLICA_SERVING
+        assert fleet.replica("victim").generation == 2
+        assert not fleet.replica("victim").killed
+        r = fleet.submit("after", height=512, width=512,
+                         seed=99).result(timeout=30)
+        assert r.output is not None
+    assert all(r.output is not None for r in results)  # 100% availability
+    assert ledger.max_count() == 1  # kill + failover never double-executed
+
+
+def test_redispatch_passes_remaining_ttl_not_a_fresh_one():
+    """The client's TTL is one budget across every dispatch: a failover
+    (or any re-dispatch) submits with the REMAINING time, and a request
+    whose deadline already lapsed is failed, not re-dispatched."""
+    from concurrent.futures import Future
+
+    from distrifuser_tpu.serve.fleet import _FleetRequest
+
+    clock = ManualClock()
+    fleet, _ = mk_fleet((("r0", 1.0),), clock=clock)
+    with fleet:
+        captured = {}
+        server = fleet.replica("r0").server
+        orig_submit = server.submit
+
+        def spy(prompt, **kw):
+            captured.update(kw)
+            return orig_submit(prompt, **kw)
+
+        server.submit = spy
+        params = dict(prompt="x", height=512, width=512,
+                      negative_prompt="", num_inference_steps=None,
+                      guidance_scale=5.0, seed=0, ttl_s=9.0,
+                      slo_class="default")
+        # 5 of the 9 TTL seconds already burned on a failed replica:
+        # the re-dispatch must carry the remaining 4, not a fresh 9
+        fr = _FleetRequest(params=params, future=Future(),
+                           deadline=clock() + 4.0)
+        ok, _ = fleet._try_dispatch(fr)
+        assert ok and captured["ttl_s"] == pytest.approx(4.0)
+        fr.future.result(timeout=30)
+        # fully lapsed: disposed of with the typed deadline error,
+        # never dispatched again
+        fr2 = _FleetRequest(params=dict(params), future=Future(),
+                            deadline=clock() - 1.0)
+        ok, exc = fleet._try_dispatch(fr2)
+        assert ok and exc is None
+        with pytest.raises(DeadlineExceededError):
+            fr2.future.result(timeout=5)
+
+
+def test_restart_prunes_dead_generation_metrics():
+    """A restarted replica's previous server generation leaves the
+    shared registry (its gauge closures pinned the dead server); only
+    the live generation renders."""
+    registry = MetricsRegistry()
+    rep = Replica("r", FakeExecutorFactory(batch_size=4), serve_config(),
+                  registry=registry)
+    rep.start()
+    rep.submit("p", height=512, width=512).result(timeout=30)
+    gen1 = {"replica": "r", "generation": "1"}
+    assert registry.get("serve_requests", gen1) is not None
+    rep.stop()
+    rep.start()
+    assert registry.get("serve_requests", gen1) is None  # pruned
+    assert registry.get(
+        "serve_requests", {"replica": "r", "generation": "2"}) is not None
+    rep.stop()
+
+
+def test_stop_stays_responsive_during_warmup():
+    """start() must not hold the lifecycle lock across the (potentially
+    minutes-long) warmup build: a concurrent stop() returns promptly,
+    wins the race, and the freshly built server never serves."""
+    rep = Replica("r", FakeExecutorFactory(batch_size=4, build_delay_s=0.5),
+                  serve_config())
+    t = threading.Thread(target=rep.start)
+    t.start()
+    deadline = time.monotonic() + 5
+    while rep.state != REPLICA_WARMING and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rep.state == REPLICA_WARMING
+    t0 = time.monotonic()
+    rep.stop(timeout=5.0)
+    assert time.monotonic() - t0 < 0.4  # did not wait out the 0.5s build
+    t.join(timeout=10)
+    assert rep.state == REPLICA_STOPPED
+    assert rep.server is None  # the discarded server was never published
+    with pytest.raises(ServerClosedError):
+        rep.submit("p", height=512, width=512)
+
+
+def test_request_fatal_errors_do_not_drain_healthy_replicas():
+    """A client spamming doomed requests (no covering bucket) must not
+    auto-drain a healthy fleet: request-fatal outcomes skip the
+    consecutive-failure bookkeeping."""
+    fleet, _ = mk_fleet(
+        (("r0", 1.0), ("r1", 1.0)),
+        fleet_config=FleetConfig(tick_s=0, drain_failure_threshold=2))
+    with fleet:
+        for i in range(6):  # 3x the drain threshold, all NoBucketError
+            fut = fleet.submit(f"poison{i}", height=8192, width=8192)
+            with pytest.raises(Exception):
+                fut.result(timeout=30)
+        snap = fleet.metrics_snapshot()["fleet"]
+        assert snap["requests"].get("auto_drains", 0) == 0
+        assert snap["requests"]["failed_fatal"] == 6
+        for entry in snap["replicas"].values():
+            assert entry["state"] == REPLICA_SERVING
+            assert not entry["faulted"]
+        # the fleet still serves real work
+        r = fleet.submit("ok", height=512, width=512).result(timeout=30)
+        assert r.output is not None
+
+
+def test_fleet_start_is_parallel():
+    """N replicas warm concurrently: fleet startup costs ~one warmup
+    build, not N (the warmups are independent compiles)."""
+    registry = MetricsRegistry()
+    reps = [Replica(f"r{i}", FakeExecutorFactory(batch_size=4,
+                                                 build_delay_s=0.3),
+                    serve_config(), registry=registry) for i in range(3)]
+    fleet = FleetRouter(reps, FleetConfig(tick_s=0), registry=registry)
+    t0 = time.monotonic()
+    fleet.start()
+    elapsed = time.monotonic() - t0
+    fleet.stop()
+    assert elapsed < 0.75, elapsed  # serial would be >= 0.9
+
+
+def test_fleet_start_failure_stops_started_replicas():
+    """One replica failing to start must not leak the others' scheduler
+    threads: the fleet stops what it started and raises."""
+
+    class ExplodingReplica(Replica):
+        def start(self):
+            raise RuntimeError("injected start failure")
+
+    registry = MetricsRegistry()
+    good = Replica("good", FakeExecutorFactory(batch_size=4),
+                   serve_config(), registry=registry)
+    bad = ExplodingReplica("bad", FakeExecutorFactory(batch_size=4),
+                           serve_config(), registry=registry)
+    fleet = FleetRouter([good, bad], FleetConfig(tick_s=0),
+                        registry=registry)
+    with pytest.raises(RuntimeError, match="failed to start"):
+        fleet.start()
+    assert good.state == REPLICA_STOPPED  # cleaned up, not leaked
+
+
+def test_kill_is_terminal_even_with_retries_enabled():
+    """The kill signals the server's shutdown SYNCHRONOUSLY before the
+    fault propagates, so the in-server retry loop can never re-dispatch
+    onto the "dead" replica and mask the kill — the batch fails
+    terminally and the fleet fails over, deterministically."""
+    ledger = ExecutionLedger()
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="victim", p=1.0, max_fires=1)],
+                     seed=0)
+    cfg = serve_config(resilience=ResilienceConfig(
+        max_retries=5, backoff_base_s=0.001, backoff_max_s=0.01))
+    registry = MetricsRegistry()
+    reps = [
+        Replica(name, LedgerFakeExecutorFactory(
+            ledger, replica=name, batch_size=4), cfg,
+            capacity_weight=w, fault_plan=plan, registry=registry)
+        for name, w in (("victim", 10.0), ("survivor", 1.0))
+    ]
+    fleet = FleetRouter(reps, FleetConfig(tick_s=0), registry=registry)
+    with fleet:
+        r = fleet.submit("only", height=512, width=512,
+                         seed=1).result(timeout=30)
+    assert r.replica == "survivor"
+    assert ledger.snapshot()[("only", 1)] == ["survivor"]
+    assert plan.fired() == {"replica/kill": 1}  # retries never re-fired it
+    assert fleet.replica("victim").killed
+
+
+def test_rebuilt_fleet_over_same_replicas_and_registry():
+    """stop()'s error message says 'build a new FleetRouter' — that
+    recovery path must actually work over the same replicas and shared
+    registry (the new router replaces its predecessor's fleet gauges
+    instead of colliding)."""
+    registry = MetricsRegistry()
+    reps = [Replica(f"r{i}", FakeExecutorFactory(batch_size=4),
+                    serve_config(), registry=registry) for i in range(2)]
+    fleet1 = FleetRouter(reps, FleetConfig(tick_s=0), registry=registry)
+    with fleet1:
+        fleet1.submit("p", height=512, width=512).result(timeout=30)
+    with pytest.raises(ServerClosedError, match="build a new"):
+        fleet1.start()
+    fleet2 = FleetRouter(reps, FleetConfig(tick_s=0), registry=registry)
+    with fleet2:
+        r = fleet2.submit("q", height=512, width=512).result(timeout=30)
+        assert r.output is not None
+        # double start is a typed caller error, never a teardown
+        with pytest.raises(RuntimeError, match="already started"):
+            fleet2.start()
+        assert all(s.replica.state == REPLICA_SERVING
+                   for s in fleet2._slots.values())
+
+
+def test_auto_restart_cannot_resurrect_after_stop():
+    """A pending auto-restart must not bring a replica back to life
+    after the fleet stopped — the restart path checks the stopping
+    latch (the leaked-scheduler-thread hazard)."""
+    fleet, _ = mk_fleet(
+        (("r0", 1.0),),
+        fleet_config=FleetConfig(tick_s=0, auto_restart=True,
+                                 restart_cooldown_s=0.0))
+    fleet.start()
+    slot = fleet._slots["r0"]
+    fleet.stop()
+    fleet._restart_async(slot)  # what a racing tick would have spawned
+    deadline = time.monotonic() + 5
+    while slot.restarting and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fleet.replica("r0").state == REPLICA_STOPPED
+    assert not slot.restarting
+    # the operator paths share the same latch
+    with pytest.raises(ServerClosedError):
+        fleet.restart_replica("r0")
+    with pytest.raises(ServerClosedError):
+        fleet.drain_replica("r0")
+    assert fleet.replica("r0").state == REPLICA_STOPPED
+
+
+def test_fleet_health_snapshot_shape():
+    fleet, _ = mk_fleet((("a", 1.0), ("b", 2.0)))
+    with fleet:
+        fleet.submit("p", height=512, width=512).result(timeout=30)
+        h = fleet.health()
+        assert h["status"] == "ok"
+        assert h["serving_replicas"] == 2 and h["total_replicas"] == 2
+        assert set(h["replicas"]) == {"a", "b"}
+        for entry in h["replicas"].values():
+            assert entry["state"] == REPLICA_SERVING
+            assert 0.0 <= entry["score"] <= 1.0
+        import json
+
+        json.dumps(fleet.metrics_snapshot())  # JSON end to end
+        json.dumps(h)
