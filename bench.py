@@ -142,6 +142,17 @@ def main():
     parser.add_argument("--fused_min_budget_s", type=float, default=420.0)
     # v5e bf16 MXU peak (TFLOP/s) for the MFU line; override per chip class
     parser.add_argument("--peak_tflops", type=float, default=197.0)
+    # Quantized mode: weight_quant holds the kernels low-precision,
+    # quant_compute routes their matmuls (DistriConfig semantics).  The
+    # MFU line then carries a "mode" tag ("int8-auto", ...) so quantized
+    # and bf16 runs land side by side in the bench trajectory — ROADMAP
+    # item 5 gates on MFU/latency, not byte ratios.  MFU stays computed
+    # against the bf16-equivalent FLOP count and bf16 peak, so a value
+    # above the bf16 run's is exactly the compute-path win.
+    parser.add_argument("--weight_quant", type=str, default="none",
+                        choices=["none", "int8", "fp8"])
+    parser.add_argument("--quant_compute", type=str, default="auto",
+                        choices=["off", "auto", "dot", "pallas"])
     parser.add_argument(_RETRY_FLAG, action="store_true", help=argparse.SUPPRESS)
     parser.add_argument(_START_TS_FLAG, type=float, default=None,
                         help=argparse.SUPPRESS)
@@ -223,6 +234,20 @@ def main():
     print(f"bench provenance: model dtype={jnp.dtype(dtype).name}",
           file=sys.stderr, flush=True)
     params = unet_mod.init_unet_params(jax.random.PRNGKey(0), ucfg, dtype)
+    if args.weight_quant != "none":
+        from distrifuser_tpu.models.weights import quantize_params
+
+        params = quantize_params(params, args.weight_quant,
+                                 compute=args.quant_compute)
+        print(f"bench provenance: weight_quant={args.weight_quant} "
+              f"quant_compute={args.quant_compute}",
+              file=sys.stderr, flush=True)
+    quant_tag = ("bf16" if args.weight_quant == "none"
+                 else f"{args.weight_quant}-{args.quant_compute}")
+    if args.weight_quant != "none":
+        # a quantized run is a different trajectory than the bf16
+        # headline — never let the two alias one metric name
+        metric = f"{metric}_{quant_tag}"
     scheduler = get_scheduler("ddim")
 
     b = 1
@@ -251,6 +276,8 @@ def main():
             warmup_steps=4,
             parallelism="patch",
             use_cuda_graph=mode != "stepwise",
+            weight_quant=args.weight_quant,
+            quant_compute=args.quant_compute,
         )
         runner = make_runner(cfg, ucfg, params, scheduler)
 
@@ -361,6 +388,12 @@ def main():
                 "value": round(mfu, 4),
                 "unit": "fraction",
                 "vs_baseline": round(mfu / 0.45, 3),
+                # which arithmetic produced it: "bf16", or
+                # "<weight_quant>-<quant_compute>" — both modes report
+                # against the SAME bf16-equivalent FLOP count and bf16
+                # peak, so quantized > bf16 reads directly as the
+                # compute-path speedup (ROADMAP item 5's gate)
+                "mode": quant_tag,
             }), flush=True)
         except Exception as e:  # never let the MFU extra sink the bench
             print(f"mfu line skipped: {type(e).__name__}: {e}",

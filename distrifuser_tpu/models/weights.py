@@ -565,11 +565,22 @@ def convert_mmdit_state_dict(sd: Dict[str, np.ndarray], dtype=jnp.float32):
 _DENSE_LAYERS = frozenset({"conv_out", "final_out"})
 
 
-def quantize_params(tree, mode: str):
+def quantize_params(tree, mode: str, *, compute: str = "dequant",
+                    channel_tile: int = 1):
     """Quantize every matmul/conv kernel of a converted param tree to the
     weight mode ("int8" / "fp8"; "none" returns the tree untouched — the
     bit-identity guarantee of the default config, so it REFUSES trees that
     already carry quantized leaves).
+
+    ``compute`` tags each QuantizedTensor with its execution policy
+    ("dequant" = PR-6 lazy-dequant storage semantics; "auto"/"dot"/
+    "pallas" route the consuming matmul through the low-precision paths
+    of ops/gemm_routing.py — DistriConfig.quant_compute maps "off" to
+    "dequant" here).  ``channel_tile`` groups output channels per scale
+    (1 = per-channel, the parity-pinned default).  On an ALREADY-quantized
+    tree at the same mode, payloads and scales are kept bit-identical and
+    only the compute policy re-tags (a reloaded archive carries storage,
+    not policy).
 
     Only leaves under a ``"kernel"`` dict key with ndim >= 2 quantize — the
     layout contract of this module's converters puts exactly the matmul and
@@ -593,6 +604,9 @@ def quantize_params(tree, mode: str):
     )
 
     validate_weight_mode(mode)
+    # config-level "off" (DistriConfig.quant_compute) is the leaf-level
+    # "dequant" policy
+    compute = "dequant" if compute == "off" else compute
     if mode == "none":
         # "none" is the bit-identity guarantee of the default config — a
         # tree still carrying QuantizedTensor leaves (a quantized .npz
@@ -639,7 +653,13 @@ def quantize_params(tree, mode: str):
                         have = ("int8" if v.payload.dtype == jnp.int8
                                 else "fp8")
                         if have == mode:
-                            out[k] = v
+                            # storage is baked (payload, scale, tile
+                            # granularity); the EXECUTION policy re-tags
+                            # to this call's config
+                            out[k] = (v if v.compute == compute else
+                                      QuantizedTensor(v.payload, v.scale,
+                                                      v.dtype, compute,
+                                                      v.channel_tile))
                             continue
                         raise ValueError(
                             f"quantize_params({mode!r}) on a tree already "
@@ -647,10 +667,42 @@ def quantize_params(tree, mode: str):
                             "compounds the rounding error — rebuild from "
                             "the dense tree"
                         )
-                    out[k] = quantize_weight(jnp.asarray(v), mode)
+                    out[k] = quantize_weight(jnp.asarray(v), mode,
+                                             compute=compute,
+                                             channel_tile=channel_tile)
                 else:
                     out[k] = walk(v, k)
             return out
+        return node
+
+    return walk(tree)
+
+
+def set_quant_compute(tree, policy: str):
+    """Re-tag every `QuantizedTensor` leaf's EXECUTION policy without
+    touching payloads or scales (DistriConfig.quant_compute semantics:
+    "off" maps to the leaf-level "dequant").  Cheap and numerics-free on
+    its own — the policy only selects which matmul path the next trace
+    takes — so pipelines apply it to reloaded archives (which carry
+    storage, not policy) and the serve layer applies ExecKey.quant_compute
+    through it.  Identity on dense trees."""
+    from ..parallel.compress import QuantizedTensor
+
+    leaf = "dequant" if policy == "off" else policy
+    if leaf not in ("dequant", "auto", "dot", "pallas"):
+        raise ValueError(
+            f"quant_compute policy must be 'off', 'auto', 'dot', or "
+            f"'pallas', got {policy!r}"
+        )
+
+    def walk(node):
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, QuantizedTensor) and node.compute != leaf:
+            return QuantizedTensor(node.payload, node.scale, node.dtype,
+                                   leaf, node.channel_tile)
         return node
 
     return walk(tree)
@@ -693,9 +745,16 @@ def params_nbytes(tree) -> int:
 # ---------------------------------------------------------------------------
 
 # Reserved npz leaf names for a QuantizedTensor kernel: payload, fp32
-# scales, and the (compute dtype, payload dtype) name pair — npz does not
-# round-trip ml_dtypes' float8 (it comes back as a void view), so the
-# payload dtype is recorded and viewed back on load.
+# scales, and the (compute dtype, payload dtype, channel_tile) record —
+# npz does not round-trip ml_dtypes' float8 (older numpy loads it as a
+# void view; newer versions can refuse the descr outright), so fp8
+# payloads are stored as EXPLICIT uint8 byte views and the recorded dtype
+# is viewed back on load.  channel_tile must be recorded too: with
+# grouped scales the scale length is ceil(out/tile), which is NOT
+# derivable from the payload shape when the last tile is partial — a
+# loader that assumed per-channel scales would rebuild a misaligned
+# QuantizedTensor (QuantizedTensor.__init__ now refuses that loudly).
+# Legacy archives (2-element dtype record, raw payload) still load.
 _QT_PAYLOAD, _QT_SCALE, _QT_DTYPES = "__wq__", "__wqs__", "__wqd__"
 
 # Dense leaves with ml_dtypes dtypes (bfloat16 trees) hit the same npz void
@@ -729,10 +788,14 @@ def _flatten(tree, prefix=""):
         for i, v in enumerate(tree):
             flat.update(_flatten(v, f"{prefix}{i}."))
     elif isinstance(tree, QuantizedTensor):
-        flat[f"{prefix}{_QT_PAYLOAD}"] = np.asarray(tree.payload)
+        payload = np.asarray(tree.payload)
+        if payload.dtype.kind == "V":  # ml_dtypes fp8: store uint8 bytes
+            payload = np.ascontiguousarray(payload).view(np.uint8)
+        flat[f"{prefix}{_QT_PAYLOAD}"] = payload
         flat[f"{prefix}{_QT_SCALE}"] = np.asarray(tree.scale, np.float32)
         flat[f"{prefix}{_QT_DTYPES}"] = np.array(
-            [np.dtype(tree.dtype).name, np.dtype(tree.payload.dtype).name]
+            [np.dtype(tree.dtype).name, np.dtype(tree.payload.dtype).name,
+             str(tree.channel_tile)]
         )
     else:
         v = np.asarray(tree)
@@ -765,13 +828,20 @@ def _restore(tree, dtype):
         if _QT_PAYLOAD in tree:
             names = [str(x) for x in tree[_QT_DTYPES]]
             pdt = _weight_payload_dtype(names[1])
+            # legacy (pre-channel_tile) archives recorded only the dtype
+            # pair; they were always per-channel
+            ct = int(names[2]) if len(names) > 2 else 1
             payload = np.asarray(tree[_QT_PAYLOAD])
             if payload.dtype != pdt:
+                # uint8 byte view (current archives) or numpy's void view
+                # of an ml_dtypes payload (legacy): both are 1-byte and
+                # view back shape-preserving
                 payload = payload.view(pdt)
             return QuantizedTensor(
                 jnp.array(payload),
                 jnp.array(tree[_QT_SCALE], jnp.float32),
                 jnp.dtype(names[0]),
+                channel_tile=ct,
             )
         if _RAW_VALUE in tree:
             raw = np.asarray(tree[_RAW_VALUE]).view(

@@ -72,9 +72,29 @@ def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int,
     replicated context KV, which every device holds in full (the online
     softmax is merge-order invariant up to fp rounding, so a static block
     composes exactly).  Returns the normalized fp32 accumulator
-    [B, heads, Lq, D] (callers cast/reshape)."""
+    [B, heads, Lq, D] (callers cast/reshape).
+
+    The exchange is SOFTWARE-PIPELINED (FastUSP-style kernel-level
+    compute/communication overlap, arXiv 2602.10940): hop 1 launches
+    before the own/static merges, and inside the loop each arrival's NEXT
+    hop is issued before that arrival is merged — the in-flight buffer
+    reaches only the loop carry through data movement, so XLA's
+    latency-hiding scheduler runs every hop's wire time concurrently with
+    the previous chunk's matmuls (the property tests/test_ring_attention
+    checks structurally via utils/overlap.py: the ring while-body's
+    collective-permute classifies *deferred*).  Still exactly n-1 hops —
+    the last arrival merges outside the loop, so no wasted exchange — and
+    the merge order is unchanged, so numerics are identical to the serial
+    ring.
+    """
     b, lq, c = q.shape
     d = c // heads
+    from ..parallel.collectives import ring_shift
+
+    # start hop 1 first: nothing depends on it until the own/static
+    # merges are done, so its wire time hides behind them
+    in_flight = ring_shift(kv_rotating, n, axis) if n > 1 else None
+
     s, vh = _chunk_scores(q, kv_own, heads)
     acc = jnp.zeros((b, heads, lq, d), jnp.float32)
     m = jnp.full((b, heads, lq, 1), -jnp.inf, jnp.float32)
@@ -83,17 +103,27 @@ def ring_pass(q, kv_own, kv_rotating, n: int, axis: str, *, heads: int,
     if kv_static is not None:
         s, vh = _chunk_scores(q, kv_static, heads)
         acc, m, l = _online_merge((acc, m, l), s, vh)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    if n == 1:
+        return acc / l
 
     def body(i, carry):
         acc, m, l, buf = carry
-        buf = lax.ppermute(buf, axis, perm=perm)
+        # issue hop i+2 BEFORE merging hop i+1's arrival: nxt flows only
+        # into the carry (pure data movement), so the permute overlaps
+        # this chunk's scores/merge instead of serializing ahead of them
+        nxt = ring_shift(buf, n, axis)
         s, vh = _chunk_scores(q, buf, heads)
         acc, m, l = _online_merge((acc, m, l), s, vh)
-        return acc, m, l, buf
+        return acc, m, l, nxt
 
-    acc, m, l, _ = lax.fori_loop(0, n - 1, body, (acc, m, l, kv_rotating))
+    # hops 1..n-2 merge in the loop (each launching its successor); the
+    # final arrival merges outside it — total hops stay n-1
+    if n > 2:
+        acc, m, l, in_flight = lax.fori_loop(
+            0, n - 2, body, (acc, m, l, in_flight)
+        )
+    s, vh = _chunk_scores(q, in_flight, heads)
+    acc, m, l = _online_merge((acc, m, l), s, vh)
     return acc / l
 
 

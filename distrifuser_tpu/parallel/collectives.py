@@ -38,6 +38,25 @@ def psum_mean(x, axis: str = SP_AXIS):
     return lax.pmean(x, axis)
 
 
+def ring_perm(n: int):
+    """Wrapping next-neighbor permutation along a ring axis: device i
+    sends to i+1 mod n.  Single source of truth for the ring-attention
+    chunk rotation (ops/ring_attention.py) and its software-pipelined
+    decomposition: hop h delivers device ``r-h mod n``'s chunk to rank
+    ``r``, so n-1 hops cover every peer exactly once."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_shift(x, n: int, axis: str = SP_AXIS):
+    """One ring hop: every device hands ``x`` to its next neighbor and
+    receives the previous neighbor's.  The unit the pipelined ring
+    attention overlaps — each hop's ppermute is issued BEFORE the compute
+    that consumes the previous hop's arrival, so its wire time hides
+    behind that chunk's matmuls (FastUSP-style kernel-level
+    compute/communication overlap, arXiv 2602.10940)."""
+    return lax.ppermute(x, axis, perm=ring_perm(n))
+
+
 def neighbor_perms(n: int):
     """Non-wrapping neighbor permutations along the patch axis:
     ``(down, up)`` = (send to next device, send to previous device).  Edge

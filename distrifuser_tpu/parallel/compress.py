@@ -63,6 +63,16 @@ COMPRESS_MODES = ("none", "int8", "fp8", "int8_residual")
 # wire-only: weights have no previous-step value to delta-code against.
 WEIGHT_QUANT_MODES = ("none", "int8", "fp8")
 
+# Quantized-COMPUTE policies (DistriConfig.quant_compute / ExecKey): how a
+# QuantizedTensor kernel executes at its consuming matmul.  "off" is PR-6
+# semantics — dequantize to the compute dtype and run a dense matmul
+# (quantization buys HBM bytes, zero FLOPs).  "auto" resolves per shape
+# through ops/gemm_routing.py (env override -> measured table -> analytic
+# default); "dot" forces the low-precision dot_general path (activations
+# dynamically quantized per token, int8/fp8 MACs, fused per-channel-tile
+# scale after the accumulate); "pallas" forces the tiled Pallas kernel.
+QUANT_COMPUTE_MODES = ("off", "auto", "dot", "pallas")
+
 # Layer kinds (context.KIND_REGISTRY) whose stale refresh compresses.  "gn"
 # is deliberately absent (see module docstring); "stepcache" is a local
 # carry with no collective.
@@ -149,6 +159,25 @@ def validate_weight_mode(mode: str) -> None:
         )
 
 
+def validate_quant_compute(policy: str, weight_quant: str = "int8") -> None:
+    """Config-time validation of a quantized-compute policy, shared by
+    DistriConfig, ServeConfig, and ExecKey.  Forcing a low-precision
+    execution path ("dot"/"pallas") on a full-precision key is a config
+    contradiction — there is no quantized kernel to execute — and refuses
+    loudly rather than silently running dense."""
+    if policy not in QUANT_COMPUTE_MODES:
+        raise ValueError(
+            f"quant_compute must be one of {QUANT_COMPUTE_MODES}, got "
+            f"{policy!r}"
+        )
+    if policy in ("dot", "pallas") and weight_quant == "none":
+        raise ValueError(
+            f"quant_compute={policy!r} forces a low-precision matmul path "
+            "but weight_quant='none' holds no quantized kernels — set "
+            "weight_quant to int8/fp8 or keep quant_compute 'auto'/'off'"
+        )
+
+
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
     """A quantized weight kernel: 1-byte payload + one fp32 scale per
@@ -168,14 +197,47 @@ class QuantizedTensor:
     consuming dot, so HBM holds (and streams) the 1-byte payload.  lax
     primitives don't take the protocol: explicit call sites (the conv
     paths in ops/conv.py) densify via ``asdense``.
+
+    ``compute`` is the EXECUTION policy (QUANT_COMPUTE_MODES minus "off",
+    which maps to the leaf-level "dequant"): ops/linear.py dispatches a
+    QuantizedTensor kernel to the low-precision dot_general / Pallas path
+    per this policy and the ops/gemm_routing.py table.  It lives in the
+    pytree AUX data (not a traced leaf), so two trees differing only in
+    policy have distinct treedefs — jit retraces instead of silently
+    reusing the other policy's program.  ``channel_tile`` groups output
+    channels per scale (1 = per-channel, the default and the PR-6
+    layout); the scale's last axis then has ``ceil(out/channel_tile)``
+    entries, with a partial last tile when out %% channel_tile != 0.
     """
 
-    __slots__ = ("payload", "scale", "_dtype")
+    __slots__ = ("payload", "scale", "_dtype", "compute", "channel_tile")
 
-    def __init__(self, payload, scale, dtype):
+    def __init__(self, payload, scale, dtype, compute: str = "dequant",
+                 channel_tile: int = 1):
         self.payload = payload
         self.scale = scale
         self._dtype = jnp.dtype(dtype)
+        if compute not in ("dequant", "auto", "dot", "pallas"):
+            raise ValueError(
+                f"QuantizedTensor compute policy must be 'dequant', "
+                f"'auto', 'dot', or 'pallas', got {compute!r}"
+            )
+        self.compute = compute
+        ct = int(channel_tile)
+        if ct < 1:
+            raise ValueError(f"channel_tile must be >= 1, got {channel_tile}")
+        n = payload.shape[-1] if getattr(payload, "ndim", 0) else 1
+        tiles = -(-n // ct)
+        sl = scale.shape[-1] if getattr(scale, "ndim", 0) else 1
+        if sl != tiles:
+            raise ValueError(
+                f"scale/payload tile misalignment: payload has {n} output "
+                f"channels at channel_tile={ct} -> {tiles} scale tiles, "
+                f"but the scale's last axis has {sl} — a round-trip that "
+                "dropped the tile size would dequantize with the wrong "
+                "per-channel scales"
+            )
+        self.channel_tile = ct
 
     @property
     def shape(self):
@@ -201,30 +263,70 @@ class QuantizedTensor:
         return int(self.payload.size * jnp.dtype(self.payload.dtype).itemsize
                    + self.scale.size * 4)
 
+    def channel_scale(self):
+        """The fp32 scale EXPANDED to one entry per output channel
+        ([..., out]), regardless of ``channel_tile`` — what the fused
+        scale application after a low-precision accumulate multiplies by
+        (and what ``__jax_array__`` dequantizes with)."""
+        if self.channel_tile == 1:
+            return self.scale
+        n = self.payload.shape[-1]
+        return jnp.repeat(self.scale, self.channel_tile, axis=-1)[..., :n]
+
     def __jax_array__(self):
-        return dequantize(self.payload, self.scale, self._dtype, axis=-2)
+        return dequantize(self.payload, self.channel_scale(), self._dtype,
+                          axis=-2)
 
     def __repr__(self) -> str:
         return (f"QuantizedTensor(shape={tuple(self.shape)}, "
                 f"payload={jnp.dtype(self.payload.dtype).name}, "
-                f"dtype={self._dtype.name})")
+                f"dtype={self._dtype.name}, compute={self.compute!r}, "
+                f"channel_tile={self.channel_tile})")
 
     def tree_flatten(self):
-        return (self.payload, self.scale), (self._dtype,)
+        return ((self.payload, self.scale),
+                (self._dtype, self.compute, self.channel_tile))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0])
+        return cls(children[0], children[1], *aux)
 
 
-def quantize_weight(w, mode: str) -> QuantizedTensor:
+def quantize_weight(w, mode: str, *, compute: str = "dequant",
+                    channel_tile: int = 1) -> QuantizedTensor:
     """Quantize one kernel leaf with per-output-channel-tile fp32 scales
     (the output axis is last in both the linear and HWIO conv layouts, so
-    the reduction axis is always ``-2``)."""
+    the reduction axis is always ``-2``).  ``channel_tile > 1`` groups
+    that many output channels per scale (each tile's scale is the max of
+    its channels' amax, so the per-element error bound still holds — just
+    against the tile amax, which is why per-channel stays the default);
+    the last tile is partial when the channel count does not divide.
+    ``compute`` tags the execution policy (see QuantizedTensor)."""
     if mode not in ("int8", "fp8"):
         raise ValueError(f"not a weight-quantizing mode: {mode!r}")
-    q, scale = quantize(w, mode, axis=-2)
-    return QuantizedTensor(q, scale, w.dtype)
+    ct = int(channel_tile)
+    if ct <= 1:
+        q, scale = quantize(w, mode, axis=-2)
+        return QuantizedTensor(q, scale, w.dtype, compute, 1)
+    xf = jnp.asarray(w).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-2)  # [..., out] per-channel amax
+    n = amax.shape[-1]
+    tiles = -(-n // ct)
+    pad = tiles * ct - n
+    if pad:
+        # pad with 0 so a partial last tile's scale is the max of its REAL
+        # channels only
+        amax = jnp.pad(amax, [(0, 0)] * (amax.ndim - 1) + [(0, pad)])
+    tile_amax = amax.reshape(*amax.shape[:-1], tiles, ct).max(axis=-1)
+    limit = _INT8_MAX if mode == "int8" else _FP8_MAX
+    scale = jnp.maximum(tile_amax, _SCALE_FLOOR) / limit
+    per_ch = jnp.repeat(scale, ct, axis=-1)[..., :n]
+    div = xf / jnp.expand_dims(per_ch, -2)
+    if mode == "int8":
+        q = jnp.clip(jnp.round(div), -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    else:
+        q = div.astype(fp8_dtype())
+    return QuantizedTensor(q, scale, w.dtype, compute, ct)
 
 
 def asdense(x):

@@ -653,8 +653,32 @@ class _GenerationMixin:
                 f"{mode!r}: the full-precision kernels are gone — rebuild "
                 "the pipeline from the dense weights instead"
             )
-        self.runner.params = quantize_params(self.runner.params, mode)
+        self.runner.params = quantize_params(
+            self.runner.params, mode, compute=cfg.quant_compute)
         cfg.weight_quant = mode
+        compiled = getattr(self.runner, "_compiled", None)
+        if compiled:
+            compiled.clear()
+
+    def set_quant_compute(self, policy: str) -> None:
+        """Re-tag the denoiser's quantized kernels with an EXECUTION
+        policy (DistriConfig.quant_compute; docs/PERF.md "Quantized
+        compute & GEMM routing").  Unlike set_weight_quant this is
+        payload-free — no values change, only which matmul path the next
+        trace routes through (ops/gemm_routing.py) — so it is safe in
+        both directions and the serve layer forces it per
+        ExecKey.quant_compute.  Drops compiled programs: policy lives in
+        the pytree aux data, so a policy change is a different traced
+        program."""
+        from .parallel.compress import validate_quant_compute
+        from .models.weights import set_quant_compute
+
+        cfg = self.distri_config
+        validate_quant_compute(policy, cfg.weight_quant)
+        if policy == cfg.quant_compute:
+            return
+        self.runner.params = set_quant_compute(self.runner.params, policy)
+        cfg.quant_compute = policy
         compiled = getattr(self.runner, "_compiled", None)
         if compiled:
             compiled.clear()
@@ -679,6 +703,7 @@ class _GenerationMixin:
         return {
             "weight_quant": cfg.weight_quant,
             "weight_quant_aux": cfg.weight_quant_aux,
+            "quant_compute": cfg.quant_compute,
             "per_component_nbytes": parts,
             "total_bytes": sum(parts.values()),
         }
@@ -831,7 +856,8 @@ class _DistriPipelineBase(_GenerationMixin):
         # the denoiser under weight_quant, the aux models (text encoders +
         # VAE) under their own tolerance sub-knob — "none" is a no-op, so
         # the default config stays bit-identical
-        unet_params = quantize_params(unet_params, distri_config.weight_quant)
+        unet_params = quantize_params(unet_params, distri_config.weight_quant,
+                                      compute=distri_config.quant_compute)
         self.vae_params, self.text_encoders, _ = _quantize_aux(
             distri_config, vae_params, text_encoders)
         self.scheduler = scheduler
@@ -1293,7 +1319,8 @@ class DistriPixArtPipeline(_GenerationMixin):
         dit_params = dit_mod.fold_size_condition(
             dit_params, dit_config, float(cfg.height), float(cfg.width)
         )
-        dit_params = quantize_params(dit_params, cfg.weight_quant)
+        dit_params = quantize_params(dit_params, cfg.weight_quant,
+                                     compute=cfg.quant_compute)
         runner_cls = (
             PipeFusionRunner if cfg.parallelism == "pipefusion"
             else DiTDenoiseRunner
@@ -1554,7 +1581,8 @@ class DistriSD3Pipeline(_GenerationMixin):
         self.scheduler = scheduler
         self.tokenizers = tokenizers
         text_encoders = self.text_encoders
-        mmdit_params = quantize_params(mmdit_params, cfg.weight_quant)
+        mmdit_params = quantize_params(mmdit_params, cfg.weight_quant,
+                                       compute=cfg.quant_compute)
         self.t5 = (t5_config, t5_q)
         self.max_t5_tokens = max_t5_tokens
         pooled_dim = sum(

@@ -78,6 +78,16 @@ class ExecKey:
     # partial_refresh tier keys its degraded programs through this field.
     refresh_fraction: float = 1.0
     weight_quant: str = "none"
+    # Quantized-COMPUTE policy (DistriConfig.quant_compute semantics):
+    # storage-only ("off") and compute-routed ("auto"/"dot"/"pallas")
+    # executables trace different matmul paths — int8-storage and
+    # int8-compute are DISTINCT compiled programs for the same bucket, so
+    # the ladder/controller can hold both and the weight ledger never
+    # aliases them.  Irrelevant (and unvalidated beyond membership) when
+    # weight_quant="none": a dense program has no quantized kernels to
+    # route, so "auto" and "off" trace identically — the field is kept
+    # out of short() there.
+    quant_compute: str = "auto"
     exec_mode: str = "fused"
     parallelism: str = "patch"
     pipe_patches: int = 0
@@ -111,6 +121,9 @@ class ExecKey:
                 f"weight_quant must be one of {WEIGHT_QUANT_MODES}, got "
                 f"{self.weight_quant!r}"
             )
+        from ..parallel.compress import validate_quant_compute
+
+        validate_quant_compute(self.quant_compute, self.weight_quant)
         if self.parallelism not in ("patch", "pipefusion"):
             raise ValueError(
                 f"ExecKey.parallelism must be 'patch' or 'pipefusion', "
@@ -146,12 +159,18 @@ class ExecKey:
               else f":pr{self.refresh_fraction:g}")
         wq = ("" if self.weight_quant == "none"
               else f":wq-{self.weight_quant}")
+        # storage-only vs compute-routed quantization are different
+        # programs: tag every non-default policy on quantized keys
+        # ("auto", the fleet default, stays untagged)
+        qc = ("" if self.weight_quant == "none"
+              or self.quant_compute == "auto"
+              else f":qc-{self.quant_compute}")
         em = "" if self.exec_mode == "fused" else f":{self.exec_mode}"
         pf = ("" if self.parallelism == "patch"
               else f":pf{self.pipe_patches or ''}")
         return (f"{self.model_id}:{self.scheduler}:{self.height}x"
                 f"{self.width}@{self.steps}st:{g}:{self.mesh_plan}"
-                f"{sc}{cc}{pr}{wq}{em}{pf}")
+                f"{sc}{cc}{pr}{wq}{qc}{em}{pf}")
 
 
 class ExecutorCache:

@@ -387,6 +387,13 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
             # of retrying into the same wall (serve/errors.py)
             raise DegradationInapplicableError(
                 str(exc), rung="weight_quant_on") from exc
+    # quant_compute re-tags the EXECUTION policy of already-quantized
+    # kernels (no payload change, no numerics until the next trace picks
+    # its routed path) — always safe to force post-construction, in both
+    # directions
+    if (key.quant_compute != getattr(dcfg, "quant_compute", "auto")
+            and hasattr(pipeline, "set_quant_compute")):
+        pipeline.set_quant_compute(key.quant_compute)
     if key.exec_mode == "stepwise":
         try:
             pipeline.set_stepwise(True)

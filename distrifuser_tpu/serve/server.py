@@ -393,6 +393,7 @@ class InferenceServer:
             refresh_fraction=(self.config.refresh_fraction
                               if parallelism == "patch" else 1.0),
             weight_quant=self.config.weight_quant,
+            quant_compute=self.config.quant_compute,
             parallelism=parallelism,
             pipe_patches=pipe_patches,
         )
@@ -1223,6 +1224,7 @@ class InferenceServer:
             # PR-4 wire-byte accounting
             "weights": {
                 "weight_quant": self.config.weight_quant,
+                "quant_compute": self.config.quant_compute,
                 "per_executor_nbytes": self.cache.weight_bytes(),
             },
             "resilience": self.resilience.snapshot(),

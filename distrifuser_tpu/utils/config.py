@@ -171,6 +171,19 @@ class DistriConfig:
     # decode error lands directly in output pixels (docs/PERF.md
     # "Quantized weights" for the measured tolerances).
     weight_quant_aux: str = "none"
+    # Quantized COMPUTE (ops/gemm_routing.py + ops/quant_matmul.py): how
+    # the weight_quant kernels execute at their consuming matmuls.  "off"
+    # pins PR-6 storage-only semantics (dequantize to the compute dtype,
+    # dense matmul — bytes saved, zero FLOPs).  "auto" (default) resolves
+    # per shape: env override -> the measured per-shape GEMM table ->
+    # analytic default (real int8/fp8 dot_general on TPU at the MXU's 2x
+    # int8 MAC rate, with dynamic per-token activation quantization and
+    # the per-channel-tile scale applied after the accumulate; dequant on
+    # CPU).  "dot"/"pallas" force one low-precision path (require
+    # weight_quant != "none").  Changes numerics vs "off" — activations
+    # quantize too; docs/PERF.md "Quantized compute & GEMM routing" pins
+    # the tolerances.  No effect when weight_quant="none".
+    quant_compute: str = "auto"
     # Sequence-parallel VAE decode over the sp axis (exact: fresh halo convs,
     # psum'd GroupNorm, ring mid attention — models/vae.py decode_sp).  The
     # reference decodes the full latent replicated on every rank; this is n x
@@ -292,6 +305,9 @@ class DistriConfig:
                 )
         validate_weight_mode(self.weight_quant)
         validate_weight_mode(self.weight_quant_aux)
+        from ..parallel.compress import validate_quant_compute
+
+        validate_quant_compute(self.quant_compute, self.weight_quant)
         if self.weight_quant != "none" and self.parallelism == "tensor":
             raise ValueError(
                 "weight_quant quantizes whole kernels ahead of the mesh "
@@ -960,6 +976,13 @@ class ServeConfig:
     # The aux-model sub-knob (weight_quant_aux) stays a builder decision:
     # it is fixed per builder, so it needs no per-key identity.
     weight_quant: str = "none"
+    # Service-wide quantized-COMPUTE policy (DistriConfig.quant_compute
+    # semantics): threaded into every ExecKey — storage-only ("off") and
+    # compute-routed ("auto"/"dot"/"pallas") programs trace different
+    # matmul paths, so they are distinct executables.  "auto" (default)
+    # means the PR-9 tier ladder's int8 rungs and the fleet inherit the
+    # low-precision execution path with no further serve-layer changes.
+    quant_compute: str = "auto"
     # Service-wide PCPP partial-refresh fraction (DistriConfig.
     # refresh_fraction semantics): threaded into every ExecKey — the
     # strided refresh schedule is traced into the program, so a fraction
@@ -1063,6 +1086,7 @@ class ServeConfig:
                                   self.step_cache_depth)
         from ..parallel.compress import (
             validate_mode,
+            validate_quant_compute,
             validate_refresh_fraction,
             validate_weight_mode,
         )
@@ -1070,6 +1094,7 @@ class ServeConfig:
         validate_mode(self.comm_compress)
         validate_refresh_fraction(self.refresh_fraction)
         validate_weight_mode(self.weight_quant)
+        validate_quant_compute(self.quant_compute, self.weight_quant)
         _SERVE_PARALLELISMS = ("patch", "pipefusion")
         if self.parallelism not in _SERVE_PARALLELISMS:
             raise ValueError(

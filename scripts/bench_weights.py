@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -53,8 +54,18 @@ TOLERANCES = {"unet": 1e-2, "dit": 3e-3, "mmdit": 3e-3}
 FP8_BOUNDS = {"unet": 4.5e-2, "dit": 1e-2, "mmdit": 1.3e-2}
 INT8_MIN_RATIO = 1.7
 
+# Compute-path tolerances (--compute): the low-precision dot/Pallas routes
+# quantize ACTIVATIONS dynamically on top of the weight rounding, so their
+# decoded-image budget sits above the storage-only numbers (docs/PERF.md
+# "Quantized compute & GEMM routing").  int8 gates; fp8 informative.
+COMPUTE_TOLERANCES = {"unet": 2e-2, "dit": 6e-3, "mmdit": 8e-3}
+# Analytic FLOP-path ceiling for the routed matmuls: int8 MACs at the
+# MXU's 2x rate plus quantize/scale overhead must land at <= 0.6 of the
+# bf16 dequant path's cost (the acceptance gate; ~0.5 + overhead terms).
+ANALYTIC_RATIO_MAX = 0.6
 
-def _build(family: str, mode: str):
+
+def _build(family: str, mode: str, compute: str = "auto"):
     import jax
     import jax.numpy as jnp
 
@@ -65,7 +76,7 @@ def _build(family: str, mode: str):
     common = dict(
         devices=jax.devices()[:1], height=128, width=128, warmup_steps=1,
         parallelism="patch", do_classifier_free_guidance=False,
-        dtype=jnp.float32, weight_quant=mode,
+        dtype=jnp.float32, weight_quant=mode, quant_compute=compute,
     )
     if family == "unet":
         from distrifuser_tpu.models.clip import (init_clip_params,
@@ -129,6 +140,60 @@ def _build(family: str, mode: str):
     raise SystemExit(f"unknown family {family!r}")
 
 
+def _analytic_compute_ratios(pipe):
+    """Closed-form FLOP cost of each quantized EXECUTION path over the
+    denoiser's routed matmuls (the 2D / depth-stacked QuantizedTensor
+    kernels), relative to the dequant-bf16 path.
+
+    Per kernel [K, N] at token count M: dequant costs ``2MKN`` bf16 MACs
+    (+ the KN dequantize convert); the dot route costs ``MKN``
+    MAC-equivalents (int8 at the MXU's 2x rate) + ``3MK`` activation
+    quantization + ``2MN`` scale application; Pallas fuses the weight
+    scale into the epilogue (``MN`` instead of ``2MN``).  The ratio is
+    nearly M-independent (overhead terms go as 1/N and 1/K), so one
+    representative M — this pipeline's latent token count — suffices.
+    Conv kernels (4D, always dequant) are excluded from the ratio and
+    reported as their own share.
+    """
+    import jax
+
+    from distrifuser_tpu.parallel.compress import QuantizedTensor
+
+    cfg = pipe.distri_config
+    m = cfg.latent_height * cfg.latent_width
+    cost = {"dequant": 0.0, "dot": 0.0, "pallas": 0.0}
+    conv_flops = 0.0
+    leaves = jax.tree.leaves(
+        pipe.runner.params,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    for leaf in leaves:
+        if not isinstance(leaf, QuantizedTensor):
+            continue
+        shp = tuple(leaf.shape)
+        if len(shp) == 2:
+            depth, (k, n) = 1, shp
+        elif len(shp) == 3:
+            depth, k, n = shp
+        else:  # conv kernels dequantize on every path
+            conv_flops += 2.0 * m * math.prod(shp)
+            continue
+        cost["dequant"] += depth * (2.0 * m * k * n + k * n)
+        cost["dot"] += depth * (m * k * n + 3.0 * m * k + 2.0 * m * n)
+        cost["pallas"] += depth * (m * k * n + 3.0 * m * k + m * n)
+    if cost["dequant"] <= 0:
+        return None
+    routed = cost["dequant"]
+    return {
+        "m_tokens": int(m),
+        "routed_matmul_flops": routed,
+        "conv_dense_flops": conv_flops,
+        "flop_ratio_vs_dequant": {
+            impl: round(cost[impl] / routed, 4)
+            for impl in ("dot", "pallas")
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=2)
@@ -138,6 +203,14 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument("--out", type=str, default=None,
                     help="also append the JSON line to this file")
+    ap.add_argument("--compute", action="store_true",
+                    help="also emit the compute-path section (one extra "
+                         "JSON line: steps/sec + parity + analytic FLOP "
+                         "ratio per execution path)")
+    ap.add_argument("--compute_only", action="store_true",
+                    help="emit ONLY the compute-path line (CI wiring)")
+    ap.add_argument("--compute_out", type=str, default=None,
+                    help="append the compute-path JSON line to this file")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -154,25 +227,84 @@ def main() -> None:
     modes = ["none"] + [m for m in modes if m != "none"]
     families = [f for f in args.families.split(",") if f]
 
-    per_family = {}
+    def timed_gen(pipe, family):
+        prompt = "a tpu etching an image"
+        gen = lambda: np.stack(pipe(  # noqa: E731 — fresh traced call
+            [prompt] if family == "unet" else prompt,
+            num_inference_steps=args.steps, seed=args.seed,
+            guidance_scale=1.0, output_type="np").images)
+        img = gen()  # compile outside the timed window
+        best = min(
+            (lambda t0: (gen(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            )
+            for _ in range(args.repeats)
+        )
+        return img, best
+
+    from common import emit_bench_line
+
     ok = True
+
+    # ---- compute-path section (ISSUE 12): the execution paths ----------
+    if args.compute or args.compute_only:
+        comp_modes = [m for m in modes if m != "none"]
+        comp_families = {}
+        for family in families:
+            base_img, _ = timed_gen(_build(family, "none"), family)
+            base_img = base_img.astype(np.float64)
+            fam = {}
+            for mode in comp_modes:
+                rows = {}
+                analytic = None
+                for impl in ("off", "dot", "pallas"):
+                    pipe = _build(family, mode, compute=impl)
+                    img, best = timed_gen(pipe, family)
+                    delta = float(np.abs(img.astype(np.float64)
+                                         - base_img).max())
+                    tol = (COMPUTE_TOLERANCES[family] if impl != "off"
+                           else TOLERANCES[family])
+                    row = {
+                        "steps_per_s": round(args.steps / best, 3),
+                        "max_abs_delta": delta,
+                        "within_tolerance": delta <= tol
+                        if mode == "int8" else None,
+                    }
+                    if mode == "int8":
+                        ok &= bool(row["within_tolerance"])
+                    if analytic is None and impl != "off":
+                        analytic = _analytic_compute_ratios(pipe)
+                    rows[impl] = row
+                if analytic:
+                    ratios = analytic["flop_ratio_vs_dequant"]
+                    analytic["within_ratio_max"] = all(
+                        r <= ANALYTIC_RATIO_MAX for r in ratios.values())
+                    ok &= analytic["within_ratio_max"]
+                fam[mode] = {"impls": rows, "analytic": analytic}
+            comp_families[family] = fam
+        emit_bench_line({
+            "bench": "weights_compute",
+            "backend": jax.default_backend(),
+            "steps": args.steps,
+            "seed": args.seed,
+            "compute_tolerances": COMPUTE_TOLERANCES,
+            "analytic_ratio_max": ANALYTIC_RATIO_MAX,
+            "families": comp_families,
+            "ok": bool(ok),
+        }, args.compute_out or args.out)
+        if args.compute_only:
+            if not ok:
+                sys.exit(1)
+            return
+
+    per_family = {}
     for family in families:
         rows = {}
         base_img = base_bytes = None
         for mode in modes:
             pipe = _build(family, mode)
             prompt = "a tpu etching an image"
-            gen = lambda: np.stack(pipe(  # noqa: E731 — fresh traced call
-                [prompt] if family == "unet" else prompt,
-                num_inference_steps=args.steps, seed=args.seed,
-                guidance_scale=1.0, output_type="np").images)
-            img = gen()  # compile outside the timed window
-            best = min(
-                (lambda t0: (gen(), time.perf_counter() - t0)[1])(
-                    time.perf_counter()
-                )
-                for _ in range(args.repeats)
-            )
+            img, best = timed_gen(pipe, family)
             nbytes = pipe.weight_report()["per_component_nbytes"]["denoiser"]
             row = {
                 "denoiser_nbytes": int(nbytes),
@@ -212,8 +344,6 @@ def main() -> None:
         "families": per_family,
         "ok": bool(ok),
     }
-    from common import emit_bench_line
-
     emit_bench_line(line, args.out)
     if not ok:
         sys.exit(1)

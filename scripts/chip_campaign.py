@@ -79,7 +79,7 @@ def register_axon_bounded(claim_timeout_s: int) -> bool:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phases", type=str,
-                    default="attn,tune,b1024_step,b1024,b1024_xla,b2048,"
+                    default="attn,tune,gemm,b1024_step,b1024,b1024_xla,b2048,"
                             "b2048_ring,b1024_fp32,trace")
     ap.add_argument("--deadline_s", type=float, default=9000.0,
                     help="total wall-clock budget; later phases skip")
@@ -250,6 +250,85 @@ def main():
                         res[f"{bq}x{bk}"] = f"failed:{type(e).__name__}"
                 emit(phase_name, L=L, heads=H, head_dim=C // H, batch=2,
                      ms=res)
+
+    # ---------------- gemm: quantized-compute impl comparison --------------
+    if "gemm" in phases and left() > 600:
+        from distrifuser_tpu.ops.linear import _quantized_matmul
+        from distrifuser_tpu.parallel.compress import (fp8_supported,
+                                                       quantize_weight)
+
+        # (M, K, N): token-count x reduction x output dims of the hot
+        # quantized matmuls — SDXL level-0/1 attention + MLP projections
+        # at 1024px, SD3-medium image-stream projections, 2048px level 1.
+        # On CPU (a structural bake: the table is backend-gated, so CPU
+        # measurements govern only CPU routing) the set shrinks to what
+        # emulated-bf16 GEMMs can chain inside the deadline.
+        if dev.platform == "tpu":
+            gemm_shapes = [
+                (1024, 1280, 5120), (4096, 640, 2560), (4096, 640, 640),
+                (4096, 1536, 6144), (16384, 640, 2560),
+            ]
+            gemm_iters = 20
+        else:
+            gemm_shapes = [(1024, 512, 2048), (4096, 512, 512)]
+            gemm_iters = 3
+        gemm_modes = ["int8"] + (["fp8"] if fp8_supported() else [])
+        for (M, K, N) in gemm_shapes:
+            if left() < 300:
+                emit("gemm", M=M, skipped="deadline")
+                continue
+            x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+            w1 = np.asarray(jax.random.normal(
+                jax.random.PRNGKey(1), (K, N), jnp.bfloat16))
+            w2 = np.asarray(jax.random.normal(
+                jax.random.PRNGKey(2), (N, K), jnp.bfloat16))
+            for mode in gemm_modes:
+                # chain by PAIRS of matmuls ([M,K]@[K,N] then [M,N]@[N,K]
+                # back to x's shape) so timed() can data-depend iterations;
+                # ms is per PAIR — only the impl ordering matters, and it
+                # is shared by every column
+                res = {}
+                for impl in ("dequant", "dot", "pallas"):
+                    q1 = quantize_weight(jnp.asarray(w1), mode, compute=impl)
+                    q2 = quantize_weight(jnp.asarray(w2), mode, compute=impl)
+
+                    def pair(xx, a, b):
+                        return _quantized_matmul(
+                            _quantized_matmul(xx, a), b).astype(xx.dtype)
+
+                    try:
+                        res[impl] = round(timed(pair, x, q1, q2,
+                                                iters=gemm_iters) * 1e3, 3)
+                    except Exception as e:
+                        res[impl] = f"failed:{type(e).__name__}"
+                emit("gemm", M=M, K=K, N=N, mode=mode,
+                     backend=dev.platform, ms=res)
+            # pallas tile sweep (int8 only: the tile optimum is about the
+            # accumulator walk, not the payload dtype)
+            res = {}
+            q1 = quantize_weight(jnp.asarray(w1), "int8", compute="pallas")
+            q2 = quantize_weight(jnp.asarray(w2), "int8", compute="pallas")
+            for bm, bn, bk in [(128, 256, 512), (256, 256, 512),
+                               (256, 512, 512), (512, 256, 1024)]:
+                os.environ["DISTRIFUSER_TPU_GEMM"] = "pallas"
+                os.environ["DISTRIFUSER_TPU_GEMM_BM"] = str(bm)
+                os.environ["DISTRIFUSER_TPU_GEMM_BN"] = str(bn)
+                os.environ["DISTRIFUSER_TPU_GEMM_BK"] = str(bk)
+                jax.clear_caches()  # env routing is trace-time
+                try:
+                    res[f"{bm}x{bn}x{bk}"] = round(timed(
+                        lambda xx, a, b: _quantized_matmul(
+                            _quantized_matmul(xx, a), b).astype(xx.dtype),
+                        x, q1, q2, iters=min(gemm_iters, 10),
+                    ) * 1e3, 3)
+                except Exception as e:
+                    res[f"{bm}x{bn}x{bk}"] = f"failed:{type(e).__name__}"
+            for var in ("DISTRIFUSER_TPU_GEMM", "DISTRIFUSER_TPU_GEMM_BM",
+                        "DISTRIFUSER_TPU_GEMM_BN", "DISTRIFUSER_TPU_GEMM_BK"):
+                os.environ.pop(var, None)
+            jax.clear_caches()
+            emit("gemm_tune", M=M, K=K, N=N, mode="int8",
+                 backend=dev.platform, ms=res)
 
     # ---------------- full-model latencies --------------------------------
     def bench_unet(size, stepwise, label, flash_env=None, attn_impl="gather",

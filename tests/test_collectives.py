@@ -98,3 +98,68 @@ def test_psum_mean(devices8):
         )
     )(x)
     np.testing.assert_allclose(np.asarray(out), np.full((n, 1), np.mean(range(n))))
+
+
+def test_ring_perm_covers_every_peer_once():
+    n = 4
+    perm = col.ring_perm(n)
+    assert perm == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    # n-1 hops deliver device r-h mod n to rank r, every peer exactly once
+    for r in range(n):
+        seen = set()
+        src = r
+        for _ in range(n - 1):
+            src = (src - 1) % n
+            seen.add(src)
+        assert seen == set(range(n)) - {r}
+
+
+def test_ring_shift_rotates_one_hop(devices8):
+    n = 4
+    mesh = sp_mesh(devices8, n)
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+    out = jax.jit(
+        shard_map(
+            lambda xl: col.ring_shift(xl, n),
+            mesh=mesh,
+            in_specs=P(SP_AXIS, None),
+            out_specs=P(SP_AXIS, None),
+        )
+    )(x)
+    # rank r receives rank r-1's value (wrap at 0)
+    np.testing.assert_array_equal(
+        np.asarray(out).ravel(), np.array([3.0, 0.0, 1.0, 2.0])
+    )
+
+
+def test_pipelined_ring_pass_permute_is_deferred(devices8):
+    """FastUSP-style overlap, checked structurally: the software-pipelined
+    ring_pass issues hop i+1's ppermute before merging hop i's arrival, so
+    in the compiled while body the collective-permute's value reaches ONLY
+    the loop carry — utils/overlap.py classifies it deferred
+    (overlappable), where the serial ring's permute (consumed by the same
+    iteration's score matmuls) classified inline."""
+    from distrifuser_tpu.ops.ring_attention import ring_pass
+    from distrifuser_tpu.utils.overlap import analyze_loop_collectives
+
+    n, b, L, c, heads = 4, 1, 256, 64, 4
+    mesh = sp_mesh(devices8, n)
+    q = jnp.zeros((b, L, c))
+    kv = jnp.zeros((b, L, 2 * c))
+    sm = shard_map(
+        lambda ql, kvl: ring_pass(ql, kvl, kvl, n, SP_AXIS, heads=heads),
+        mesh=mesh,
+        in_specs=(P(None, SP_AXIS), P(None, SP_AXIS)),
+        out_specs=P(None, None, SP_AXIS),
+    )
+    hlo = jax.jit(sm).lower(q, kv).compile().as_text()
+    reports = analyze_loop_collectives(hlo)
+    assert reports, "ring fori_loop produced no while-body collectives"
+    ring = max(reports, key=lambda r: r.n_deferred)
+    assert "collective-permute" in ring.deferred.values(), (
+        f"pipelined ring hop not carry-only: {ring.inline}"
+    )
+    assert ring.n_inline == 0, (
+        f"ring while body serializes a collective against compute: "
+        f"{ring.inline}"
+    )

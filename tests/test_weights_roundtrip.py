@@ -116,3 +116,56 @@ def test_converted_then_quantized_tree_npz_roundtrip(tmp_path, mode):
     assert isinstance(back["conv_in"]["kernel"], QuantizedTensor)
     payload = "int8" if mode == "int8" else "float8_e4m3fn"
     assert payload in kinds
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_npz_roundtrip_partial_channel_tile_and_byte_view(tmp_path, mode):
+    """Regression (ISSUE 12): archives must restore payload dtype AND
+    tile-scale alignment.  With grouped channel tiles the scale length is
+    ceil(out/tile) — NOT derivable from the payload shape when the last
+    tile is partial — so a loader that dropped the tile size would
+    rebuild per-channel-misaligned QuantizedTensors (the constructor now
+    refuses that).  fp8 payloads additionally store as explicit uint8
+    byte views (numpy's void round-trip of ml_dtypes is
+    version-fragile); the recorded dtype views them back."""
+    from distrifuser_tpu.parallel.compress import (QuantizedTensor,
+                                                   fp8_supported,
+                                                   quantize_weight)
+
+    if mode == "fp8" and not fp8_supported():
+        pytest.skip("no float8_e4m3fn in this jax build")
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    # 50 output channels at tile 16 -> 4 scale tiles, last one partial;
+    # bf16 compute dtype exercises the byte-view dense-leaf path too
+    tree = {
+        "layer": {
+            "kernel": quantize_weight(
+                jnp.asarray(rng.randn(24, 50), jnp.bfloat16), mode,
+                channel_tile=16),
+            "bias": jnp.zeros((50,), jnp.bfloat16),
+        }
+    }
+    path = str(tmp_path / f"ct_{mode}.npz")
+    save_params(path, tree)
+    # the archive holds no ml_dtypes-void payloads (uint8/int8 views only)
+    raw = np.load(path)
+    assert all(raw[k].dtype.kind != "V" for k in raw.files), {
+        k: raw[k].dtype for k in raw.files}
+    back = load_params(path)
+    qt = back["layer"]["kernel"]
+    assert isinstance(qt, QuantizedTensor)
+    assert qt.channel_tile == 16 and qt.scale.shape == (4,)
+    assert qt.payload.dtype == tree["layer"]["kernel"].payload.dtype
+    assert qt.dtype == jnp.bfloat16
+    for a, b in [(tree["layer"]["kernel"].payload, qt.payload),
+                 (tree["layer"]["kernel"].scale, qt.scale)]:
+        np.testing.assert_array_equal(
+            np.asarray(a).view(np.uint8), np.asarray(b).view(np.uint8))
+    # dequantized values identical -> the forward is bit-stable across a
+    # server restart
+    np.testing.assert_array_equal(
+        np.asarray(tree["layer"]["kernel"].__jax_array__(), np.float32),
+        np.asarray(qt.__jax_array__(), np.float32))
+    assert params_nbytes(back) == params_nbytes(tree)
