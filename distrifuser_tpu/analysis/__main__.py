@@ -1,0 +1,174 @@
+"""``python -m distrifuser_tpu.analysis`` — the one lint entry point.
+
+Exit codes:
+  0  clean (or only suppressed findings; non-strict tolerates stale
+     baseline entries with a warning)
+  1  non-baselined findings, stale baseline entries (--strict), or a
+     malformed baseline
+  2  usage errors
+
+The jaxpr overlap gate needs the fake 8-device CPU mesh, so this module
+pins JAX_PLATFORMS=cpu and the host-device-count flag BEFORE anything
+imports jax — same bootstrap as tests/conftest.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_fake_devices() -> None:
+    # ``python -m distrifuser_tpu.analysis`` imports the parent package
+    # (and therefore jax) before this module runs, but XLA reads these
+    # only at BACKEND initialization — the first jax.devices() call —
+    # so setting them here still works as long as no checker (or caller)
+    # touched a device yet.  overlap_gate verifies the count and emits a
+    # finding if a pre-initialized backend got in first.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _repo_root() -> str:
+    # the directory CONTAINING the distrifuser_tpu package
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "distrifuser_tpu", "analysis",
+                        "baseline.txt")
+
+
+def main(argv=None) -> int:
+    _ensure_fake_devices()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from . import registry
+    from .core import Baseline, BaselineError, CheckContext, \
+        apply_baseline, render_baseline
+
+    parser = argparse.ArgumentParser(
+        prog="python -m distrifuser_tpu.analysis",
+        description="distrilint: machine-check the repo's cross-cutting "
+                    "invariants (see docs/ANALYSIS.md)")
+    parser.add_argument("--strict", action="store_true",
+                        help="fail on ANY non-baselined finding and on "
+                        "stale baseline entries (the CI gate mode)")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the findings report as JSON")
+    parser.add_argument("--baseline", metavar="PATH",
+                        help="baseline file (default: "
+                        "distrifuser_tpu/analysis/baseline.txt)")
+    parser.add_argument("--checker", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this checker (repeatable)")
+    parser.add_argument("--root", default=None,
+                        help="repo root to analyze (default: the "
+                        "checkout this package lives in).  Must be the "
+                        "SAME checkout as the importable package: the "
+                        "compile-identity/route-tables/jaxpr-overlap "
+                        "checkers read the imported modules, not --root")
+    parser.add_argument("--list", action="store_true",
+                        help="list checkers and exit")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings as the "
+                        "baseline (new entries get an UNREVIEWED "
+                        "placeholder reason the validator rejects — "
+                        "replace each with a real justification)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for c in registry.all_checkers():
+            print(f"{c.NAME:26s} {c.DESCRIPTION}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    if os.path.realpath(root) != os.path.realpath(_repo_root()):
+        # import-based checkers read the sys.path package; mixing trees
+        # would let an AST-side removal pass against import-side truth
+        print(f"--root {root} is not the importable checkout "
+              f"({_repo_root()}): import-based checkers would read the "
+              "wrong tree — run the target checkout's own entry point",
+              file=sys.stderr)
+        return 2
+    ctx = CheckContext(root)
+    baseline_path = args.baseline or default_baseline_path(root)
+
+    results = registry.run_checkers(ctx, args.checker)
+    findings = [f for fs in results.values() for f in fs]
+
+    if args.write_baseline:
+        try:
+            previous = Baseline.load(baseline_path)
+        except BaselineError:
+            previous = Baseline(entries=(), path=baseline_path)
+        header = ("# distrilint baseline — reviewed suppressions "
+                  "(docs/ANALYSIS.md).\n"
+                  "# Every entry needs a '# provenance:' reason line; "
+                  "stale entries fail --strict.\n")
+        with open(baseline_path, "w") as f:
+            f.write(render_baseline(findings, previous, header=header))
+        print(f"wrote {len(findings)} entr{'y' if len(findings) == 1 else 'ies'} "
+              f"to {baseline_path}")
+        return 0
+
+    try:
+        baseline = Baseline.load(baseline_path)
+    except BaselineError as exc:
+        print(f"BASELINE INVALID: {exc}", file=sys.stderr)
+        return 1
+    result = apply_baseline(findings, baseline,
+                            active_checkers=list(results))
+
+    for f in sorted(result.new, key=lambda f: (f.path, f.line)):
+        print(f.render(), file=sys.stderr)
+    for e in result.stale:
+        line = (f"STALE BASELINE ENTRY {e.fingerprint} ({e.checker} "
+                f"{e.path}): no checker emits this fingerprint any more "
+                f"— remove it from {baseline_path}")
+        print(line, file=sys.stderr)
+
+    counts = {name: len(fs) for name, fs in results.items()}
+    summary = {
+        "schema": 1,
+        "new": len(result.new),
+        "suppressed": len(result.suppressed),
+        "stale_baseline": len(result.stale),
+        "baseline_size": len(baseline.entries),
+        "by_checker": counts,
+    }
+    if args.json:
+        report = dict(summary)
+        report["findings"] = [f.to_json() for f in result.new]
+        report["suppressed_findings"] = [
+            {**f.to_json(), "provenance": e.reason}
+            for f, e in result.suppressed
+        ]
+        report["stale_entries"] = [
+            {"fingerprint": e.fingerprint, "checker": e.checker,
+             "path": e.path} for e in result.stale
+        ]
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+
+    errors = [f for f in result.new if f.severity == "error"]
+    failed = bool(errors) or (args.strict
+                              and (result.new or result.stale))
+    status = "FAIL" if failed else "ok"
+    print(f"distrilint {status}: {len(result.new)} new, "
+          f"{len(result.suppressed)} suppressed, "
+          f"{len(result.stale)} stale baseline entries "
+          f"({sum(counts.values())} raw across {len(counts)} checkers)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Checker implementations.  Each module exposes ``NAME``,
+``DESCRIPTION``, and ``run(ctx) -> List[Finding]``; checkers keep a
+pure core (operating on an extracted model of the tree) separate from
+the extraction, so tests can seed violations without editing the repo.
+Registration lives in analysis/registry.py."""

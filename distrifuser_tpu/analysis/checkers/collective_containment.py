@@ -1,0 +1,136 @@
+"""Collective containment: comm bytes only move where accounting sees.
+
+The byte model became a *checked invariant* in PR 8: the live
+StepTimeline counters reconcile EXACTLY against the closed-form
+`comm_plan`, which prices what `context.WIRE_REGISTRY` registered.  That
+reconciliation is only exhaustive while every collective flows through
+the registered helpers — a raw `lax.all_gather` dropped into a model
+file moves real wire bytes the plan never prices, and the exact test
+keeps passing while lying.
+
+This checker confines raw `lax.<collective>` call sites to the blessed
+accounting layer:
+
+* `parallel/collectives.py` — the named-axis helper surface itself;
+* `parallel/context.py` — PatchContext's emit/refresh paths, which
+  register every exchange in WIRE_REGISTRY as they trace it;
+* `parallel/compress.py` — the quantized-wire variants, ditto.
+
+Everything else must call the helpers (ops/, models/, parallel runners)
+or carry a baseline entry whose provenance line names the accounting
+that covers it (e.g. PipeFusion's ring hops are priced by its own
+closed-form `comm_report`, reconciled in tests/test_pipefusion.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Tuple
+
+from ..core import CheckContext, Finding, enclosing_qualname
+
+NAME = "collective-containment"
+DESCRIPTION = ("raw lax.<collective> calls confined to the "
+               "WIRE_REGISTRY-accounted helper modules")
+
+#: raw spellings this checker hunts (jax.lax surface)
+COLLECTIVE_NAMES = frozenset({
+    "ppermute", "all_gather", "psum", "pmean", "psum_scatter",
+    "all_to_all", "pmin", "pmax", "pgather", "pshuffle", "pswapaxes",
+})
+
+#: modules where raw collectives ARE the accounting layer
+BLESSED_MODULES = frozenset({
+    "distrifuser_tpu/parallel/collectives.py",
+    "distrifuser_tpu/parallel/context.py",
+    "distrifuser_tpu/parallel/compress.py",
+})
+
+
+def _lax_bases(tree: ast.Module) -> frozenset:
+    """Local names that refer to jax.lax in this module (``lax`` via
+    ``from jax import lax`` / ``import jax.lax as lax``), plus direct
+    names bound by ``from jax.lax import ppermute``."""
+    bases, direct = set(), {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "lax":
+                        bases.add(a.asname or "lax")
+            elif node.module == "jax.lax":
+                for a in node.names:
+                    if a.name in COLLECTIVE_NAMES:
+                        direct[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.lax":
+                    # `import jax.lax as L` binds L; plain `import
+                    # jax.lax` binds `jax`, and calls read jax.lax.x
+                    bases.add(a.asname if a.asname else "jax.lax")
+                elif a.name == "jax":
+                    bases.add((a.asname or "jax") + ".lax")  # jax.lax.x
+    return frozenset(bases), dict(direct)
+
+
+def scan_module(tree: ast.Module, relpath: str,
+                blessed: Sequence[str] = ()) -> List[Finding]:
+    """Findings for raw collective calls in one module (pure core —
+    tests feed fixture sources here directly)."""
+    blessed = set(blessed) | BLESSED_MODULES
+    if relpath in blessed:
+        return []
+    bases, direct = _lax_bases(tree)
+    findings: List[Finding] = []
+    counts: Dict[Tuple[str, str], int] = {}
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST):
+        is_scope = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))
+        if is_scope:
+            stack.append(node)
+        if isinstance(node, ast.Call):
+            name = None
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in COLLECTIVE_NAMES:
+                base = None
+                if isinstance(fn.value, ast.Name):
+                    base = fn.value.id
+                elif (isinstance(fn.value, ast.Attribute)
+                      and isinstance(fn.value.value, ast.Name)):
+                    base = f"{fn.value.value.id}.{fn.value.attr}"
+                if base in bases:
+                    name = fn.attr
+            elif isinstance(fn, ast.Name) and fn.id in direct:
+                name = direct[fn.id]  # canonical name, not the alias
+            if name is not None:
+                scope = enclosing_qualname(stack)
+                idx = counts.get((scope, name), 0)
+                counts[(scope, name)] = idx + 1
+                findings.append(Finding(
+                    checker=NAME, path=relpath, line=node.lineno,
+                    message=(
+                        f"raw lax.{name} in {scope} — collectives must "
+                        "flow through the WIRE_REGISTRY-accounted "
+                        "helpers (parallel/collectives.py) or the "
+                        "PatchContext emit paths, or the comm_plan/"
+                        "StepTimeline exact reconciliation stops being "
+                        "exhaustive; wrap it, or baseline it naming the "
+                        "accounting that covers it"),
+                    identity=f"{scope}:{name}:{idx}",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_scope:
+            stack.pop()
+
+    visit(tree)
+    return findings
+
+
+def run(ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py("distrifuser_tpu"):
+        findings.extend(scan_module(ctx.tree(rel), rel))
+    return findings

@@ -1,0 +1,255 @@
+"""Compile-identity completeness: no half-wired ExecKey knob can land.
+
+The invariant (serve/cache.py ExecKey docstring, re-proved by hand in
+every one of PRs 2/4/6/7/9/12): **every trace-affecting serve knob is a
+compile-identity field**.  A `ServeConfig` knob that changes the traced
+program but is missing from `ExecKey` makes two different XLA programs
+alias one cache entry — a stale executor silently serves wrong numerics
+to the whole fleet.  The wiring has four stations, and a new knob must
+reach all of them:
+
+1. a same-named `ExecKey` dataclass field (`serve/cache.py`);
+2. `ExecKey.short()` must render it — short() keys the per-executor
+   ledgers (weight_bytes, circuits, degradations), so an unrendered
+   field lets two resident keys collide to one tag;
+3. `executors.apply_key_policy` must consider it — degraded keys built
+   by ladder/controller rewrites reach builders that predate the knob;
+4. `InferenceServer._exec_key_for` must thread the ServeConfig value
+   into the `ExecKey(...)` construction — or per-bucket routing forgets
+   the knob entirely.
+
+ServeConfig fields that deliberately do NOT trace live in
+`SERVE_RUNTIME_ALLOWLIST` with a reason each (the explicit
+trace-invariant allowlist); ExecKey fields no station needs are listed
+the same way.  Removing any single ExecKey field — or its short()/
+apply_key_policy handling — makes this checker fail (asserted field by
+field in tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Tuple
+
+from ..core import CheckContext, Finding
+
+NAME = "compile-identity"
+DESCRIPTION = ("ServeConfig knobs mirrored into ExecKey; short()/"
+               "apply_key_policy/_exec_key_for cover every field")
+
+CACHE_PATH = "distrifuser_tpu/serve/cache.py"
+EXECUTORS_PATH = "distrifuser_tpu/serve/executors.py"
+SERVER_PATH = "distrifuser_tpu/serve/server.py"
+
+#: ServeConfig fields that never change the traced program — each with
+#: the reason it is trace-invariant.  A new ServeConfig field must either
+#: gain a same-named ExecKey field or an entry here; there is no third
+#: option the gate accepts.
+SERVE_RUNTIME_ALLOWLIST: Dict[str, str] = {
+    "max_queue_depth": "admission bound — host-side queue shape",
+    "default_ttl_s": "deadline bookkeeping on the host clock",
+    "max_batch_size": "batcher coalescing bound; batch dim is padded "
+                      "inside one program",
+    "batch_window_s": "batcher linger timing, host-side",
+    "buckets": "per-request: snapped resolutions enter keys as "
+               "ExecKey.height/width",
+    "cache_capacity": "LRU bound on the executor map itself",
+    "warmup_buckets": "startup prefetch list; each bucket keys normally",
+    "warmup_cfg": "warmup-only: enters keys via _exec_key_for(cfg=...)",
+    "default_steps": "per-request default: enters ExecKey.steps",
+    "bucket_parallelism": "routing map: resolves per bucket into "
+                          "ExecKey.parallelism in _exec_key_for",
+    "pipeline_stages": "staged vs monolithic dispatch of the SAME "
+                       "compiled stage programs (bit-identical, "
+                       "tests/test_staging.py)",
+    "max_inflight_batches": "staging HBM cap, host-side semaphore",
+    "prompt_cache_capacity": "host-side embedding LRU bound",
+    "controller": "sub-config: tier walks rewrite keys via apply_tier",
+    "resilience": "sub-config: ladder rungs rewrite keys via "
+                  "DegradationLadder.apply",
+    "observability": "host-side tracing/metrics plane",
+}
+
+#: ExecKey fields _exec_key_for does not thread from ServeConfig —
+#: set only by degradation machinery downstream of key construction.
+LADDER_ONLY_ALLOWLIST: Dict[str, str] = {
+    "exec_mode": "set only by the resilience ladder's stepwise rung "
+                 "(DegradationLadder.apply); ServeConfig has no such knob",
+}
+
+#: ExecKey fields apply_key_policy leaves to build_pipeline: the builder
+#: constructs its DistriConfig/weights from these, and no degradation
+#: rung ever rewrites them post-construction except through a fresh key.
+STRUCTURAL_FIELDS: Dict[str, str] = {
+    "model_id": "selects the builder's weights — never forced post-build",
+    "scheduler": "pipeline constructor argument",
+    "height": "bucket geometry: the builder's DistriConfig shape",
+    "width": "bucket geometry: the builder's DistriConfig shape",
+    "steps": "prepare(key.steps) in pipeline_executor_factory",
+    "cfg": "guidance branch topology, fixed at construction",
+    "mesh_plan": "mesh layout, fixed at construction",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class IdentityModel:
+    """Everything the pure check needs, extracted from the tree.  Tests
+    mutate copies of this to seed violations (missing field, dropped
+    short() tag, unthreaded kwarg) without editing the repo."""
+
+    exec_key_fields: Tuple[str, ...]
+    serve_config_fields: Tuple[str, ...]
+    short_attrs: FrozenSet[str]       # self.X reads inside ExecKey.short
+    policy_attrs: FrozenSet[str]      # every attr name in apply_key_policy
+    policy_key_attrs: FrozenSet[str]  # key.X reads in apply_key_policy
+    key_call_kwargs: FrozenSet[str]   # ExecKey(...) kwargs in _exec_key_for
+    lines: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def line(self, station: str) -> int:
+        return self.lines.get(station, 0)
+
+
+def _attr_reads(node: ast.AST, base: str) -> FrozenSet[str]:
+    return frozenset(
+        n.attr for n in ast.walk(node)
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name)
+        and n.value.id == base
+    )
+
+
+def _all_attr_names(node: ast.AST) -> FrozenSet[str]:
+    return frozenset(n.attr for n in ast.walk(node)
+                     if isinstance(n, ast.Attribute))
+
+
+def _find_def(tree: ast.Module, name: str, cls: str = None) -> ast.AST:
+    for node in ast.walk(tree):
+        if cls is not None:
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                for sub in node.body:
+                    if (isinstance(sub, ast.FunctionDef)
+                            and sub.name == name):
+                        return sub
+        elif isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    raise LookupError(f"{name!r} not found" + (f" in class {cls}" if cls
+                                               else ""))
+
+
+def build_model(ctx: CheckContext) -> IdentityModel:
+    """Extract the four stations from the real tree: ExecKey/ServeConfig
+    fields by import (dataclass truth, inheritance-proof), the handling
+    functions by AST (what the source actually references)."""
+    from ...serve.cache import ExecKey
+    from ...utils.config import ServeConfig
+
+    short_def = _find_def(ctx.tree(CACHE_PATH), "short", cls="ExecKey")
+    policy_def = _find_def(ctx.tree(EXECUTORS_PATH), "apply_key_policy")
+    keyfor_def = _find_def(ctx.tree(SERVER_PATH), "_exec_key_for")
+    key_call = None
+    for node in ast.walk(keyfor_def):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "ExecKey"):
+            key_call = node
+            break
+    kwargs = frozenset(kw.arg for kw in key_call.keywords
+                       if kw.arg is not None) if key_call else frozenset()
+    return IdentityModel(
+        exec_key_fields=tuple(f.name for f in dataclasses.fields(ExecKey)),
+        serve_config_fields=tuple(
+            f.name for f in dataclasses.fields(ServeConfig)),
+        short_attrs=_attr_reads(short_def, "self"),
+        policy_attrs=_all_attr_names(policy_def),
+        policy_key_attrs=_attr_reads(policy_def, "key"),
+        key_call_kwargs=kwargs,
+        lines={
+            "short": short_def.lineno,
+            "policy": policy_def.lineno,
+            "key_for": keyfor_def.lineno,
+        },
+    )
+
+
+def check_model(model: IdentityModel) -> List[Finding]:
+    """The pure gate over an extracted (or test-seeded) model."""
+    findings: List[Finding] = []
+    key_fields = set(model.exec_key_fields)
+
+    def finding(path, line, rule, field, message):
+        findings.append(Finding(
+            checker=NAME, path=path, line=line, message=message,
+            identity=f"{rule}:{field}"))
+
+    # station 1: every ServeConfig knob is mirrored or allowlisted
+    for f in model.serve_config_fields:
+        if f not in key_fields and f not in SERVE_RUNTIME_ALLOWLIST:
+            finding("distrifuser_tpu/utils/config.py", 0, "mirror", f,
+                    f"ServeConfig.{f} is neither an ExecKey field nor in "
+                    "the trace-invariant allowlist — a trace-affecting "
+                    "knob missing from the compile identity lets a stale "
+                    "executor serve wrong numerics (add the ExecKey "
+                    "field or allowlist it with a reason in "
+                    "analysis/checkers/compile_identity.py)")
+    # allowlist hygiene: entries must be live and must not shadow fields
+    for f, _why in SERVE_RUNTIME_ALLOWLIST.items():
+        if f not in model.serve_config_fields:
+            finding("distrifuser_tpu/utils/config.py", 0,
+                    "allowlist-stale", f,
+                    f"trace-invariant allowlist names {f!r} which is no "
+                    "longer a ServeConfig field — remove the entry")
+        if f in key_fields:
+            finding(CACHE_PATH, 0, "allowlist-shadow", f,
+                    f"{f!r} is both an ExecKey field and allowlisted as "
+                    "trace-invariant — one of the two is lying")
+
+    # station 2: short() renders every field, and only real fields
+    for f in model.exec_key_fields:
+        if f not in model.short_attrs:
+            finding(CACHE_PATH, model.line("short"), "short", f,
+                    f"ExecKey.short() never reads self.{f} — the tag "
+                    "keys per-executor ledgers, so two resident keys "
+                    "differing only in this field would collide")
+    for a in model.short_attrs - key_fields:
+        finding(CACHE_PATH, model.line("short"), "short-dangling", a,
+                f"ExecKey.short() reads self.{a} which is not an ExecKey "
+                "field — dangling handling for a removed field")
+
+    # station 3: apply_key_policy considers every non-structural field
+    for f in model.exec_key_fields:
+        if f in STRUCTURAL_FIELDS:
+            continue
+        if f not in model.policy_attrs:
+            finding(EXECUTORS_PATH, model.line("policy"), "policy", f,
+                    f"apply_key_policy never references {f!r} — degraded "
+                    "keys carrying it would reach builders unchecked "
+                    "(force it, validate it, or raise "
+                    "DegradationInapplicableError)")
+    for a in model.policy_key_attrs - key_fields:
+        finding(EXECUTORS_PATH, model.line("policy"), "policy-dangling", a,
+                f"apply_key_policy reads key.{a} which is not an ExecKey "
+                "field — dangling handling for a removed field")
+
+    # station 4: _exec_key_for threads every constructor-visible field
+    for f in model.exec_key_fields:
+        if f in LADDER_ONLY_ALLOWLIST:
+            continue
+        if f not in model.key_call_kwargs:
+            finding(SERVER_PATH, model.line("key_for"), "key-for", f,
+                    f"_exec_key_for's ExecKey(...) call never passes "
+                    f"{f!r} — the ServeConfig knob would silently key "
+                    "every bucket at the dataclass default")
+    for a in model.key_call_kwargs - key_fields:
+        finding(SERVER_PATH, model.line("key_for"), "key-for-dangling", a,
+                f"_exec_key_for passes ExecKey kwarg {a!r} which is not "
+                "a field — dangling construction for a removed field")
+    for f, _why in LADDER_ONLY_ALLOWLIST.items():
+        if f not in key_fields:
+            finding(CACHE_PATH, 0, "ladder-allowlist-stale", f,
+                    f"ladder-only allowlist names {f!r} which is not an "
+                    "ExecKey field — remove the entry")
+    return findings
+
+
+def run(ctx: CheckContext) -> List[Finding]:
+    return check_model(build_model(ctx))

@@ -1,0 +1,226 @@
+"""Serve thread/lock discipline: guarded attributes mutate under their
+lock.
+
+The serve layer's thread model is deliberate and documented, not
+incidental: breaker/ladder VALUES are scheduler-thread-owned while map
+MEMBERSHIP is lock-guarded (serve/resilience.py `_keys_lock` comment),
+the executor cache's map and pin tables mutate only under `_lock` with a
+``*_locked`` caller-holds-lock suffix convention (serve/cache.py), the
+queue's items/closed/seq move under one lock shared with its condition,
+and snapshot()-class readers rely on mutations being serialized to get
+GIL-consistent copies.  A mutation that slips outside its lock corrupts
+exactly the state the health/metrics planes read from other threads —
+and reviews catch it only when someone remembers the rule.
+
+This checker encodes the rule as data: `GUARDED_REGISTRY` maps each
+audited class to its lock attribute and the attributes that lock guards
+(derived from the in-code docs).  The AST pass then asserts every
+mutation of a guarded attribute happens (a) lexically inside
+``with self.<lock>:``, (b) in ``__init__``/``__post_init__`` (the object
+is not yet shared), (c) in a method whose name ends ``_locked`` (the
+documented caller-holds-lock convention), or (d) in a per-class
+``owner_methods`` allowlist entry for scheduler-owned paths.
+
+Reads are deliberately NOT checked: the serve metrics contract
+explicitly blesses unlocked dict-copy reads (GIL snapshot semantics,
+resilience.py snapshot docs).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+from ..core import CheckContext, Finding
+
+NAME = "lock-discipline"
+DESCRIPTION = ("guarded serve-layer attributes mutate only under their "
+               "documented lock (registry-driven AST pass)")
+
+#: method names that mutate their receiver in place
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "discard",
+    "pop", "popitem", "popleft", "clear", "update", "setdefault", "add",
+    "move_to_end", "sort", "reverse",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Guard:
+    """One audited class: which lock guards which attributes."""
+
+    lock: str
+    attrs: FrozenSet[str]
+    #: methods allowed to mutate without the lock (single-owner paths,
+    #: each with the in-code doc that blesses it)
+    owner_methods: FrozenSet[str] = frozenset()
+
+
+def guard(lock: str, attrs: Sequence[str],
+          owner_methods: Sequence[str] = ()) -> Guard:
+    return Guard(lock=lock, attrs=frozenset(attrs),
+                 owner_methods=frozenset(owner_methods))
+
+
+#: (module relpath -> class name -> Guard), derived from the thread-model
+#: docs each class carries.  Growing the serve layer?  Register the new
+#: class here — an unregistered lock is an unchecked invariant.
+GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
+    "distrifuser_tpu/serve/cache.py": {
+        # "a lock still guards the map so stats reads ... are consistent"
+        # (module docstring); *_locked = caller-holds-lock convention
+        "ExecutorCache": guard(
+            "_lock",
+            ["_entries", "_pins", "_pin_refs", "_deferred", "hits",
+             "misses", "evictions", "deferred_evictions",
+             "build_seconds"],
+        ),
+    },
+    "distrifuser_tpu/serve/resilience.py": {
+        # "_keys_lock guards MAP membership only" (resilience.py §engine)
+        "ResilienceEngine": guard("_keys_lock", ["_keys"]),
+    },
+    "distrifuser_tpu/serve/queue.py": {
+        "RequestQueue": guard("_lock", ["_items", "_closed", "_seq"]),
+    },
+    "distrifuser_tpu/serve/controller.py": {
+        # observe_batch is documented any-thread; _classes/_service move
+        # under _lock so snapshot() copies are consistent
+        "SLOController": guard(
+            "_lock", ["_classes", "_service", "_service_sum"]),
+    },
+    "distrifuser_tpu/serve/promptcache.py": {
+        "PromptCache": guard("_lock", ["_entries", "_hits", "_misses"]),
+    },
+    "distrifuser_tpu/serve/fleet.py": {
+        # the parked list is mutated by submit failover, the housekeeping
+        # tick, and stop() — all under the router RLock
+        "FleetRouter": guard("_lock", ["_parked"]),
+    },
+}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'X' when node is ``self.X``, else ''."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _is_lock_ctx(item: ast.withitem, lock: str) -> bool:
+    return _self_attr(item.context_expr) == lock
+
+
+def scan_class(cls: ast.ClassDef, spec: Guard, relpath: str,
+               class_name: str = None) -> List[Finding]:
+    """Findings for unguarded mutations in one class (pure core)."""
+    class_name = class_name or cls.name
+    findings: List[Finding] = []
+    counts: Dict[Tuple[str, str], int] = {}
+
+    def report(method: str, attr: str, line: int, how: str):
+        idx = counts.get((method, attr), 0)
+        counts[(method, attr)] = idx + 1
+        findings.append(Finding(
+            checker=NAME, path=relpath, line=line,
+            message=(
+                f"{class_name}.{method} mutates self.{attr} ({how}) "
+                f"outside `with self.{spec.lock}:` — the thread-model "
+                f"docs guard it with {spec.lock}; take the lock, rename "
+                "the method *_locked if the caller holds it, or "
+                "register it as scheduler-owned with a doc pointer"),
+            identity=f"{class_name}.{method}:{attr}:{idx}",
+        ))
+
+    def walk(node: ast.AST, method: str, locked: bool):
+        # track lock scope lexically
+        if isinstance(node, ast.With):
+            now_locked = locked or any(
+                _is_lock_ctx(i, spec.lock) for i in node.items)
+            for child in ast.iter_child_nodes(node):
+                walk(child, method, now_locked)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs (worker closures) run on other threads: they
+            # start unlocked regardless of the enclosing with-block
+            for child in node.body:
+                walk(child, node.name, False)
+            return
+        if not locked:
+            exempt = (method in ("__init__", "__post_init__")
+                      or method.endswith("_locked")
+                      or method in spec.owner_methods)
+            if not exempt:
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr in spec.attrs:
+                            report(method, attr, node.lineno, "assign")
+                        if (isinstance(t, (ast.Subscript, ast.Starred))
+                                and _self_attr(getattr(t, "value", None))
+                                in spec.attrs):
+                            report(method,
+                                   _self_attr(t.value), node.lineno,
+                                   "item assign")
+                        if isinstance(t, ast.Tuple):
+                            for el in t.elts:
+                                attr = _self_attr(el)
+                                if attr in spec.attrs:
+                                    report(method, attr, node.lineno,
+                                           "tuple assign")
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        base = (t.value if isinstance(t, ast.Subscript)
+                                else t)
+                        attr = _self_attr(base)
+                        if attr in spec.attrs:
+                            report(method, attr, node.lineno, "del")
+                elif isinstance(node, ast.Call):
+                    fn = node.func
+                    if (isinstance(fn, ast.Attribute)
+                            and fn.attr in MUTATOR_METHODS):
+                        attr = _self_attr(fn.value)
+                        if attr in spec.attrs:
+                            report(method, attr, node.lineno,
+                                   f".{fn.attr}()")
+        for child in ast.iter_child_nodes(node):
+            walk(child, method, locked)
+
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in item.body:
+                walk(child, item.name, False)
+    return findings
+
+
+def run(ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath, classes in sorted(GUARDED_REGISTRY.items()):
+        if not ctx.exists(relpath):
+            findings.append(Finding(
+                checker=NAME, path=relpath, line=0,
+                message=(f"lock registry names {relpath} which no longer "
+                         "exists — move or drop the registry entry"),
+                identity=f"registry-missing:{relpath}"))
+            continue
+        tree = ctx.tree(relpath)
+        found = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name in classes:
+                found.add(node.name)
+                findings.extend(scan_class(node, classes[node.name],
+                                           relpath))
+        for missing in set(classes) - found:
+            findings.append(Finding(
+                checker=NAME, path=relpath, line=0,
+                message=(f"lock registry names class {missing} which no "
+                         f"longer exists in {relpath} — update the "
+                         "registry"),
+                identity=f"registry-missing:{relpath}:{missing}"))
+    return findings

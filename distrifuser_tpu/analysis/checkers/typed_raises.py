@@ -1,0 +1,87 @@
+"""Typed-error discipline: the serve layer never raises bare
+RuntimeError/Exception.
+
+Every failure a request can see is routed by TYPE (serve/errors.py):
+`RetryableError` drives the retry loop and breaker, `FatalError` fails
+the request terminally, `DegradationInapplicableError` retracts a ladder
+rung.  A bare ``raise RuntimeError(...)`` in a serve hot path is
+invisible to all of that — the breaker can't count it, the ladder can't
+react, and callers are reduced to string matching (exactly what the
+typed hierarchy exists to kill).
+
+Rule: inside ``distrifuser_tpu/serve/``, ``raise`` of a *generic*
+exception (`RuntimeError`, `Exception`, `BaseException`, `StandardError`)
+is a finding.  Validation raises (`ValueError`/`TypeError`/`KeyError`/
+`AssertionError`/`NotImplementedError`) stay legal everywhere — config
+`__post_init__` and argument checking are not dispatch-relevant — and
+typed subclasses are by definition not flagged (the AST sees the
+subclass name at the raise site).  Deliberate escapes (e.g. a contract
+violation that must BYPASS the typed retry routing) get their own named
+subclass instead: `errors.ExecutorContractError` exists for exactly
+that, staying outside the ServeError hierarchy on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..core import CheckContext, Finding, enclosing_qualname
+
+NAME = "typed-raises"
+DESCRIPTION = ("no bare RuntimeError/Exception raises in serve/* — the "
+               "breaker/ladder must see typed outcomes")
+
+GENERIC_EXCEPTIONS = frozenset({
+    "RuntimeError", "Exception", "BaseException", "StandardError",
+})
+
+SERVE_PREFIX = "distrifuser_tpu/serve/"
+
+
+def scan_module(tree: ast.Module, relpath: str) -> List[Finding]:
+    findings: List[Finding] = []
+    counts: Dict[Tuple[str, str], int] = {}
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST):
+        is_scope = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))
+        if is_scope:
+            stack.append(node)
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            name = None
+            exc = node.exc
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in GENERIC_EXCEPTIONS:
+                scope = enclosing_qualname(stack)
+                idx = counts.get((scope, name), 0)
+                counts[(scope, name)] = idx + 1
+                findings.append(Finding(
+                    checker=NAME, path=relpath, line=node.lineno,
+                    message=(
+                        f"bare `raise {name}` in {scope} — serve "
+                        "failures must be typed (serve/errors.py) so the "
+                        "breaker/ladder/fleet routing sees them; raise a "
+                        "ServeError subclass, or a named subclass like "
+                        "ExecutorContractError when the point is to "
+                        "bypass typed routing"),
+                    identity=f"{scope}:{name}:{idx}",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_scope:
+            stack.pop()
+
+    visit(tree)
+    return findings
+
+
+def run(ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py(SERVE_PREFIX.rstrip("/")):
+        findings.extend(scan_module(ctx.tree(rel), rel))
+    return findings

@@ -1,0 +1,268 @@
+"""Checker API: findings, fingerprints, and the provenance'd baseline.
+
+Design contract (mirrors the routing-table lint this generalizes):
+
+* a `Finding` is one violated invariant at one place, with a
+  **fingerprint** that is stable across unrelated edits — it hashes the
+  checker name, the repo-relative path, and an *identity* string the
+  checker chooses (enclosing qualname + violation kind + occurrence
+  index, never a line number), so inserting code above a suppressed
+  finding does not orphan its baseline entry;
+* the **baseline** is reviewable suppressions-as-data: each entry MUST be
+  preceded by a ``# provenance:`` line explaining why the violation is
+  deliberate.  An entry whose reason is missing (or still the
+  ``UNREVIEWED`` placeholder ``--write-baseline`` emits) fails the run —
+  a suppression nobody justified is debt pretending to be policy;
+* **stale** entries (fingerprint no longer emitted by any checker) fail
+  strict runs too: the baseline must shrink when the tree heals, or its
+  size stops meaning anything (scripts/analysis_report.py trends it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+#: marker ``--write-baseline`` stamps on machine-generated entries; the
+#: baseline validator rejects it so every suppression gets a human reason.
+UNREVIEWED = "UNREVIEWED"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated invariant at one location.
+
+    ``identity`` is the fingerprint material (checker-chosen, stable
+    across unrelated edits — no line numbers); it defaults to ``message``
+    for checkers whose messages are already stable.
+    """
+
+    checker: str
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based; 0 = module/whole-file finding
+    message: str
+    severity: str = "error"
+    identity: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got "
+                f"{self.severity!r}")
+
+    @property
+    def fingerprint(self) -> str:
+        material = self.identity or self.message
+        digest = hashlib.sha256(
+            f"{self.checker}|{self.path}|{material}".encode()
+        ).hexdigest()
+        return digest[:12]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return (f"{loc}: [{self.checker}/{self.severity}] {self.message} "
+                f"[{self.fingerprint}]")
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class BaselineError(ValueError):
+    """The baseline file itself violates its format contract (entry
+    without a provenance reason, unparseable line, UNREVIEWED reason)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    fingerprint: str
+    checker: str
+    path: str
+    note: str
+    reason: str
+    line: int  # line number in the baseline file (diagnostics only)
+
+
+@dataclasses.dataclass
+class Baseline:
+    """Parsed suppression file.  ``parse`` raises `BaselineError` on
+    format violations — a malformed baseline must fail the gate, not
+    silently suppress nothing (or everything)."""
+
+    entries: Tuple[BaselineEntry, ...] = ()
+    path: Optional[str] = None
+
+    @property
+    def fingerprints(self) -> Dict[str, BaselineEntry]:
+        return {e.fingerprint: e for e in self.entries}
+
+    @classmethod
+    def parse(cls, text: str, path: Optional[str] = None) -> "Baseline":
+        entries: List[BaselineEntry] = []
+        reason: Optional[str] = None
+        for lineno, raw in enumerate(text.splitlines(), start=1):
+            line = raw.strip()
+            if not line:
+                reason = None  # a blank line detaches a dangling reason
+                continue
+            if line.startswith("#"):
+                body = line.lstrip("#").strip()
+                if body.lower().startswith("provenance:"):
+                    reason = body[len("provenance:"):].strip()
+                continue
+            parts = line.split(None, 3)
+            if len(parts) < 3:
+                raise BaselineError(
+                    f"{path or 'baseline'}:{lineno}: unparseable entry "
+                    f"{line!r} (want: <fingerprint> <checker> <path> "
+                    "[note])")
+            fp, checker, relpath = parts[0], parts[1], parts[2]
+            note = parts[3] if len(parts) == 4 else ""
+            if not (len(fp) == 12 and all(c in "0123456789abcdef"
+                                          for c in fp)):
+                raise BaselineError(
+                    f"{path or 'baseline'}:{lineno}: malformed "
+                    f"fingerprint {fp!r}")
+            if reason is None:
+                raise BaselineError(
+                    f"{path or 'baseline'}:{lineno}: entry {fp} has no "
+                    "'# provenance:' reason line — every suppression "
+                    "must say why the violation is deliberate")
+            if UNREVIEWED in reason:
+                raise BaselineError(
+                    f"{path or 'baseline'}:{lineno}: entry {fp} still "
+                    f"carries the {UNREVIEWED} placeholder — replace it "
+                    "with a real reason or fix the finding")
+            entries.append(BaselineEntry(fp, checker, relpath, note,
+                                         reason, lineno))
+            reason = None
+        return cls(entries=tuple(entries), path=path)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(entries=(), path=path)
+        with open(path) as f:
+            return cls.parse(f.read(), path=path)
+
+
+@dataclasses.dataclass
+class BaselineResult:
+    new: List[Finding]
+    suppressed: List[Tuple[Finding, BaselineEntry]]
+    stale: List[BaselineEntry]
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Baseline,
+                   active_checkers: Optional[Sequence[str]] = None
+                   ) -> BaselineResult:
+    """Partition findings into (new, suppressed) and surface stale
+    baseline entries whose fingerprint nothing emitted this run.
+    ``active_checkers`` limits staleness to entries owned by checkers
+    that actually ran — a ``--checker`` subset must not misreport the
+    other checkers' suppressions as healed."""
+    by_fp = baseline.fingerprints
+    new: List[Finding] = []
+    suppressed: List[Tuple[Finding, BaselineEntry]] = []
+    seen_fps = set()
+    for f in findings:
+        entry = by_fp.get(f.fingerprint)
+        if entry is not None:
+            suppressed.append((f, entry))
+            seen_fps.add(f.fingerprint)
+        else:
+            new.append(f)
+    active = set(active_checkers) if active_checkers is not None else None
+    stale = [e for e in baseline.entries
+             if e.fingerprint not in seen_fps
+             and (active is None or e.checker in active)]
+    return BaselineResult(new=new, suppressed=suppressed, stale=stale)
+
+
+def render_baseline(findings: Sequence[Finding],
+                    previous: Optional[Baseline] = None,
+                    header: str = "") -> str:
+    """Baseline text covering ``findings``: entries already justified in
+    ``previous`` keep their reason; new ones get the UNREVIEWED
+    placeholder the validator rejects (forcing a human-written reason
+    before the suppression counts)."""
+    prev = previous.fingerprints if previous is not None else {}
+    out = [header.rstrip()] if header else []
+    for f in sorted(findings, key=lambda f: (f.path, f.checker,
+                                             f.fingerprint)):
+        old = prev.get(f.fingerprint)
+        reason = old.reason if old is not None else (
+            f"{UNREVIEWED} — justify this suppression or fix the finding")
+        out.append(f"# provenance: {reason}")
+        out.append(f"{f.fingerprint} {f.checker} {f.path} {f.message}")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+class CheckContext:
+    """What checkers get: the repo root, cached ASTs, and file listing.
+
+    Tests point this at fixture trees; the CLI points it at the real
+    repo (the directory containing the ``distrifuser_tpu`` package).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._ast_cache: Dict[str, ast.Module] = {}
+        self._src_cache: Dict[str, str] = {}
+
+    def abspath(self, relpath: str) -> str:
+        return os.path.join(self.root, relpath.replace("/", os.sep))
+
+    def exists(self, relpath: str) -> bool:
+        return os.path.exists(self.abspath(relpath))
+
+    def source(self, relpath: str) -> str:
+        if relpath not in self._src_cache:
+            with open(self.abspath(relpath)) as f:
+                self._src_cache[relpath] = f.read()
+        return self._src_cache[relpath]
+
+    def tree(self, relpath: str) -> ast.Module:
+        if relpath not in self._ast_cache:
+            self._ast_cache[relpath] = ast.parse(
+                self.source(relpath), filename=relpath)
+        return self._ast_cache[relpath]
+
+    def iter_py(self, subdir: str = "") -> Iterable[str]:
+        """Repo-relative paths of every .py file under ``subdir``
+        (sorted, posix separators), skipping this package itself —
+        checker fixtures embedded in docstrings must not self-flag."""
+        base = os.path.join(self.root, subdir.replace("/", os.sep))
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames.sort()
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith("distrifuser_tpu/analysis/"):
+                    continue
+                yield rel
+
+
+def enclosing_qualname(stack: Sequence[ast.AST]) -> str:
+    """Dotted name of the enclosing class/function scope, for stable
+    finding identities (``UNet.forward`` survives line-number churn)."""
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(names) if names else "<module>"

@@ -1,0 +1,67 @@
+"""Checker registry: the one list CI and tests run.
+
+Ordering is cheap-first so a syntax-level failure surfaces before the
+trace-based gate spends seconds building the tiny model.  Adding a
+checker = adding a module under analysis/checkers/ with ``NAME``,
+``DESCRIPTION``, ``run(ctx)`` and listing it here (docs/ANALYSIS.md
+walks through it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .core import CheckContext, Finding
+
+
+def all_checkers() -> List[object]:
+    from .checkers import (
+        collective_containment,
+        compile_identity,
+        lock_discipline,
+        overlap_gate,
+        route_tables,
+        typed_raises,
+    )
+
+    return [
+        typed_raises,
+        collective_containment,
+        lock_discipline,
+        compile_identity,
+        route_tables,
+        overlap_gate,
+    ]
+
+
+def get_checker(name: str):
+    for c in all_checkers():
+        if c.NAME == name:
+            return c
+    raise KeyError(
+        f"unknown checker {name!r}; have "
+        f"{[c.NAME for c in all_checkers()]}")
+
+
+def run_checkers(ctx: CheckContext,
+                 names: Optional[Sequence[str]] = None
+                 ) -> Dict[str, List[Finding]]:
+    """Run the (selected) checkers; a checker CRASH becomes an error
+    finding rather than aborting the run — a broken gate must fail
+    loudly, not skip silently."""
+    checkers = (all_checkers() if not names
+                else [get_checker(n) for n in names])
+    results: Dict[str, List[Finding]] = {}
+    for checker in checkers:
+        try:
+            results[checker.NAME] = list(checker.run(ctx))
+        except Exception as exc:  # noqa: BLE001 — surfaced as a finding
+            results[checker.NAME] = [Finding(
+                checker=checker.NAME, path="distrifuser_tpu/analysis",
+                line=0,
+                message=(f"checker crashed: {type(exc).__name__}: {exc} "
+                         "— a crashed gate fails the run, it never "
+                         "skips"),
+                identity="checker-crash",
+            )]
+    return results

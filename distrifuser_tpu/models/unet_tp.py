@@ -46,6 +46,7 @@ from ..ops.conv import conv2d
 from ..ops.linear import linear
 from ..ops.normalization import group_norm
 from ..ops.attention import sdpa
+from ..parallel.collectives import psum
 from ..utils.config import SP_AXIS
 from .unet import UNetConfig, silu
 
@@ -201,7 +202,7 @@ def tp_attention(p, x, *, head_dim: int, axis: str = SP_AXIS,
     local_heads = q.shape[-1] // head_dim
     out = sdpa(q, k, v, heads=local_heads)
     out = out @ p["to_out"]["kernel"]  # no bias before reduce
-    out = lax.psum(out, axis)
+    out = psum(out, axis)
     return out + p["to_out"]["bias"]
 
 
@@ -213,7 +214,7 @@ def tp_feed_forward(p, x, *, axis: str = SP_AXIS):
     a, g = h[:, 0], h[:, 1]
     act = a * jax.nn.gelu(g, approximate=False)
     y = act @ p["net_2"]["kernel"]
-    y = lax.psum(y, axis)
+    y = psum(y, axis)
     return y + p["net_2"]["bias"]
 
 
@@ -230,7 +231,7 @@ def tp_resnet(p, x, temb, *, groups: int, n: int, axis: str = SP_AXIS):
         h, p["conv2"]["kernel"], (1, 1), ((1, 1), (1, 1)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
-    y = lax.psum(y, axis) + p["conv2"]["bias"]
+    y = psum(y, axis) + p["conv2"]["bias"]
     if "conv_shortcut" in p:
         x = conv2d(p["conv_shortcut"], x)
     return x + y
@@ -247,7 +248,7 @@ def tp_conv(p, x, *, stride: int = 1, axis: str = SP_AXIS, n: int = 1):
         x_local, p["kernel"], (stride, stride), ((pad, pad), (pad, pad)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
-    y = lax.psum(y, axis)
+    y = psum(y, axis)
     if "bias" in p:
         y = y + p["bias"]
     return y

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import sdpa
+from ..parallel.collectives import psum_mean
 from ..ops.conv import _conv_valid_h, conv2d
 from ..ops.linear import linear
 from ..ops.normalization import _local_moments, group_norm
@@ -166,7 +167,7 @@ def _group_norm_sp(p, x, n, axis, *, groups, eps):
     if n == 1:
         return group_norm(p, x, groups=groups, eps=eps)
     b, h, w, c = x.shape
-    m = lax.pmean(_local_moments(x, groups), axis)  # [2, B, G], equal shards
+    m = psum_mean(_local_moments(x, groups), axis)  # [2, B, G], equal shards
     # clamp: E[x^2]-E[x]^2 can go slightly negative from fp32 cancellation
     # (the dense path's two-pass formula is non-negative by construction)
     mean, var = m[0], jnp.maximum(m[1] - jnp.square(m[0]), 0.0)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.collectives import all_gather
 from ..parallel.context import PatchContext
 from .linear import linear
 from .sdpa_routing import Route, lookup
@@ -319,7 +320,7 @@ def patch_self_attention(p, x, ctx: PatchContext, name: str, *, heads: int):
     if ctx.n == 1:
         full_kv = kv
     elif ctx.is_sync:
-        gathered = lax.all_gather(kv, ctx.axis)  # [n, B, L, 2C]
+        gathered = all_gather(kv, ctx.axis)  # [n, B, L, 2C]
         ctx.emit(name, gathered, kind="attn")
         full_kv = _flatten_seq(gathered)
     else:

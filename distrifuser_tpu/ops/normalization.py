@@ -25,6 +25,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel.collectives import all_gather, psum_mean
 from ..parallel.context import PatchContext
 
 
@@ -80,7 +81,7 @@ def patch_group_norm(
     if ctx.mode in ("stale_gn", "corrected_async_gn"):
         m = _local_moments(x, groups)  # [2, B, G]
         if ctx.is_sync:
-            gathered = lax.all_gather(m, ctx.axis)  # [n, 2, B, G]
+            gathered = all_gather(m, ctx.axis)  # [n, 2, B, G]
             full = gathered.mean(axis=0)
             ctx.emit(name, gathered, kind="gn")
         else:
@@ -104,7 +105,7 @@ def patch_group_norm(
         # Blocking all_reduce of moments every step (groupnorm.py:74-91);
         # also the warmup path for separate_gn / no_sync.
         m = _local_moments(x, groups)
-        full = lax.pmean(m, ctx.axis)
+        full = psum_mean(m, ctx.axis)
         var = full[1] - jnp.square(full[0])
         return _normalize(p, x, full[0], var, groups=groups, eps=eps, bessel_ne=ne)
 

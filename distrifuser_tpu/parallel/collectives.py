@@ -38,6 +38,18 @@ def psum_mean(x, axis: str = SP_AXIS):
     return lax.pmean(x, axis)
 
 
+def psum(x, axis: str = SP_AXIS):
+    """Sum over the axis (reference all_reduce(SUM), tp/attention.py:159).
+    The tensor-parallel partial-sum reduce: every TP matmul/conv shard
+    contributes its local partial and reads back the full activation —
+    per-layer, synchronous, the defining cost of the TP layout (the
+    reason displaced patches win at small world sizes, SURVEY.md §2.6).
+    Routed through here so distrilint's collective-containment checker
+    keeps every raw `lax` collective inside the accounted helper
+    surface."""
+    return lax.psum(x, axis)
+
+
 def ring_perm(n: int):
     """Wrapping next-neighbor permutation along a ring axis: device i
     sends to i+1 mod n.  Single source of truth for the ring-attention

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.config import CFG_AXIS, DistriConfig
+from .collectives import all_gather
 
 
 def branch_select(cfg: DistriConfig, enc, added=None):
@@ -55,7 +56,7 @@ def combine_guidance(cfg: DistriConfig, out, gs, batch):
     ``u + gs * (c - u)`` with branches gathered over the cfg axis
     (cfg_split), unfolded from the batch dim (folded), or passed through."""
     if cfg.cfg_split:
-        both = lax.all_gather(out, CFG_AXIS)  # [2, B, ...]
+        both = all_gather(out, CFG_AXIS)  # [2, B, ...]
         u, c = both[0], both[1]
         return u + gs * (c - u)
     if cfg.do_classifier_free_guidance:

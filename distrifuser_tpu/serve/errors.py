@@ -114,6 +114,26 @@ class ResourceExhaustedError(ExecuteFailedError):
     trigger for the graceful-degradation ladder."""
 
 
+class LifecycleError(FatalError):
+    """An operator-API call violated a lifecycle state machine: starting
+    an already-started fleet, restarting a replica from a state with no
+    such transition, an illegal replica state-machine edge.  Fatal for
+    the *call* (retrying the same transition verbatim cannot succeed),
+    and still a RuntimeError via ServeError, so pre-existing operator
+    code catching RuntimeError keeps working."""
+
+
+class ExecutorContractError(RuntimeError):
+    """An executor broke its batching contract (e.g. returned N outputs
+    for a batch of M).  Deliberately NOT a ServeError: the typed
+    retry/breaker routing must not see it — a contract violation is a
+    bug, not a transient fault, so it bubbles past the retry loop to the
+    scheduler-loop guard, which fails the batch and counts a
+    scheduler_error.  The name (rather than a bare RuntimeError) keeps
+    the escape auditable: distrilint's typed-raises checker flags bare
+    generic raises in serve/*."""
+
+
 # -- fatal -------------------------------------------------------------------
 
 

@@ -64,6 +64,7 @@ from ..utils.metrics import MetricsRegistry
 from .errors import (
     DeadlineExceededError,
     FatalError,
+    LifecycleError,
     NoHealthyReplicaError,
     RetryableError,
     ServerClosedError,
@@ -203,7 +204,7 @@ class FleetRouter:
             # a typed raise, not an assert: under ``python -O`` an assert
             # vanishes and a double start would "clean up" (stop) the
             # healthy serving replicas on its own error path
-            raise RuntimeError("fleet already started")
+            raise LifecycleError("fleet already started")
         if self._stopped:
             raise ServerClosedError(
                 "this fleet was stopped; build a new FleetRouter")
@@ -232,7 +233,7 @@ class FleetRouter:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             name, exc = errors[0]
-            raise RuntimeError(
+            raise LifecycleError(
                 f"replica {name} failed to start; the fleet was not "
                 "brought up (already-started replicas were stopped)"
             ) from exc

@@ -55,7 +55,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.config import ServeConfig
-from .errors import ServerClosedError
+from .errors import LifecycleError, ServerClosedError
 from .faults import FaultPlan, InjectedReplicaKilled
 from .server import InferenceServer
 
@@ -186,7 +186,7 @@ class Replica:
         with self._lock:
             frm = self._state
             if to not in _TRANSITIONS[frm]:
-                raise RuntimeError(
+                raise LifecycleError(
                     f"replica {self.name}: illegal lifecycle transition "
                     f"{frm} -> {to}"
                 )
@@ -211,7 +211,7 @@ class Replica:
         mid-warm wins — the freshly built server is discarded."""
         with self._lock:
             if self._state not in (REPLICA_STARTING, REPLICA_STOPPED):
-                raise RuntimeError(
+                raise LifecycleError(
                     f"replica {self.name} cannot start from {self._state}"
                 )
             self._transition(REPLICA_WARMING)

@@ -60,6 +60,7 @@ from .errors import (
     DeadlineExceededError,
     DegradationInapplicableError,
     ExecuteFailedError,
+    ExecutorContractError,
     FatalError,
     NoBucketError,
     QueueFullError,
@@ -862,10 +863,11 @@ class InferenceServer:
             ) from exc
         t1 = self.clock()
         if len(outputs) != len(batch):
-            # contract violation, NOT a transient fault: bubbles past the
-            # retry loop to the _loop guard, which fails the batch and
-            # counts a scheduler_error
-            raise RuntimeError(
+            # contract violation, NOT a transient fault: the typed escape
+            # (outside the ServeError hierarchy, serve/errors.py) bubbles
+            # past the retry loop to the _loop guard, which fails the
+            # batch and counts a scheduler_error
+            raise ExecutorContractError(
                 f"executor returned {len(outputs)} outputs for a batch of "
                 f"{len(batch)}"
             )

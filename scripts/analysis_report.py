@@ -1,0 +1,110 @@
+"""One schema-1 JSON summary line for the distrilint run.
+
+The bench-line convention (scripts/common.py emit_bench_line) applied to
+static analysis: findings by checker and severity, baseline size, and
+stale-entry count, so the trajectory of suppressed debt is trackable
+across PRs exactly like steps/sec and wire bytes are.  A shrinking
+``baseline_size`` is paid-down debt; a growing one is a review flag.
+
+Exit code mirrors the gate (``--gate``): nonzero when the strict run
+would fail (new findings or stale baseline entries), so the report can
+double as the CI step where wiring two commands is awkward.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from common import emit_bench_line  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None,
+                        help="also append the JSON line to this file")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit 1 when the strict gate would fail "
+                        "(new findings or stale baseline entries)")
+    parser.add_argument("--from-json", default=None, metavar="PATH",
+                        help="summarize an existing `--json` report from "
+                        "`python -m distrifuser_tpu.analysis` instead of "
+                        "re-running the checkers (what CI does — the "
+                        "jaxpr traces are not free)")
+    args = parser.parse_args()
+
+    if args.from_json:
+        import json
+
+        with open(args.from_json) as f:
+            report = json.load(f)
+        by_severity = {}
+        for f_ in (report.get("findings", [])
+                   + report.get("suppressed_findings", [])):
+            sev = f_.get("severity", "error")
+            by_severity[sev] = by_severity.get(sev, 0) + 1
+        emit_bench_line({
+            "bench": "analysis",
+            "findings_total": (report["new"] + report["suppressed"]),
+            "findings_new": report["new"],
+            "findings_suppressed": report["suppressed"],
+            "by_checker": report["by_checker"],
+            "by_severity": by_severity,
+            "baseline_size": report["baseline_size"],
+            "stale_baseline": report["stale_baseline"],
+            "clean": not report["new"] and not report["stale_baseline"],
+        }, out=args.out)
+        if args.gate and (report["new"] or report["stale_baseline"]):
+            return 1
+        return 0
+
+    # the analysis CLI's device bootstrap, then the framework directly
+    from distrifuser_tpu.analysis.__main__ import (
+        _ensure_fake_devices,
+        _repo_root,
+        default_baseline_path,
+    )
+
+    _ensure_fake_devices()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distrifuser_tpu.analysis import (
+        Baseline,
+        CheckContext,
+        apply_baseline,
+        run_checkers,
+    )
+
+    root = _repo_root()
+    results = run_checkers(CheckContext(root))
+    findings = [f for fs in results.values() for f in fs]
+    baseline = Baseline.load(default_baseline_path(root))
+    applied = apply_baseline(findings, baseline)
+
+    by_severity = {}
+    for f in findings:
+        by_severity[f.severity] = by_severity.get(f.severity, 0) + 1
+    emit_bench_line({
+        "bench": "analysis",
+        "findings_total": len(findings),
+        "findings_new": len(applied.new),
+        "findings_suppressed": len(applied.suppressed),
+        "by_checker": {name: len(fs) for name, fs in sorted(
+            results.items())},
+        "by_severity": by_severity,
+        "baseline_size": len(baseline.entries),
+        "stale_baseline": len(applied.stale),
+        "clean": not applied.new and not applied.stale,
+    }, out=args.out)
+    if args.gate and (applied.new or applied.stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,112 +1,27 @@
-"""Lint the checked-in measured routing tables (sdpa + gemm).
+"""Lint the checked-in measured routing tables (sdpa + gemm) — shim.
 
-The routing tables are DATA committed as code — regenerated by
-scripts/update_sdpa_table.py / update_gemm_table.py from chip-campaign
-logs — so a hand-edit that drops the provenance line, desyncs it from the
-comment, or malforms a key would silently turn "reviewable measurement"
-into "unexplained magic constant".  This check enforces the format
-contract; the tier-1 workflow runs it, and tests/test_routing_tables.py
-runs the same function under pytest.
-
-Checks, per table:
-  * the module imports (tables parse);
-  * MEASURED_PROVENANCE is a non-empty string AND matches the
-    ``# provenance:`` comment line inside the generated block;
-  * every MEASURED_ROUTES key/value is well-formed for its table
-    (sdpa: (head_dim int, log2-bucket int) -> Route with a known impl;
-    gemm: (mode str, log2-bucket int) -> GemmRoute with a known impl,
-    plus a declared MEASURED_BACKEND string when routes exist).
+The checks live in the distrilint framework now
+(distrifuser_tpu/analysis/checkers/route_tables.py, one of the six
+checkers `python -m distrifuser_tpu.analysis --strict` runs in tier-1);
+this script remains as the thin historical entry point so existing
+workflows and tests/test_routing_tables.py keep one behavior:
+``check_tables()`` returns human-readable problem strings (empty =
+clean) and ``main()`` exits nonzero on any problem.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _block_provenance(path: str, begin: str, end: str) -> str:
-    src = open(path).read()
-    m = re.search(re.escape(begin) + r"(.*?)" + re.escape(end), src,
-                  flags=re.DOTALL)
-    if not m:
-        raise AssertionError(f"{path}: generated block markers missing")
-    p = re.search(r"^# provenance: (.+)$", m.group(1), flags=re.MULTILINE)
-    if not p:
-        raise AssertionError(f"{path}: no '# provenance:' line in the "
-                             "generated block")
-    return p.group(1).strip()
-
-
 def check_tables() -> list:
     """Returns a list of human-readable findings (empty = clean)."""
-    problems = []
+    from distrifuser_tpu.analysis.checkers import route_tables
 
-    from distrifuser_tpu.ops import gemm_routing, sdpa_routing
-
-    # --- sdpa ---
-    sdpa_path = sdpa_routing.__file__
-    try:
-        comment = _block_provenance(
-            sdpa_path,
-            "# --- BEGIN MEASURED_ROUTES",
-            "# --- END MEASURED_ROUTES ---")
-    except AssertionError as e:
-        problems.append(str(e))
-        comment = None
-    prov = getattr(sdpa_routing, "MEASURED_PROVENANCE", None)
-    if not (isinstance(prov, str) and prov.strip()):
-        problems.append("sdpa_routing.MEASURED_PROVENANCE missing/empty")
-    elif comment is not None and comment != prov:
-        problems.append(
-            f"sdpa provenance comment {comment!r} != MEASURED_PROVENANCE "
-            f"{prov!r}")
-    for key, route in sdpa_routing.MEASURED_ROUTES.items():
-        if not (isinstance(key, tuple) and len(key) == 2
-                and all(isinstance(x, int) for x in key)):
-            problems.append(f"sdpa route key malformed: {key!r}")
-        if not isinstance(route, sdpa_routing.Route) or route.impl not in (
-                "xla", "inrepo", "upstream"):
-            problems.append(f"sdpa route value malformed: {key!r} -> "
-                            f"{route!r}")
-
-    # --- gemm ---
-    gemm_path = gemm_routing.__file__
-    try:
-        comment = _block_provenance(
-            gemm_path,
-            "# --- BEGIN MEASURED_GEMM_ROUTES",
-            "# --- END MEASURED_GEMM_ROUTES ---")
-    except AssertionError as e:
-        problems.append(str(e))
-        comment = None
-    prov = getattr(gemm_routing, "MEASURED_PROVENANCE", None)
-    if not (isinstance(prov, str) and prov.strip()):
-        problems.append("gemm_routing.MEASURED_PROVENANCE missing/empty")
-    elif comment is not None and comment != prov:
-        problems.append(
-            f"gemm provenance comment {comment!r} != MEASURED_PROVENANCE "
-            f"{prov!r}")
-    backend = getattr(gemm_routing, "MEASURED_BACKEND", None)
-    if not isinstance(backend, str):
-        problems.append("gemm_routing.MEASURED_BACKEND missing")
-    if gemm_routing.MEASURED_ROUTES and not backend:
-        problems.append(
-            "gemm table has routes but no MEASURED_BACKEND — unscoped "
-            "measurements would govern every platform")
-    for key, route in gemm_routing.MEASURED_ROUTES.items():
-        if not (isinstance(key, tuple) and len(key) == 2
-                and isinstance(key[0], str)
-                and key[0] in ("int8", "fp8")
-                and isinstance(key[1], int)):
-            problems.append(f"gemm route key malformed: {key!r}")
-        if not isinstance(route, gemm_routing.GemmRoute) or (
-                route.impl not in gemm_routing.GEMM_IMPLS):
-            problems.append(f"gemm route value malformed: {key!r} -> "
-                            f"{route!r}")
-    return problems
+    return [f.message for f in route_tables.check_tables()]
 
 
 def main() -> int:
