@@ -1,0 +1,131 @@
+"""Sync containment: primitives only come from the instrumentable layer.
+
+distrisched (analysis/concurrency/) can only explore interleavings it
+can SEE: its deterministic scheduler interposes at the sync points of
+primitives constructed through utils/sync.py.  A raw
+``threading.Lock()`` (or ``queue.Queue()``) dropped into a serve module
+is invisible to the harness — its waits neither yield to the seeded
+scheduler nor carry vector clocks, so schedules silently stop covering
+the code around it and the race/deadlock gate keeps passing while
+blind.  This is the dynamic-analysis analog of collective-containment's
+"bytes only move where accounting sees".
+
+This checker confines raw constructor calls for
+``threading.{Lock,RLock,Condition,Event,Semaphore,BoundedSemaphore,
+Barrier,Thread,Timer}`` and ``queue.{Queue,LifoQueue,PriorityQueue,
+SimpleQueue}`` to ``utils/sync.py`` (the passthrough layer itself).
+Everything else under ``distrifuser_tpu/`` calls the sync factories, or
+carries a baseline entry whose provenance names why harness coverage is
+not needed there (same workflow as collective-containment).  Aliased
+imports (``import threading as t``, ``from threading import Lock``) are
+resolved, not pattern-matched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Tuple
+
+from ..core import CheckContext, Finding, enclosing_qualname
+
+NAME = "sync-containment"
+DESCRIPTION = ("raw threading/queue primitive constructors confined to "
+               "utils/sync.py so distrisched's scheduler sees every "
+               "sync point")
+
+#: constructor names hunted, per module
+SYNC_CTORS = {
+    "threading": frozenset({
+        "Lock", "RLock", "Condition", "Event", "Semaphore",
+        "BoundedSemaphore", "Barrier", "Thread", "Timer",
+    }),
+    "queue": frozenset({
+        "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    }),
+}
+
+#: the passthrough layer itself — raw constructors ARE its job
+BLESSED_MODULES = frozenset({
+    "distrifuser_tpu/utils/sync.py",
+})
+
+
+def _ctor_bindings(tree: ast.Module) -> Tuple[Dict[str, str],
+                                              Dict[str, Tuple[str, str]]]:
+    """(module-alias -> module, direct-name -> (module, ctor)) for the
+    hunted modules, resolving ``import x as y`` and ``from x import C``."""
+    mod_alias: Dict[str, str] = {}
+    direct: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in SYNC_CTORS:
+                    mod_alias[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in SYNC_CTORS:
+                for a in node.names:
+                    if a.name in SYNC_CTORS[node.module]:
+                        direct[a.asname or a.name] = (node.module, a.name)
+    return mod_alias, direct
+
+
+def scan_module(tree: ast.Module, relpath: str,
+                blessed: Sequence[str] = ()) -> List[Finding]:
+    """Findings for raw sync constructors in one module (pure core —
+    tests feed fixture sources directly)."""
+    blessed = set(blessed) | BLESSED_MODULES
+    if relpath in blessed:
+        return []
+    mod_alias, direct = _ctor_bindings(tree)
+    if not mod_alias and not direct:
+        return []
+    findings: List[Finding] = []
+    counts: Dict[Tuple[str, str], int] = {}
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST):
+        is_scope = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef))
+        if is_scope:
+            stack.append(node)
+        if isinstance(node, ast.Call):
+            hit = None  # (module, ctor)
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in mod_alias):
+                module = mod_alias[fn.value.id]
+                if fn.attr in SYNC_CTORS[module]:
+                    hit = (module, fn.attr)
+            elif isinstance(fn, ast.Name) and fn.id in direct:
+                hit = direct[fn.id]
+            if hit is not None:
+                module, ctor = hit
+                scope = enclosing_qualname(stack)
+                idx = counts.get((scope, ctor), 0)
+                counts[(scope, ctor)] = idx + 1
+                findings.append(Finding(
+                    checker=NAME, path=relpath, line=node.lineno,
+                    message=(
+                        f"raw {module}.{ctor}() in {scope} — construct "
+                        "it via utils/sync.py so distrisched's "
+                        "deterministic scheduler sees its sync points "
+                        "(a raw primitive is a blind spot in the "
+                        "race/deadlock gate); or baseline it naming why "
+                        "harness coverage is not needed"),
+                    identity=f"{scope}:{module}.{ctor}:{idx}",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_scope:
+            stack.pop()
+
+    visit(tree)
+    return findings
+
+
+def run(ctx: CheckContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py("distrifuser_tpu"):
+        findings.extend(scan_module(ctx.tree(rel), rel))
+    return findings

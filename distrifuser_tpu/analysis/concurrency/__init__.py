@@ -1,0 +1,37 @@
+"""distrisched: deterministic schedule exploration for the serve plane.
+
+The dynamic half of the correctness tooling distrilint started (PR 13):
+serve scenarios run on seeded virtual schedules (sched.py), a
+vector-clock happens-before detector and a lock-order graph watch every
+sync point and instrumented attribute write (races.py, harness.py), and
+what they find flows through the same Finding/fingerprint/baseline
+pipeline as the static checkers.  ``python -m
+distrifuser_tpu.analysis.concurrency`` is the gate; docs/ANALYSIS.md
+"Concurrency analysis" is the walkthrough.
+"""
+
+from .harness import (  # noqa: F401
+    CHECKER_NAMES,
+    DEADLOCK,
+    DRIFT,
+    RACE,
+    ExplorationResult,
+    Failure,
+    ScenarioContext,
+    ScheduleResult,
+    explore,
+    run_schedule,
+    synthesize_findings,
+)
+from .races import (  # noqa: F401
+    LockOrderGraph,
+    RaceDetector,
+    RaceReport,
+    WriteOriginRecorder,
+)
+from .sched import (  # noqa: F401
+    DeterministicRuntime,
+    ScheduleAbort,
+    SchedulerError,
+)
+from .scenarios import SCENARIOS  # noqa: F401

@@ -1,0 +1,150 @@
+"""``python -m distrifuser_tpu.analysis.concurrency`` — the distrisched
+gate: explore N seeded schedules per serve scenario, report race /
+deadlock / registry-drift findings through the distrilint baseline, and
+fail on scenario invariant violations (which replay bit-identically
+from the printed seed).
+
+Exit codes mirror the static gate:
+  0  clean (or only baselined findings; non-strict tolerates stale)
+  1  non-baselined findings, scenario failures, stale entries (--strict),
+     or a malformed baseline
+  2  usage errors
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distrifuser_tpu.analysis.concurrency",
+        description="distrisched: deterministic schedule exploration "
+                    "with happens-before race and deadlock detection "
+                    "(docs/ANALYSIS.md)")
+    parser.add_argument("--schedules", type=int, default=50,
+                        help="seeded schedules per scenario (seeds "
+                        "0..N-1; default 50 — the CI gate's 250 total)")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="replay exactly ONE seed per scenario "
+                        "(failure reproduction) instead of the range")
+    parser.add_argument("--scenario", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this scenario (repeatable)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and exit")
+    parser.add_argument("--strict", action="store_true",
+                        help="fail on stale baseline entries too (the "
+                        "CI gate mode; run the full default scenario x "
+                        "seed set or staleness is meaningless)")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the findings/exploration report")
+    parser.add_argument("--baseline", metavar="PATH", default=None,
+                        help="baseline file (default: the shared "
+                        "distrifuser_tpu/analysis/baseline.txt)")
+    parser.add_argument("--trace-dir", metavar="DIR", default=None,
+                        help="write failing schedules' traces here "
+                        "(one file per failure, named scenario_seed)")
+    parser.add_argument("--print-trace", action="store_true",
+                        help="dump each failing schedule trace to "
+                        "stderr as well")
+    parser.add_argument("--max-steps", type=int, default=60000)
+    args = parser.parse_args(argv)
+
+    from ..core import Baseline, BaselineError, apply_baseline
+    from ..__main__ import _repo_root, default_baseline_path
+    from . import CHECKER_NAMES, SCENARIOS, explore
+
+    if args.list:
+        for name, fn in SCENARIOS.items():
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{name:28s} {doc[0] if doc else ''}")
+        return 0
+
+    names = args.scenario or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s) {unknown}; have {list(SCENARIOS)}",
+              file=sys.stderr)
+        return 2
+    scenarios = {n: SCENARIOS[n] for n in names}
+    seeds = ([args.seed] if args.seed is not None
+             else list(range(args.schedules)))
+
+    result = explore(scenarios, seeds, max_steps=args.max_steps)
+
+    baseline_path = args.baseline or default_baseline_path(_repo_root())
+    try:
+        baseline = Baseline.load(baseline_path)
+    except BaselineError as exc:
+        print(f"BASELINE INVALID: {exc}", file=sys.stderr)
+        return 1
+    applied = apply_baseline(result.findings, baseline,
+                             active_checkers=list(CHECKER_NAMES))
+
+    for f in sorted(applied.new, key=lambda f: (f.checker, f.path)):
+        print(f.render(), file=sys.stderr)
+    for e in applied.stale:
+        print(f"STALE BASELINE ENTRY {e.fingerprint} ({e.checker} "
+              f"{e.path}): no explored schedule emits this fingerprint "
+              f"any more — remove it from {baseline_path}",
+              file=sys.stderr)
+    for fail in result.failures:
+        print(f"SCENARIO FAILURE {fail.scenario} --seed {fail.seed}: "
+              f"{fail.error}", file=sys.stderr)
+        if args.trace_dir:
+            os.makedirs(args.trace_dir, exist_ok=True)
+            path = os.path.join(args.trace_dir,
+                                f"{fail.scenario}_{fail.seed}.trace")
+            with open(path, "w") as fh:
+                fh.write(fail.trace)
+            print(f"  schedule trace: {path}", file=sys.stderr)
+        if args.print_trace:
+            print(fail.trace, file=sys.stderr)
+
+    counts = result.counts()
+    summary = {
+        "schema": 1,
+        "schedules_explored": result.schedules_explored,
+        "per_scenario": result.per_scenario,
+        "races": counts["concurrency-race"],
+        "deadlocks": counts["concurrency-deadlock"],
+        "guard_registry_drift": counts["guard-registry-drift"],
+        "new": len(applied.new),
+        "suppressed": len(applied.suppressed),
+        "stale_baseline": len(applied.stale),
+        "failures": len(result.failures),
+    }
+    if args.json:
+        report = dict(summary)
+        report["findings"] = [f.to_json() for f in applied.new]
+        report["suppressed_findings"] = [
+            {**f.to_json(), "provenance": e.reason}
+            for f, e in applied.suppressed
+        ]
+        report["failure_list"] = [
+            {"scenario": f.scenario, "seed": f.seed, "error": f.error}
+            for f in result.failures
+        ]
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+
+    failed = bool(applied.new) or bool(result.failures) or (
+        args.strict and bool(applied.stale))
+    status = "FAIL" if failed else "ok"
+    print(f"distrisched {status}: {result.schedules_explored} schedules "
+          f"across {len(result.per_scenario)} scenarios — "
+          f"{counts['concurrency-race']} races, "
+          f"{counts['concurrency-deadlock']} deadlocks, "
+          f"{counts['guard-registry-drift']} drift "
+          f"({len(applied.new)} new, {len(applied.suppressed)} "
+          f"suppressed, {len(applied.stale)} stale), "
+          f"{len(result.failures)} scenario failures")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

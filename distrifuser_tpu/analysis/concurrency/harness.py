@@ -1,0 +1,478 @@
+"""distrisched harness: run serve scenarios under the deterministic
+scheduler and turn what the detectors saw into distrilint findings.
+
+`run_schedule(scenario, seed)` is the unit of exploration: it installs
+the seeded runtime into utils.sync, patches ``time.monotonic``/
+``time.sleep`` to virtual time and `concurrent.futures.Future` so
+resolve->callback hand-offs carry vector clocks, instruments every
+serve/utils class's ``__setattr__`` so cross-thread attribute writes
+feed the race detector and the drift recorder, runs the scenario, and
+drains every thread it spawned.  Everything is restored in ``finally``
+— a harness run leaves the process exactly as it found it.
+
+`explore(...)` fans one scenario across N seeds (or several scenarios
+across a seed range), merges the per-schedule evidence, and emits three
+checkers' worth of `Finding`s through the ordinary baseline pipeline:
+
+* ``concurrency-race`` — unordered write/write (and, in fixture mode,
+  read/write) access pairs on one attribute, per vector-clock
+  happens-before;
+* ``concurrency-deadlock`` — a concretely wedged schedule (with its
+  wait-for cycle and replay seed), or a lock-order cycle accumulated
+  across schedules (AB/BA seen from the lucky interleavings);
+* ``guard-registry-drift`` — attributes observed written from >= 2
+  threads on one object whose class/attr is absent from the static
+  checker's GUARDED_REGISTRY: dynamic evidence of the static pass's
+  blind spot.
+
+Scenario invariant violations (assertion failures, unexpected thread
+exceptions, step-budget exhaustion) are NOT findings — they are
+failures, reported with the seed that reproduces them bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import os
+import time as _time_mod
+from concurrent import futures as _futures_mod
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ...utils import sync
+from ..core import Finding
+from .races import LockOrderGraph, WriteOriginRecorder, strip_instance
+from .sched import DeterministicRuntime, ScheduleAbort
+
+#: modules whose classes get write instrumentation during a harness run
+#: (every class defined in them; exceptions excluded).  This is the
+#: serve control plane plus the utils classes it shares across threads.
+OBSERVED_MODULES = (
+    "distrifuser_tpu.serve.queue",
+    "distrifuser_tpu.serve.server",
+    "distrifuser_tpu.serve.fleet",
+    "distrifuser_tpu.serve.replica",
+    "distrifuser_tpu.serve.staging",
+    "distrifuser_tpu.serve.resilience",
+    "distrifuser_tpu.serve.cache",
+    "distrifuser_tpu.serve.controller",
+    "distrifuser_tpu.serve.promptcache",
+    "distrifuser_tpu.serve.batcher",
+    "distrifuser_tpu.serve.faults",
+    "distrifuser_tpu.serve.testing",
+    "distrifuser_tpu.utils.metrics",
+    "distrifuser_tpu.utils.trace",
+)
+
+RACE = "concurrency-race"
+DEADLOCK = "concurrency-deadlock"
+DRIFT = "guard-registry-drift"
+CHECKER_NAMES = (RACE, DEADLOCK, DRIFT)
+
+
+def _repo_relpath(cls) -> str:
+    """Repo-relative posix path of the module defining ``cls`` (falls
+    back to the dotted module name for non-file classes)."""
+    import sys
+
+    mod = sys.modules.get(cls.__module__)
+    path = getattr(mod, "__file__", None)
+    if not path:
+        return cls.__module__
+    path = os.path.abspath(path)
+    marker = os.sep + "distrifuser_tpu" + os.sep
+    i = path.find(marker)
+    if i < 0:
+        return os.path.basename(path)
+    return path[i + 1:].replace(os.sep, "/")
+
+
+def observed_classes(extra: Sequence[type] = ()) -> List[type]:
+    out: List[type] = []
+    for modname in OBSERVED_MODULES:
+        mod = importlib.import_module(modname)
+        for obj in vars(mod).values():
+            if (isinstance(obj, type) and obj.__module__ == modname
+                    and not issubclass(obj, BaseException)):
+                out.append(obj)
+    out.extend(extra)
+    return out
+
+
+# -- patch plumbing ----------------------------------------------------------
+
+
+class _Patcher:
+    """Reversible monkey-patch set (class attrs + module attrs)."""
+
+    def __init__(self):
+        self._undo: List[Callable[[], None]] = []
+
+    def set(self, owner, name: str, value) -> None:
+        old = getattr(owner, name)
+        setattr(owner, name, value)
+        self._undo.append(lambda: setattr(owner, name, old))
+
+    def set_class_attr(self, cls: type, name: str, value) -> None:
+        """Like set(), but restore-exact for class dicts: an attribute
+        the class merely INHERITED is removed again on restore, never
+        written back as an own attribute (writing back would freeze the
+        base class's patched wrapper into every subclass forever)."""
+        had_own = name in vars(cls)
+        old = vars(cls).get(name)
+        setattr(cls, name, value)
+        if had_own:
+            self._undo.append(lambda: setattr(cls, name, old))
+        else:
+            self._undo.append(lambda: delattr(cls, name))
+
+    def restore(self) -> None:
+        while self._undo:
+            self._undo.pop()()
+
+
+def _covered_by_patched_base(cls: type, classes,
+                             dunder: str) -> bool:
+    """True when ``cls`` inherits ``dunder`` from another observed class
+    — patching it again would stack a second wrapper (double-recording
+    every write)."""
+    return (dunder not in vars(cls)
+            and any(b in classes for b in cls.__mro__[1:]))
+
+
+def _instrument_writes(patcher: _Patcher, classes: Sequence[type]) -> None:
+    cset = set(classes)
+    for cls in classes:
+        if _covered_by_patched_base(cls, cset, "__setattr__"):
+            continue
+        orig = cls.__setattr__
+
+        def _setattr(self, name, value, _orig=orig):
+            rt = sync.active_runtime()
+            if rt is not None:
+                rt.record_write(self, name, value)
+            _orig(self, name, value)
+
+        patcher.set_class_attr(cls, "__setattr__", _setattr)
+
+
+def _instrument_reads(patcher: _Patcher, classes: Sequence[type]) -> None:
+    cset = set(classes)
+    for cls in classes:
+        if _covered_by_patched_base(cls, cset, "__getattribute__"):
+            continue
+        orig = cls.__getattribute__
+
+        def _getattribute(self, name, _orig=orig):
+            value = _orig(self, name)
+            if not name.startswith("__"):
+                rt = sync.active_runtime()
+                if rt is not None:
+                    try:
+                        d = _orig(self, "__dict__")
+                    except AttributeError:
+                        d = None
+                    if d is not None and name in d:
+                        rt.record_read(self, name)
+            return value
+
+        patcher.set_class_attr(cls, "__getattribute__", _getattribute)
+
+
+def _patch_time(patcher: _Patcher, rt: DeterministicRuntime) -> None:
+    patcher.set(_time_mod, "monotonic", rt.clock)
+    patcher.set(_time_mod, "perf_counter", rt.clock)
+    patcher.set(_time_mod, "sleep", rt.sleep)
+
+
+def _patch_futures(patcher: _Patcher, rt: DeterministicRuntime) -> None:
+    """Vector-clock edges through Future resolution: set_result /
+    set_exception publish the resolver's clock; done-callbacks (how the
+    fleet consumes replica outcomes) join it on entry."""
+    fut = _futures_mod.Future
+    orig_set_result = fut.set_result
+    orig_set_exception = fut.set_exception
+    orig_add_cb = fut.add_done_callback
+
+    def set_result(self, result):
+        rt.channel_store(self)
+        orig_set_result(self, result)
+
+    def set_exception(self, exception):
+        rt.channel_store(self)
+        orig_set_exception(self, exception)
+
+    def add_done_callback(self, fn):
+        def wrapped(f, _fn=fn):
+            rt.channel_load(f)
+            _fn(f)
+
+        orig_add_cb(self, wrapped)
+
+    patcher.set(fut, "set_result", set_result)
+    patcher.set(fut, "set_exception", set_exception)
+    patcher.set(fut, "add_done_callback", add_done_callback)
+
+
+# -- scenario context --------------------------------------------------------
+
+
+class ScenarioContext:
+    """What a scenario gets: the runtime clock, managed-thread spawning,
+    and schedule-aware waiting (never block the token on a real wait)."""
+
+    def __init__(self, rt: DeterministicRuntime):
+        self.rt = rt
+        self.clock = rt.clock
+
+    def spawn(self, name: str, fn: Callable, *args):
+        t = sync.Thread(target=fn, args=args, name=name)
+        t.start()
+        return t
+
+    def wait_until(self, pred: Callable[[], bool], what: str) -> None:
+        """Yield until ``pred()`` holds; the step budget bounds a pred
+        that can never hold (reported as a failure with the seed)."""
+        while not pred():
+            self.rt.yield_point(f"wait-until {what}")
+
+    def result(self, future, tolerate: Tuple[type, ...] = ()):
+        """Schedule-aware Future.result: spin-yield until resolved, then
+        return the result (or the tolerated exception instance)."""
+        self.wait_until(future.done, "future")
+        exc = future.exception()
+        if exc is None:
+            return future.result()
+        if tolerate and isinstance(exc, tolerate):
+            return exc
+        raise exc
+
+
+# -- one schedule ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScheduleResult:
+    scenario: str
+    seed: int
+    steps: int
+    trace: str
+    deadlocks: list
+    race_reports: list
+    lock_graph: LockOrderGraph
+    writes: WriteOriginRecorder
+    obj_count: int
+    error: Optional[str] = None  # scenario failure (assertion, stray exc)
+
+
+def run_schedule(scenario: Callable[[ScenarioContext], None], seed: int,
+                 *, name: str = "", check_reads: bool = False,
+                 max_steps: int = 60000,
+                 extra_classes: Sequence[type] = ()) -> ScheduleResult:
+    rt = DeterministicRuntime(seed, max_steps=max_steps,
+                              check_reads=check_reads)
+    patcher = _Patcher()
+    classes = observed_classes(extra_classes)
+    error: Optional[str] = None
+    try:
+        _instrument_writes(patcher, classes)
+        if check_reads:
+            _instrument_reads(patcher, classes)
+        _patch_time(patcher, rt)
+        _patch_futures(patcher, rt)
+        sync.install_runtime(rt)
+        rt.register_main()
+        try:
+            scenario(ScenarioContext(rt))
+            rt.drain()
+        except ScheduleAbort:
+            pass
+        except AssertionError as exc:
+            error = f"invariant violated: {exc}"
+        except Exception as exc:  # noqa: BLE001 — reported with the seed
+            error = f"{type(exc).__name__}: {exc}"
+        # let every thread unwind even on failure, so the patch restore
+        # below cannot race a still-running managed thread
+        rt._abort_all(None)
+        for t in rt.threads:
+            if t.real is not None:
+                t.real.join(timeout=10.0)
+    finally:
+        sync.uninstall_runtime()
+        patcher.restore()
+    if error is None and rt.budget_exhausted:
+        error = (f"step budget ({max_steps}) exhausted — livelock or a "
+                 "scenario that never quiesces")
+    if error is None:
+        stray = [f"{t.name}: {type(t.exc).__name__}: {t.exc}"
+                 for t in rt.threads if t.exc is not None]
+        if stray:
+            error = "thread exception: " + "; ".join(stray)
+    return ScheduleResult(
+        scenario=name or getattr(scenario, "__name__", "scenario"),
+        seed=seed, steps=rt._steps, trace=rt.trace_text(),
+        deadlocks=list(rt.deadlocks),
+        race_reports=list(rt.detector.reports),
+        lock_graph=rt.lock_graph, writes=rt.writes,
+        obj_count=len(rt._obj_seq), error=error)
+
+
+# -- exploration + findings --------------------------------------------------
+
+
+@dataclasses.dataclass
+class Failure:
+    scenario: str
+    seed: int
+    error: str
+    trace: str
+
+
+@dataclasses.dataclass
+class ExplorationResult:
+    schedules_explored: int
+    per_scenario: Dict[str, int]
+    findings: List[Finding]
+    failures: List[Failure]
+
+    def counts(self) -> Dict[str, int]:
+        out = {name: 0 for name in CHECKER_NAMES}
+        for f in self.findings:
+            out[f.checker] = out.get(f.checker, 0) + 1
+        return out
+
+
+def _registry_coverage() -> Dict[Tuple[str, str], Set[str]]:
+    """(module path, class name) -> guarded attrs, from the static
+    checker's registry (including the ``via=`` cross-object entries the
+    dynamic pass validates).  Keyed with the module path deliberately:
+    a same-named class in another module must NOT inherit coverage —
+    that would blind both passes at once."""
+    from ..checkers.lock_discipline import GUARDED_REGISTRY
+
+    covered: Dict[Tuple[str, str], Set[str]] = {}
+    for path, classes in GUARDED_REGISTRY.items():
+        for cname, g in classes.items():
+            covered.setdefault((path, cname), set()).update(g.attrs)
+    return covered
+
+
+def _class_paths(extra_classes: Sequence[type] = ()) -> Dict[str, str]:
+    return {cls.__name__: _repo_relpath(cls)
+            for cls in observed_classes(extra_classes)}
+
+
+def synthesize_findings(results: Sequence[ScheduleResult],
+                        extra_classes: Sequence[type] = ()
+                        ) -> List[Finding]:
+    """Merge per-schedule evidence into deduplicated, fingerprint-stable
+    findings (identities carry class/attr/lock names, never seeds, line
+    numbers, or thread names)."""
+    paths = _class_paths(extra_classes)
+    covered = _registry_coverage()
+    findings: Dict[str, Finding] = {}
+
+    def add(f: Finding) -> None:
+        findings.setdefault(f.fingerprint, f)
+
+    union = LockOrderGraph()
+    instance_cycles: List[Tuple[str, ...]] = []
+    writes = WriteOriginRecorder()
+    offset = 0
+    for r in results:
+        # instance-level cycle detection runs PER SCHEDULE: labels carry
+        # schedule-local creation indices, so unioning them across seeds
+        # could alias two physical locks under one label and fabricate a
+        # cycle.  The cross-schedule union below is class-attr-level
+        # (stable names) — conservative by design, and same-name pairs
+        # are dropped there (two instances of one lock class ordering
+        # against each other is the instance pass's job).
+        instance_cycles.extend(r.lock_graph.cycles())
+        for a, bs in r.lock_graph.edges.items():
+            for b in bs:
+                union.edge(strip_instance(a), strip_instance(b))
+        writes.absorb(r.writes, offset)
+        offset += r.obj_count
+        for rep in r.race_reports:
+            path = paths.get(rep.class_name, "distrifuser_tpu")
+            add(Finding(
+                checker=RACE, path=path, line=0,
+                message=(
+                    f"{rep.kind} race on {rep.class_name}.{rep.attr}: "
+                    f"{rep.thread_a} [{rep.op_a}] and {rep.thread_b} "
+                    f"[{rep.op_b}] are unordered by happens-before "
+                    f"(scenario {r.scenario}, replay --seed {r.seed}) — "
+                    "take the documented lock, or baseline with the "
+                    "reason the unsynchronized access is safe"),
+                identity=f"{rep.class_name}.{rep.attr}:{rep.kind}",
+            ))
+        for dl in r.deadlocks:
+            labels = sorted({strip_instance(l) for _, _, l in dl.waits})
+            add(Finding(
+                checker=DEADLOCK, path="distrifuser_tpu/serve", line=0,
+                message=(
+                    f"schedule wedged in scenario {r.scenario} "
+                    f"(replay --seed {dl.seed}): {dl.describe()}"),
+                identity=f"wedge:{r.scenario}:{':'.join(labels)}",
+            ))
+    for cycle in instance_cycles + union.cycles():
+        names = sorted({strip_instance(l) for l in cycle})
+        first_cls = names[0].split(".", 1)[0]
+        add(Finding(
+            checker=DEADLOCK, path=paths.get(first_cls, "distrifuser_tpu"),
+            line=0,
+            message=(
+                "lock-order cycle over explored schedules: "
+                + " -> ".join(cycle)
+                + " — two threads taking these locks in opposite order "
+                "deadlock; impose one order or baseline with the reason "
+                "the orders can never overlap"),
+            identity="cycle:" + ":".join(names),
+        ))
+    for cls, attr in writes.multi_writer_attrs():
+        path = paths.get(cls, "distrifuser_tpu")
+        if attr in covered.get((path, cls), set()):
+            continue
+        if not path.startswith("distrifuser_tpu/"):
+            continue  # fixture classes prove the machinery, not the tree
+        add(Finding(
+            checker=DRIFT, path=path, line=0,
+            message=(
+                f"{cls}.{attr} observed written from >= 2 threads but is "
+                "absent from lock_discipline.GUARDED_REGISTRY — the "
+                "static pass is blind to it; register it (use via= for "
+                "an owner-lock guard) or baseline with the reason it "
+                "needs no guard"),
+            identity=f"{cls}.{attr}",
+        ))
+    return sorted(findings.values(),
+                  key=lambda f: (f.checker, f.path, f.identity))
+
+
+def explore(scenarios: Dict[str, Callable], seeds: Sequence[int], *,
+            check_reads: bool = False, max_steps: int = 60000,
+            extra_classes: Sequence[type] = (),
+            keep_traces: bool = False,
+            on_schedule: Optional[Callable[[ScheduleResult], None]] = None,
+            ) -> ExplorationResult:
+    results: List[ScheduleResult] = []
+    failures: List[Failure] = []
+    per_scenario: Dict[str, int] = {}
+    for sname, fn in scenarios.items():
+        for seed in seeds:
+            r = run_schedule(fn, seed, name=sname,
+                             check_reads=check_reads, max_steps=max_steps,
+                             extra_classes=extra_classes)
+            per_scenario[sname] = per_scenario.get(sname, 0) + 1
+            if r.error is not None:
+                failures.append(Failure(sname, seed, r.error, r.trace))
+            if not keep_traces:
+                r.trace = "" if r.error is None else r.trace
+            results.append(r)
+            if on_schedule is not None:
+                on_schedule(r)
+    return ExplorationResult(
+        schedules_explored=len(results),
+        per_scenario=per_scenario,
+        findings=synthesize_findings(results, extra_classes),
+        failures=failures)

@@ -1,0 +1,214 @@
+"""Pure detector math for distrisched: vector clocks, the
+happens-before race check, the lock-order graph, and the write-origin
+recorder behind the guard-registry drift cross-check.
+
+Everything here is schedule-fed and deterministic: the scheduler
+(sched.py) calls in at sync points and instrumented attribute accesses,
+and the outputs (`RaceReport`s, cycles, multi-writer attrs) are plain
+data the harness turns into distrilint `Finding`s.  No threads, no
+globals — unit-testable without running a schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# -- vector clocks -----------------------------------------------------------
+#
+# A clock is a plain {thread_id: int} dict.  Threads tick their own
+# component on release-style operations; acquire-style operations join
+# the releasing side's stored clock.  "a happened-before b" holds iff
+# a's epoch (its writer's own component at access time) is <= b's view
+# of that writer — the standard vector-clock order, evaluated lazily per
+# access pair (FastTrack-style epochs, without the adaptive read
+# representation: the serve scenarios touch few enough variables that
+# full per-thread maps are cheap).
+
+
+def merge(into: Dict[int, int], other: Dict[int, int]) -> None:
+    """into := join(into, other), in place."""
+    for tid, c in other.items():
+        if c > into.get(tid, 0):
+            into[tid] = c
+
+
+@dataclasses.dataclass(frozen=True)
+class RaceReport:
+    """One unordered access pair on one attribute (object-level; the
+    harness aggregates to class-level findings)."""
+
+    class_name: str
+    attr: str
+    kind: str  # "write-write" | "read-write" | "write-read"
+    thread_a: str
+    thread_b: str
+    op_a: str
+    op_b: str
+
+
+class _VarState:
+    __slots__ = ("writes", "reads", "write_ops", "read_ops")
+
+    def __init__(self):
+        # per-thread last-access epochs (tid -> that thread's own clock
+        # component at access time) and the op label active at the access
+        self.writes: Dict[int, int] = {}
+        self.reads: Dict[int, int] = {}
+        self.write_ops: Dict[int, str] = {}
+        self.read_ops: Dict[int, str] = {}
+
+
+class RaceDetector:
+    """Happens-before race detection over instrumented attribute
+    accesses.
+
+    ``check_reads`` gates read/write pair reporting: the serve layer's
+    documented thread model deliberately blesses unlocked snapshot-style
+    reads (GIL dict-copy semantics — serve/resilience.py snapshot docs,
+    mirrored by the static lock-discipline checker, which also skips
+    reads), so the shipped-tree gate runs writes-only and the fixture
+    tests prove the read machinery works.
+    """
+
+    def __init__(self, check_reads: bool = False):
+        self.check_reads = check_reads
+        self._vars: Dict[Tuple[int, str], _VarState] = {}
+        self.reports: List[RaceReport] = []
+        self._seen: Set[Tuple[str, str, str]] = set()
+
+    def _report(self, meta, kind: str, tid_a: int, op_a: str,
+                name_a: str, tid_b: int, op_b: str, name_b: str) -> None:
+        key = (meta[0], meta[1], kind)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.reports.append(RaceReport(
+            class_name=meta[0], attr=meta[1], kind=kind,
+            thread_a=name_a, thread_b=name_b, op_a=op_a, op_b=op_b))
+
+    def write(self, var: Tuple[int, str], meta: Tuple[str, str],
+              tid: int, tname: str, vc: Dict[int, int], op: str,
+              names: Dict[int, str]) -> None:
+        st = self._vars.setdefault(var, _VarState())
+        for u, e in st.writes.items():
+            if u != tid and e > vc.get(u, 0):
+                self._report(meta, "write-write", u, st.write_ops.get(u, ""),
+                             names.get(u, str(u)), tid, op, tname)
+        if self.check_reads:
+            for u, e in st.reads.items():
+                if u != tid and e > vc.get(u, 0):
+                    self._report(meta, "read-write", u,
+                                 st.read_ops.get(u, ""),
+                                 names.get(u, str(u)), tid, op, tname)
+        st.writes[tid] = vc.get(tid, 0)
+        st.write_ops[tid] = op
+
+    def read(self, var: Tuple[int, str], meta: Tuple[str, str],
+             tid: int, tname: str, vc: Dict[int, int], op: str,
+             names: Dict[int, str]) -> None:
+        if not self.check_reads:
+            return
+        st = self._vars.setdefault(var, _VarState())
+        for u, e in st.writes.items():
+            if u != tid and e > vc.get(u, 0):
+                self._report(meta, "write-read", u, st.write_ops.get(u, ""),
+                             names.get(u, str(u)), tid, op, tname)
+        st.reads[tid] = vc.get(tid, 0)
+        st.read_ops[tid] = op
+
+
+# -- lock-order graph --------------------------------------------------------
+
+
+class LockOrderGraph:
+    """Directed acquisition-order graph over lock *instances*.
+
+    An edge A -> B is recorded when a thread acquires B while holding A.
+    A cycle across every explored schedule is a potential deadlock even
+    if no single schedule wedged — the AB/BA pattern needs the unlucky
+    interleaving, and the graph union sees it from the lucky ones.
+    Instance labels (``Class.attr#n``) keep two same-named locks on
+    different objects distinct; cycle findings collapse to the
+    class-attr names, which survive unrelated edits.
+    """
+
+    def __init__(self):
+        self.edges: Dict[str, Set[str]] = {}
+        # representative context per edge, for the finding message
+        self.context: Dict[Tuple[str, str], str] = {}
+
+    def edge(self, held: str, acquired: str, where: str = "") -> None:
+        if held == acquired:
+            return
+        self.edges.setdefault(held, set()).add(acquired)
+        self.context.setdefault((held, acquired), where)
+
+    def absorb(self, other: "LockOrderGraph") -> None:
+        for a, bs in other.edges.items():
+            for b in bs:
+                self.edge(a, b, other.context.get((a, b), ""))
+
+    def cycles(self) -> List[Tuple[str, ...]]:
+        """Every elementary cycle's node set, deduplicated by its sorted
+        membership (one finding per distinct lock set, not one per
+        rotation)."""
+        out: Dict[Tuple[str, ...], Tuple[str, ...]] = {}
+        for start in sorted(self.edges):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(self.edges.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        key = tuple(sorted(path))
+                        out.setdefault(key, path)
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + (nxt,)))
+        return [out[k] for k in sorted(out)]
+
+
+# -- guard-registry drift ----------------------------------------------------
+
+
+def strip_instance(label: str) -> str:
+    """``Class.attr#7`` -> ``Class.attr`` (the edit-stable identity)."""
+    return label.split("#", 1)[0]
+
+
+class WriteOriginRecorder:
+    """Which threads wrote which attribute of which object.
+
+    Feeds the registry-drift cross-check: an attribute of one object
+    observed written from >= 2 distinct threads is cross-thread shared
+    state, and if its class/attr is absent from the static checker's
+    GUARDED_REGISTRY the static pass is blind to it — dynamic evidence
+    of exactly the blind spot ISSUE 14 names.
+    """
+
+    def __init__(self):
+        # (obj_seq, attr) -> set of thread ids; obj_seq -> class name
+        self._writers: Dict[Tuple[int, str], Set[int]] = {}
+        self._cls: Dict[int, str] = {}
+
+    def note(self, obj_seq: int, class_name: str, attr: str,
+             tid: int) -> None:
+        self._cls[obj_seq] = class_name
+        self._writers.setdefault((obj_seq, attr), set()).add(tid)
+
+    def multi_writer_attrs(self) -> List[Tuple[str, str]]:
+        """Sorted (class, attr) pairs where some single object saw
+        writes from >= 2 threads."""
+        out = set()
+        for (oid, attr), tids in self._writers.items():
+            if len(tids) >= 2:
+                out.add((self._cls[oid], attr))
+        return sorted(out)
+
+    def absorb(self, other: "WriteOriginRecorder", offset: int) -> None:
+        """Merge another schedule's recorder; ``offset`` keeps object
+        sequence numbers from colliding across schedules."""
+        for oid, cls in other._cls.items():
+            self._cls[oid + offset] = cls
+        for (oid, attr), tids in other._writers.items():
+            self._writers.setdefault((oid + offset, attr), set()).update(
+                tids)

@@ -1,0 +1,254 @@
+"""The serve-plane scenario suite distrisched explores.
+
+Each scenario drives REAL serve classes (server, fleet, replica, staged
+pipeline — the same objects production runs) with the deterministic
+fakes from serve/testing.py, under the seeded scheduler.  Scenarios
+encode the cross-thread invariants the race-pinning tests
+(test_fleet.py stop-during-failover, test_staging.py cache-pin races)
+each hand-construct ONE interleaving of — here N seeds explore N
+interleavings of the same story, and the invariants are asserted at the
+end of every one:
+
+* ``submit_stop_race``   — submit() from clients racing stop(): every
+  admitted future resolves; nothing leaks.
+* ``failover_exactly_once`` — a replica killed mid-dispatch: the fleet
+  fails over, the shared execution ledger proves no request completed
+  twice, and every future resolves.
+* ``drain_completes_inflight`` — drain() racing live traffic: admitted
+  work finishes (never dropped), the replica reaches drained, resume
+  serves again.
+* ``kill_restart_generation`` — kill then concurrent restarts: exactly
+  one restart wins, the generation advances once, the fresh generation
+  serves.
+* ``staging_stop_midpipeline`` — stop() against the three-stage
+  pipeline with batches in flight: every future resolves, the stage
+  workers exit.
+
+Keep scenarios clock-clean: every serve object takes ``ctx.clock``, no
+real sleeps, tick threads off (tick()/housekeeping driven explicitly) —
+the schedule trace must be a pure function of the seed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .harness import ScenarioContext
+
+
+def _serve_config(**overrides):
+    from ...utils.config import ObservabilityConfig, ResilienceConfig, \
+        ServeConfig
+
+    kw = dict(
+        max_queue_depth=16,
+        max_batch_size=4,
+        batch_window_s=0.002,
+        buckets=((64, 64),),
+        warmup_buckets=(),
+        default_steps=2,
+        default_ttl_s=300.0,
+        cache_capacity=4,
+        resilience=ResilienceConfig(
+            max_retries=1,
+            backoff_base_s=0.0,
+            backoff_multiplier=1.0,
+            backoff_max_s=0.0,
+            backoff_jitter=0.0,
+            watchdog_timeout_s=0.0,  # inline dispatch: hangs are not
+            # under test here, interleavings are
+            breaker_failure_threshold=3,
+            breaker_cooldown_s=0.1,
+        ),
+        observability=ObservabilityConfig(trace=False),
+    )
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+def submit_stop_race(ctx: ScenarioContext) -> None:
+    """submit() racing stop(): every admitted future resolves."""
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import FakeExecutorFactory
+
+    server = InferenceServer(FakeExecutorFactory(batch_size=4),
+                             _serve_config(), clock=ctx.clock)
+    server.start(warmup=False)
+    futures = []
+
+    def client(i: int) -> None:
+        try:
+            futures.append(server.submit(f"prompt-{i}", height=64,
+                                         width=64, seed=i))
+        except ServeError:
+            pass  # admission raced the stop: a typed reject is correct
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(3)]
+    stopper = ctx.spawn("stopper", lambda: server.stop(timeout=60.0))
+    for t in clients:
+        t.join()
+    stopper.join()
+    server.stop(timeout=60.0)  # idempotent
+    for f in futures:
+        # ADMITTED futures must resolve — to a result or a typed error,
+        # never hang (the invariant stop() documents)
+        ctx.result(f, tolerate=(ServeError,))
+
+
+def failover_exactly_once(ctx: ScenarioContext) -> None:
+    """replica killed mid-dispatch: failover succeeds, the ledger
+    proves no request executed to completion twice."""
+    from ...serve.errors import ServeError
+    from ...serve.faults import FaultPlan, FaultRule
+    from ...serve.fleet import build_fleet
+    from ...serve.testing import ExecutionLedger, LedgerFakeExecutorFactory
+    from ...utils.config import FleetConfig
+
+    ledger = ExecutionLedger()
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="r0", at_calls=(0,))], seed=0)
+    fleet = build_fleet(
+        lambda name: LedgerFakeExecutorFactory(ledger, name, batch_size=4),
+        _serve_config(),
+        FleetConfig(tick_s=0.0, auto_restart=False, max_failovers=3,
+                    probe_cooldown_s=0.05),
+        replicas=(("r0", 1.0), ("r1", 1.0)),
+        clock=ctx.clock,
+        fault_plan=plan,
+    )
+    fleet.start()
+    futs = [fleet.submit(f"prompt-{i}", height=64, width=64, seed=i)
+            for i in range(2)]
+
+    def pump() -> None:
+        # housekeeping runs explicitly (tick thread off): re-dispatch
+        # parked failovers until everything resolves
+        while not all(f.done() for f in futs):
+            fleet.tick()
+            ctx.rt.yield_point("pump")
+
+    pumper = ctx.spawn("pumper", pump)
+    for f in futs:
+        r = ctx.result(f, tolerate=(ServeError,))
+        assert not isinstance(r, Exception), (
+            f"failover should recover onto r1, got {r!r}")
+    pumper.join()
+    fleet.stop(timeout=60.0)
+    assert ledger.max_count() <= 1, (
+        f"a request executed to completion twice: {ledger.snapshot()}")
+
+
+def drain_completes_inflight(ctx: ScenarioContext) -> None:
+    """drain() racing traffic: admitted work finishes, drained is
+    reached, resume serves again."""
+    from ...serve.errors import ServeError, ServerClosedError
+    from ...serve.replica import REPLICA_DRAINING, Replica
+    from ...serve.testing import FakeExecutorFactory
+
+    rep = Replica("r0", FakeExecutorFactory(batch_size=4),
+                  _serve_config(), clock=ctx.clock)
+    rep.start()
+    futs = [rep.submit(f"prompt-{i}", height=64, width=64, seed=i)
+            for i in range(3)]
+    drainer = ctx.spawn("drainer", rep.drain)
+    for f in futs:
+        r = ctx.result(f, tolerate=(ServeError,))
+        assert not isinstance(r, Exception), (
+            f"drain must let admitted work FINISH, got {r!r}")
+    drainer.join()
+    assert rep.state == REPLICA_DRAINING, rep.state
+    ctx.wait_until(lambda: rep.drained, "replica drained")
+    try:
+        rep.submit("late", height=64, width=64, seed=9)
+        raise AssertionError("a draining replica admitted a request")
+    except ServerClosedError:
+        pass
+    rep.resume()
+    r = ctx.result(rep.submit("after-resume", height=64, width=64,
+                              seed=10))
+    assert r.output is not None
+    rep.stop(timeout=60.0)
+
+
+def kill_restart_generation(ctx: ScenarioContext) -> None:
+    """kill then racing restarts: one wins, the generation advances,
+    the fresh generation serves."""
+    from ...serve.errors import LifecycleError
+    from ...serve.faults import FaultPlan, FaultRule
+    from ...serve.replica import REPLICA_SERVING, REPLICA_STOPPED, Replica
+    from ...serve.testing import FakeExecutorFactory
+
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="r0", at_calls=(0,))], seed=0)
+    rep = Replica("r0", FakeExecutorFactory(batch_size=4),
+                  _serve_config(), clock=ctx.clock, fault_plan=plan)
+    rep.start()
+    gen = rep.generation
+    f = rep.submit("doomed", height=64, width=64, seed=0)
+    # the injected kill surfaces as InjectedReplicaKilled (deliberately
+    # outside the ServeError hierarchy), so tolerate any exception and
+    # assert the dispatch failed
+    r = ctx.result(f, tolerate=(Exception,))
+    assert isinstance(r, Exception), "the killed dispatch cannot succeed"
+    ctx.wait_until(lambda: rep.state == REPLICA_STOPPED, "kill lands")
+
+    outcomes = []
+
+    def restart() -> None:
+        try:
+            rep.restart(timeout=60.0)
+            outcomes.append("ok")
+        except LifecycleError:
+            outcomes.append("lost-race")  # the documented loser outcome
+
+    r1 = ctx.spawn("restart1", restart)
+    r2 = ctx.spawn("restart2", restart)
+    r1.join()
+    r2.join()
+    assert "ok" in outcomes, outcomes
+    assert rep.state == REPLICA_SERVING, rep.state
+    assert rep.generation >= gen + 1, (rep.generation, gen)
+    out = ctx.result(rep.submit("reborn", height=64, width=64, seed=1))
+    assert out.output is not None
+    rep.stop(timeout=60.0)
+
+
+def staging_stop_midpipeline(ctx: ScenarioContext) -> None:
+    """stop() against the stage pipeline mid-flight: every future
+    resolves, the stage workers exit."""
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StagedFakeExecutorFactory
+
+    server = InferenceServer(
+        StagedFakeExecutorFactory(batch_size=4),
+        _serve_config(pipeline_stages=True, max_inflight_batches=2),
+        clock=ctx.clock)
+    server.start(warmup=False)
+    futures = []
+
+    def client(i: int) -> None:
+        try:
+            futures.append(server.submit(f"prompt-{i}", height=64,
+                                         width=64, seed=i))
+        except ServeError:
+            pass
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(4)]
+    stopper = ctx.spawn("stopper", lambda: server.stop(timeout=60.0))
+    for t in clients:
+        t.join()
+    stopper.join()
+    server.stop(timeout=60.0)
+    for f in futures:
+        ctx.result(f, tolerate=(ServeError,))
+
+
+SCENARIOS: Dict[str, object] = {
+    "submit_stop_race": submit_stop_race,
+    "failover_exactly_once": failover_exactly_once,
+    "drain_completes_inflight": drain_completes_inflight,
+    "kill_restart_generation": kill_restart_generation,
+    "staging_stop_midpipeline": staging_stop_midpipeline,
+}

@@ -1,0 +1,760 @@
+"""distrisched's deterministic scheduler: serve code on virtual threads.
+
+The serve plane runs unmodified — real Python threads, real control flow
+— but every synchronization primitive it constructs (via utils/sync.py)
+is a *virtual* one owned by this runtime, and exactly ONE managed thread
+holds the run token at any instant.  At every sync point (lock
+acquire/release, condition wait/notify, event set/wait, semaphore ops,
+queue ops, thread start/join/exit, patched time.sleep, Future waits) the
+running thread yields to the scheduler, which picks the next thread from
+a seeded RNG — so a schedule is a pure function of its seed, any failure
+replays bit-identically from the printed seed, and N seeds explore N
+distinct interleavings of the same scenario.
+
+Blocking is modeled, never real: a thread that would block parks on the
+runtime (its real thread waits on a private baton event) until the
+resource wakes it — or, for finite-timeout waits, until the scheduler
+*chooses* to deliver the timeout, which is how timeout-dependent paths
+(watchdog fires, join gives up, linger window closes) get explored
+without wall-clock time.  Virtual time advances a fixed quantum per
+step, so deadline arithmetic stays deterministic.
+
+Detection rides the same hooks: vector clocks flow through every
+release/acquire pair (races.py), the lock-order graph accumulates
+held-while-acquiring edges, and a state where no thread is runnable nor
+timeout-wakeable is a concrete deadlock — reported with its wait-for
+cycle and the replay seed, then unwound by aborting every thread with
+`ScheduleAbort` (a BaseException, so serve-layer ``except Exception``
+guards cannot swallow the teardown).
+"""
+
+from __future__ import annotations
+
+import queue as _queue_mod
+import random
+import threading as _threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .races import LockOrderGraph, RaceDetector, WriteOriginRecorder, merge
+
+RUNNABLE = "runnable"
+BLOCKED = "blocked"
+FINISHED = "finished"
+NEW = "new"
+
+
+class ScheduleAbort(BaseException):
+    """Raised inside managed threads to unwind an aborted schedule
+    (deadlock found / step budget exhausted).  BaseException on purpose:
+    the serve layer's broad ``except Exception`` guards must not swallow
+    the teardown and keep a dead schedule's threads running."""
+
+
+class SchedulerError(RuntimeError):
+    """Harness misuse (unmanaged thread touched a virtual primitive,
+    nested runtimes, ...) — a bug in the scenario or the harness, never
+    a finding about the code under test."""
+
+
+class VThread:
+    """Bookkeeping for one managed thread."""
+
+    __slots__ = ("tid", "name", "state", "baton", "vc", "wake_reason",
+                 "waiting_on", "wait_kind", "timeout_ok", "waiters",
+                 "held", "real", "target", "args", "kwargs", "exc",
+                 "started", "last_op")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.name = name
+        self.state = NEW
+        self.baton = _threading.Event()  # real: the run token hand-off
+        self.vc: Dict[int, int] = {tid: 1}
+        self.wake_reason: Optional[str] = None
+        self.waiting_on: Any = None
+        self.wait_kind = ""
+        self.timeout_ok = False
+        self.waiters: List["VThread"] = []  # joiners
+        self.held: List[Any] = []  # virtual locks currently held
+        self.real: Optional[_threading.Thread] = None
+        self.target: Optional[Callable] = None
+        self.args: tuple = ()
+        self.kwargs: dict = {}
+        self.exc: Optional[BaseException] = None
+        self.started = False
+        self.last_op = ""
+
+
+class DeadlockInfo:
+    """One concrete wedged state: who waits on what, plus the lock-owner
+    wait-for cycle when one exists."""
+
+    def __init__(self, waits: List[Tuple[str, str, str]],
+                 cycle: Tuple[str, ...], seed: int, step: int):
+        self.waits = waits  # (thread, kind, label)
+        self.cycle = cycle  # thread names, possibly empty
+        self.seed = seed
+        self.step = step
+
+    def describe(self) -> str:
+        waits = "; ".join(f"{t} waits[{k}] {l}" for t, k, l in self.waits)
+        cyc = (" cycle: " + " -> ".join(self.cycle)) if self.cycle else ""
+        return f"step {self.step}: {waits}{cyc}"
+
+
+class DeterministicRuntime:
+    """One seeded schedule over one scenario run (module docstring)."""
+
+    CLOCK_QUANTUM = 0.0005  # virtual seconds per scheduling step
+
+    def __init__(self, seed: int, max_steps: int = 60000,
+                 check_reads: bool = False):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.max_steps = max_steps
+        self.threads: List[VThread] = []
+        self._by_ident: Dict[int, VThread] = {}
+        self._now = 0.0
+        self._steps = 0
+        self._prim_seq = 0
+        self._obj_seq: Dict[int, int] = {}  # id(obj) -> stable seq
+        # pin every observed object: id() values recycle after GC, and a
+        # recycled id would alias two objects' access histories
+        self._obj_refs: List[Any] = []
+        self._aborted = False
+        self.budget_exhausted = False
+        self.trace: List[str] = []
+        self.detector = RaceDetector(check_reads=check_reads)
+        self.lock_graph = LockOrderGraph()
+        self.writes = WriteOriginRecorder()
+        self.deadlocks: List[DeadlockInfo] = []
+        # cross-channel (Future) hand-off clocks, keyed by id(channel)
+        self._channel_vc: Dict[int, Dict[int, int]] = {}
+        self._names: Dict[int, str] = {}
+        self._lock_labels_seen: List[str] = []
+
+    # -- registration -------------------------------------------------------
+
+    def register_main(self) -> VThread:
+        vt = VThread(0, "0:main")
+        vt.state = RUNNABLE
+        vt.started = True
+        self.threads.append(vt)
+        self._names[0] = vt.name
+        self._by_ident[_threading.get_ident()] = vt
+        return vt
+
+    def current(self) -> VThread:
+        vt = self._by_ident.get(_threading.get_ident())
+        if vt is None:
+            raise SchedulerError(
+                "a virtual primitive was touched from a thread the "
+                "deterministic runtime does not manage — scenarios must "
+                "create every thread through utils.sync.Thread")
+        return vt
+
+    def clock(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        self._now += max(0.0, float(dt))
+
+    def obj_seq(self, obj) -> int:
+        key = id(obj)
+        seq = self._obj_seq.get(key)
+        if seq is None:
+            seq = len(self._obj_seq)
+            self._obj_seq[key] = seq
+            self._obj_refs.append(obj)
+        return seq
+
+    # -- the scheduling core ------------------------------------------------
+
+    def _check_abort(self) -> None:
+        if self._aborted:
+            raise ScheduleAbort()
+
+    def yield_point(self, op: str) -> None:
+        """One scheduling decision: trace the op, advance virtual time,
+        and maybe hand the token to another thread."""
+        self._check_abort()
+        cur = self.current()
+        self._step(cur, op)
+        self._check_abort()
+        nxt = self._choose()
+        if nxt is None or nxt is cur:
+            return
+        self._handoff(cur, nxt)
+        self._check_abort()
+
+    def _step(self, cur: VThread, op: str) -> None:
+        cur.last_op = op  # context for race reports
+        self.trace.append(f"{self._steps:05d} {cur.name} {op}")
+        self._steps += 1
+        self._now += self.CLOCK_QUANTUM
+        if self._steps > self.max_steps:
+            self.budget_exhausted = True
+            self._abort_all(cur)
+
+    def _candidates(self) -> List[VThread]:
+        return [t for t in self.threads
+                if t.state == RUNNABLE
+                or (t.state == BLOCKED and t.timeout_ok)]
+
+    def _choose(self) -> Optional[VThread]:
+        cands = self._candidates()
+        if not cands:
+            return None
+        return self.rng.choice(cands)
+
+    def _wake(self, vt: VThread, reason: str) -> None:
+        """Move a blocked thread back to RUNNABLE (does not hand off)."""
+        if vt.state != BLOCKED:
+            return
+        w = vt.waiting_on
+        if w is not None:
+            waiters = getattr(w, "waiters", None)
+            if waiters is not None and vt in waiters:
+                waiters.remove(vt)
+        vt.waiting_on = None
+        vt.timeout_ok = False
+        vt.state = RUNNABLE
+        vt.wake_reason = reason
+
+    def _handoff(self, cur: Optional[VThread], nxt: VThread) -> None:
+        if nxt.state == BLOCKED:
+            # chosen for timeout delivery
+            self._wake(nxt, "timeout")
+        nxt.baton.set()
+        if cur is not None:
+            cur.baton.wait()
+            cur.baton.clear()
+
+    def block(self, waitable, kind: str, timeout=None) -> str:
+        """Park the current thread on ``waitable`` until woken; returns
+        the wake reason ("notify" / "retry" / "timeout")."""
+        self._check_abort()
+        cur = self.current()
+        label = getattr(waitable, "label", getattr(waitable, "name", "?"))
+        self._step(cur, f"block[{kind}] {label}")
+        self._check_abort()
+        cur.state = BLOCKED
+        cur.waiting_on = waitable
+        cur.wait_kind = kind
+        cur.timeout_ok = timeout is not None and timeout >= 0
+        waitable.waiters.append(cur)
+        nxt = self._choose()
+        if nxt is None:
+            self._deadlock(cur)
+            raise ScheduleAbort()
+        self._handoff(cur, nxt)
+        self._check_abort()
+        reason = cur.wake_reason or "retry"
+        cur.wake_reason = None
+        if reason == "timeout":
+            # a timeout wait consumed (at least) its budgeted wall time —
+            # advance past it so deadline loops computing `remaining`
+            # from the virtual clock converge instead of spinning
+            self._now += max(float(timeout or 0.0), self.CLOCK_QUANTUM)
+        return reason
+
+    # -- deadlock / abort ---------------------------------------------------
+
+    def _wait_cycle(self) -> Tuple[str, ...]:
+        """Thread-name cycle through lock owners, when one exists."""
+        for start in self.threads:
+            seen: List[VThread] = []
+            t: Optional[VThread] = start
+            while (t is not None and t.state == BLOCKED
+                   and t.wait_kind in ("lock", "rlock")):
+                if t in seen:
+                    i = seen.index(t)
+                    return tuple(x.name for x in seen[i:]) + (t.name,)
+                seen.append(t)
+                t = getattr(t.waiting_on, "owner", None)
+        return ()
+
+    def _deadlock(self, cur: VThread) -> None:
+        waits = [(t.name, t.wait_kind,
+                  str(getattr(t.waiting_on, "label",
+                              getattr(t.waiting_on, "name", "?"))))
+                 for t in self.threads if t.state == BLOCKED]
+        info = DeadlockInfo(sorted(waits), self._wait_cycle(), self.seed,
+                            self._steps)
+        self.deadlocks.append(info)
+        self.trace.append(f"{self._steps:05d} DEADLOCK {info.describe()}")
+        self._abort_all(cur)
+
+    def _abort_all(self, cur: Optional[VThread]) -> None:
+        """Unwind the schedule: every parked thread wakes into
+        `ScheduleAbort`; serialization is abandoned (the threads only
+        run their unwind paths from here)."""
+        if self._aborted:
+            return
+        self._aborted = True
+        for t in self.threads:
+            if t is cur:
+                continue
+            if t.state == BLOCKED:
+                self._wake(t, "abort")
+            t.baton.set()
+
+    # -- thread management --------------------------------------------------
+
+    def new_vthread(self, name: Optional[str]) -> VThread:
+        tid = len(self.threads)
+        vt = VThread(tid, f"{tid}:{name or 'thread'}")
+        self.threads.append(vt)
+        self._names[tid] = vt.name
+        return vt
+
+    def start_vthread(self, vt: VThread) -> None:
+        cur = self.current()
+        self.yield_point(f"thread-start {vt.name}")
+        # fork: the child begins with (and after) everything the parent
+        # did so far
+        vt.vc = dict(cur.vc)
+        vt.vc[vt.tid] = vt.vc.get(vt.tid, 0) + 1
+        cur.vc[cur.tid] = cur.vc.get(cur.tid, 0) + 1
+        vt.started = True
+        vt.state = RUNNABLE
+        real = _threading.Thread(target=self._thread_body, args=(vt,),
+                                 name=vt.name, daemon=True)
+        vt.real = real
+        real.start()
+
+    def _thread_body(self, vt: VThread) -> None:
+        self._by_ident[_threading.get_ident()] = vt
+        vt.baton.wait()
+        vt.baton.clear()
+        try:
+            if not self._aborted:
+                vt.target(*vt.args, **vt.kwargs)
+        except ScheduleAbort:
+            pass
+        except BaseException as exc:  # noqa: BLE001 — surfaced by harness
+            vt.exc = exc
+        finally:
+            self._finish_thread(vt)
+
+    def _finish_thread(self, vt: VThread) -> None:
+        vt.state = FINISHED
+        if self._aborted:
+            return
+        self.trace.append(f"{self._steps:05d} {vt.name} exit")
+        self._steps += 1
+        for w in list(vt.waiters):
+            self._wake(w, "notify")
+        nxt = self._choose()
+        if nxt is not None:
+            self._handoff(None, nxt)
+        elif any(t.state == BLOCKED for t in self.threads):
+            self._deadlock(None)
+
+    def join_vthread(self, vt: VThread, timeout=None) -> None:
+        if not vt.started:
+            # stdlib semantics, faithfully: a schedule that reaches a
+            # join-before-start must surface the production crash, not
+            # silently no-op past it
+            raise RuntimeError("cannot join thread before it is started")
+        cur = self.current()
+        self.yield_point(f"join {vt.name}")
+        while vt.state != FINISHED:
+            if self.block(vt, "join", timeout) == "timeout":
+                return
+        merge(cur.vc, vt.vc)
+
+    def drain(self) -> None:
+        """Run every remaining managed thread to completion (the harness
+        epilogue; the scenario must have initiated all shutdowns)."""
+        cur = self.current()
+        while any(t is not cur and t.started and t.state != FINISHED
+                  for t in self.threads):
+            self.yield_point("drain")
+        for t in self.threads:
+            if t.real is not None:
+                t.real.join(timeout=10.0)
+
+    # -- clocks + channels --------------------------------------------------
+
+    def release_clock(self, store: Dict[int, int]) -> None:
+        """release-style op: publish the current thread's clock into a
+        primitive's stored clock, then tick."""
+        cur = self.current()
+        merge(store, cur.vc)
+        cur.vc[cur.tid] = cur.vc.get(cur.tid, 0) + 1
+
+    def acquire_clock(self, store: Dict[int, int]) -> None:
+        merge(self.current().vc, store)
+
+    def channel_store(self, channel) -> None:
+        """Hand-off edge through a non-virtual channel (Future resolve)."""
+        if self._by_ident.get(_threading.get_ident()) is None:
+            return
+        store = self._channel_vc.setdefault(id(channel), {})
+        self.release_clock(store)
+
+    def channel_load(self, channel) -> None:
+        if self._by_ident.get(_threading.get_ident()) is None:
+            return
+        store = self._channel_vc.get(id(channel))
+        if store:
+            self.acquire_clock(store)
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+        self.yield_point(f"sleep {float(seconds):.4g}")
+
+    # -- instrumentation hooks ---------------------------------------------
+
+    def record_write(self, obj, attr: str, value, op: str = "") -> None:
+        vt = self._by_ident.get(_threading.get_ident())
+        if vt is None or self._aborted:
+            return
+        if isinstance(value, _VBase) and value.auto_label:
+            value.label = f"{type(obj).__name__}.{attr}#{value.idx}"
+            value.auto_label = False
+        cls = type(obj).__name__
+        seq = self.obj_seq(obj)
+        self.writes.note(seq, cls, attr, vt.tid)
+        self.detector.write((seq, attr), (cls, attr), vt.tid, vt.name,
+                            vt.vc, op or f"after {vt.last_op}",
+                            self._names)
+
+    def record_read(self, obj, attr: str, op: str = "") -> None:
+        vt = self._by_ident.get(_threading.get_ident())
+        if vt is None or self._aborted:
+            return
+        cls = type(obj).__name__
+        seq = self.obj_seq(obj)
+        self.detector.read((seq, attr), (cls, attr), vt.tid, vt.name,
+                           vt.vc, op or f"after {vt.last_op}",
+                           self._names)
+
+    # -- factory surface consumed by utils.sync -----------------------------
+
+    def _next_prim(self) -> int:
+        self._prim_seq += 1
+        return self._prim_seq
+
+    def create_lock(self):
+        return VLock(self)
+
+    def create_rlock(self):
+        return VRLock(self)
+
+    def create_condition(self, lock=None):
+        return VCondition(self, lock)
+
+    def create_event(self):
+        return VEvent(self)
+
+    def create_semaphore(self, value: int = 1):
+        return VSemaphore(self, value)
+
+    def create_queue(self, maxsize: int = 0):
+        return VQueue(self, maxsize)
+
+    def create_thread(self, target=None, args=(), kwargs=None, name=None):
+        return VThreadHandle(self, target, args, kwargs or {}, name)
+
+    def trace_text(self) -> str:
+        return "\n".join(self.trace) + "\n"
+
+
+# -- virtual primitives ------------------------------------------------------
+
+
+class _VBase:
+    def __init__(self, rt: DeterministicRuntime, kind: str):
+        self.rt = rt
+        self.idx = rt._next_prim()
+        self.label = f"{kind}#{self.idx}"
+        self.auto_label = True
+        self.waiters: List[VThread] = []
+        self.clock: Dict[int, int] = {}
+
+    def _wake_all(self, reason: str = "retry") -> None:
+        for w in list(self.waiters):
+            self.rt._wake(w, reason)
+
+
+class VLock(_VBase):
+    REENTRANT = False
+
+    def __init__(self, rt: DeterministicRuntime, kind: str = "Lock"):
+        super().__init__(rt, kind)
+        self.owner: Optional[VThread] = None
+        self.count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        rt = self.rt
+        rt.yield_point(f"acquire {self.label}")
+        cur = rt.current()
+        to = None if (timeout is None or timeout < 0) else timeout
+        while True:
+            if self.owner is None:
+                self.owner = cur
+                self.count = 1
+                self._on_acquired(cur)
+                return True
+            if self.REENTRANT and self.owner is cur:
+                self.count += 1
+                return True
+            if not blocking:
+                return False
+            if rt.block(self, "lock", to) == "timeout":
+                return False
+
+    def release(self):
+        rt = self.rt
+        cur = rt.current()
+        if self.owner is not cur:
+            raise RuntimeError(f"release of un-owned {self.label}")
+        rt.yield_point(f"release {self.label}")
+        self.count -= 1
+        if self.count == 0:
+            self._on_released(cur)
+
+    def _on_acquired(self, cur: VThread) -> None:
+        rt = self.rt
+        rt.acquire_clock(self.clock)
+        for held in cur.held:
+            rt.lock_graph.edge(held.label, self.label)
+        cur.held.append(self)
+        if self.label not in rt._lock_labels_seen:
+            rt._lock_labels_seen.append(self.label)
+
+    def _on_released(self, cur: VThread) -> None:
+        self.owner = None
+        if self in cur.held:
+            cur.held.remove(self)
+        self.rt.release_clock(self.clock)
+        self._wake_all("retry")
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class VRLock(VLock):
+    REENTRANT = True
+
+    def __init__(self, rt: DeterministicRuntime):
+        super().__init__(rt, "RLock")
+
+
+class VCondition(_VBase):
+    def __init__(self, rt: DeterministicRuntime, lock=None):
+        super().__init__(rt, "Condition")
+        self.lock = lock if lock is not None else VLock(rt)
+
+    # delegate the lock interface (``with cond:`` and explicit acquire)
+    def acquire(self, *a, **k):
+        return self.lock.acquire(*a, **k)
+
+    def release(self):
+        return self.lock.release()
+
+    def __enter__(self):
+        self.lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rt = self.rt
+        cur = rt.current()
+        if self.lock.owner is not cur:
+            raise RuntimeError("cond.wait without holding its lock")
+        rt.yield_point(f"cond-wait {self.label}")
+        saved = self.lock.count
+        self.lock.count = 0
+        self.lock._on_released(cur)
+        reason = rt.block(self, "cond", timeout)
+        # reacquire unconditionally (stdlib semantics), then restore the
+        # recursion depth the waiter entered with
+        while True:
+            if self.lock.owner is None:
+                self.lock.owner = cur
+                self.lock.count = saved
+                self.lock._on_acquired(cur)
+                break
+            rt.block(self.lock, "lock", None)
+        if reason == "notify":
+            rt.acquire_clock(self.clock)
+            return True
+        return False
+
+    def _notify(self, n: Optional[int]) -> None:
+        rt = self.rt
+        if self.lock.owner is not rt.current():
+            raise RuntimeError("cond.notify without holding its lock")
+        rt.yield_point(f"notify {self.label}")
+        rt.release_clock(self.clock)
+        targets = list(self.waiters) if n is None else list(self.waiters)[:n]
+        for w in targets:
+            rt._wake(w, "notify")
+
+    def notify(self, n: int = 1) -> None:
+        self._notify(n)
+
+    def notify_all(self) -> None:
+        self._notify(None)
+
+
+class VEvent(_VBase):
+    def __init__(self, rt: DeterministicRuntime):
+        super().__init__(rt, "Event")
+        self.flag = False
+
+    def is_set(self) -> bool:
+        return self.flag
+
+    def set(self) -> None:
+        rt = self.rt
+        rt.yield_point(f"set {self.label}")
+        self.flag = True
+        rt.release_clock(self.clock)
+        self._wake_all("notify")
+
+    def clear(self) -> None:
+        self.rt.yield_point(f"clear {self.label}")
+        self.flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rt = self.rt
+        rt.yield_point(f"event-wait {self.label}")
+        while True:
+            if self.flag:
+                rt.acquire_clock(self.clock)
+                return True
+            if rt.block(self, "event", timeout) == "timeout":
+                return False
+
+
+class VSemaphore(_VBase):
+    def __init__(self, rt: DeterministicRuntime, value: int):
+        super().__init__(rt, "Semaphore")
+        self.value = int(value)
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        rt = self.rt
+        rt.yield_point(f"sem-acquire {self.label}")
+        while True:
+            if self.value > 0:
+                self.value -= 1
+                rt.acquire_clock(self.clock)
+                return True
+            if not blocking:
+                return False
+            if rt.block(self, "semaphore", timeout) == "timeout":
+                return False
+
+    def release(self) -> None:
+        rt = self.rt
+        rt.yield_point(f"sem-release {self.label}")
+        self.value += 1
+        rt.release_clock(self.clock)
+        self._wake_all("retry")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class VQueue(_VBase):
+    """FIFO with the stdlib queue exception surface (raises the real
+    ``queue.Empty``/``queue.Full`` so existing except clauses match).
+    ``maxsize`` is honored — a bounded queue's producer-blocked-on-full
+    states must be explorable, not silently unbounded.  Clocks travel
+    per item: a get happens-after exactly its put."""
+
+    def __init__(self, rt: DeterministicRuntime, maxsize: int = 0):
+        super().__init__(rt, "Queue")
+        self.maxsize = int(maxsize)
+        self.items: List[Tuple[Any, Dict[int, int]]] = []
+
+    def _full(self) -> bool:
+        return 0 < self.maxsize <= len(self.items)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        rt = self.rt
+        rt.yield_point(f"put {self.label}")
+        while self._full():
+            if not block:
+                raise _queue_mod.Full()
+            if rt.block(self, "queue-full", timeout) == "timeout":
+                raise _queue_mod.Full()
+        cur = rt.current()
+        vc = dict(cur.vc)
+        cur.vc[cur.tid] = cur.vc.get(cur.tid, 0) + 1
+        self.items.append((item, vc))
+        self._wake_all("retry")
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        rt = self.rt
+        rt.yield_point(f"get {self.label}")
+        while True:
+            if self.items:
+                item, vc = self.items.pop(0)
+                rt.acquire_clock(vc)
+                self._wake_all("retry")  # a slot opened for blocked puts
+                return item
+            if not block:
+                raise _queue_mod.Empty()
+            if rt.block(self, "queue", timeout) == "timeout":
+                raise _queue_mod.Empty()
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class VThreadHandle:
+    """What utils.sync.Thread returns under the runtime: the stdlib
+    Thread surface (start/join/is_alive/name/daemon) over a VThread."""
+
+    def __init__(self, rt: DeterministicRuntime, target, args, kwargs,
+                 name):
+        self.rt = rt
+        self.daemon = True
+        self.vt = rt.new_vthread(name)
+        self.vt.target = target if target is not None else (lambda: None)
+        self.vt.args = tuple(args)
+        self.vt.kwargs = dict(kwargs)
+
+    @property
+    def name(self) -> str:
+        return self.vt.name
+
+    def start(self) -> None:
+        if self.vt.started:
+            raise RuntimeError("threads can only be started once")
+        self.rt.start_vthread(self.vt)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.rt.join_vthread(self.vt, timeout)
+
+    def is_alive(self) -> bool:
+        return self.vt.started and self.vt.state != FINISHED

@@ -21,12 +21,14 @@ def all_checkers() -> List[object]:
         lock_discipline,
         overlap_gate,
         route_tables,
+        sync_containment,
         typed_raises,
     )
 
     return [
         typed_raises,
         collective_containment,
+        sync_containment,
         lock_discipline,
         compile_identity,
         route_tables,

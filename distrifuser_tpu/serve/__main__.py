@@ -18,8 +18,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import threading
 
+from ..utils import sync
 from ..utils.config import ObservabilityConfig, ServeConfig
 from .server import InferenceServer
 from .testing import FakeExecutorFactory
@@ -58,7 +58,7 @@ def run_demo(metrics_path: str = None, verbose: bool = True,
         # 512 bucket; wave 2 mixes in 768x640 requests that snap to the
         # 1024x1024 bucket (its first use = the only other compile)
         futures = []
-        lock = threading.Lock()
+        lock = sync.Lock()
 
         def client(prompt, h, w, seed):
             f = server.submit(prompt, height=h, width=w, seed=seed)
@@ -73,7 +73,7 @@ def run_demo(metrics_path: str = None, verbose: bool = True,
                for i in range(2)],
         ]
         for wave in waves:
-            threads = [threading.Thread(target=client, args=a) for a in wave]
+            threads = [sync.Thread(target=client, args=a) for a in wave]
             for t in threads:
                 t.start()
             for t in threads:

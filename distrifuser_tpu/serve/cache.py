@@ -25,10 +25,10 @@ from other threads are consistent.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from ..utils import sync
 
 
 @dataclasses.dataclass(frozen=True)
@@ -210,7 +210,7 @@ class ExecutorCache:
         # exactly which dispatch paid a compile.  None = zero overhead.
         self.tracer = None
         self._entries: "OrderedDict[ExecKey, Any]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         # refcounts by executor identity (not key: a key may rebuild while
         # the old instance is still pinned by in-flight staged work)
         self._pins: Dict[int, int] = {}

@@ -45,9 +45,9 @@ pin.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import sync
 from ..utils.config import validate_step_cache_knobs
 from .cache import ExecKey
 
@@ -217,7 +217,7 @@ class SLOController:
         self.tracer = tracer
         self.registry = registry
         self.prompt_cache = prompt_cache
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._classes: Dict[str, _ClassState] = {}
         # cost-normalized per-batch service observations (ring): a batch
         # completing in t seconds at tier i contributes t / cost_i — the

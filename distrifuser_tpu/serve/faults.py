@@ -59,10 +59,11 @@ from __future__ import annotations
 
 import dataclasses
 import random
-import threading
 import time
 import zlib
 from typing import Dict, Optional, Sequence, Tuple
+
+from ..utils import sync
 
 FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang", "kill")
 
@@ -164,7 +165,7 @@ class FaultPlan:
     def __init__(self, rules: Sequence[FaultRule], seed: int = 0):
         self.rules = tuple(rules)
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._site_calls: Dict[str, int] = {}
         self._fires: Dict[Tuple[str, str], int] = {}
         self._rule_fires = [0] * len(self.rules)

@@ -59,6 +59,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import sync
 from ..utils.config import FleetConfig, ServeConfig
 from ..utils.metrics import MetricsRegistry
 from .errors import (
@@ -166,12 +167,12 @@ class FleetRouter:
         )
         self._default_ttl = max(
             r.config.default_ttl_s for r in reps)
-        self._lock = threading.RLock()
+        self._lock = sync.RLock()
         self._parked: List[_FleetRequest] = []
         self._started = False
         self._stopping = False
         self._stopped = False
-        self._tick_stop = threading.Event()
+        self._tick_stop = sync.Event()
         self._tick_thread: Optional[threading.Thread] = None
         # a REBUILT router over the same shared registry (the documented
         # recovery path after stop()) must replace its predecessor's
@@ -218,7 +219,7 @@ class FleetRouter:
                 errors.append((slot.replica.name, exc))
 
         threads = [
-            threading.Thread(target=run, args=(s,), daemon=True,
+            sync.Thread(target=run, args=(s,), daemon=True,
                              name=f"fleet-start-{s.replica.name}")
             for s in slots
         ]
@@ -240,7 +241,7 @@ class FleetRouter:
         self._started = True
         if self.config.tick_s > 0:
             self._tick_stop.clear()
-            self._tick_thread = threading.Thread(
+            self._tick_thread = sync.Thread(
                 target=self._tick_loop, name="distrifuser-fleet-tick",
                 daemon=True)
             self._tick_thread.start()
@@ -265,7 +266,7 @@ class FleetRouter:
         # bounded by the slowest single replica, not the sum — each
         # replica's stop() is itself bounded by its join timeouts
         stoppers = [
-            threading.Thread(
+            sync.Thread(
                 target=lambda s=slot: s.replica.stop(timeout),
                 daemon=True, name=f"fleet-stop-{slot.replica.name}")
             for slot in self._slots.values()
@@ -431,8 +432,13 @@ class FleetRouter:
             # (always fresh when the tick thread is off, i.e. tick_s=0 —
             # the deterministic-test mode).
             if cfg.tick_s <= 0 or now - slot.score_at >= cfg.tick_s:
-                slot.last_score = rep.health_score(cfg.p99_ref_s)
-                slot.score_at = now
+                score = rep.health_score(cfg.p99_ref_s)
+                # the cached score is also refreshed by the tick thread:
+                # distrisched pinned the unlocked write pair as a
+                # write-write race, so both writers take the router lock
+                with self._lock:
+                    slot.last_score = score
+                    slot.score_at = now
             score = slot.last_score
             if score <= cfg.health_floor:
                 continue  # routed around now; the tick will drain it
@@ -677,8 +683,9 @@ class FleetRouter:
                 continue
             if rep.state == REPLICA_SERVING:
                 score = rep.health_score(cfg.p99_ref_s)
-                slot.last_score = score
-                slot.score_at = now
+                with self._lock:  # paired with _candidates' refresh
+                    slot.last_score = score
+                    slot.score_at = now
                 if score <= cfg.health_floor:
                     self._auto_drain(slot, reason="health_floor")
         # parked work: expire what cannot make its deadline, retry the rest
@@ -744,7 +751,7 @@ class FleetRouter:
             self.counters.inc("restarts")
             self._trace("restart", replica=slot.replica.name, kind="auto")
 
-        threading.Thread(target=run, daemon=True,
+        sync.Thread(target=run, daemon=True,
                          name=f"fleet-restart-{slot.replica.name}").start()
 
     # -- observability ------------------------------------------------------

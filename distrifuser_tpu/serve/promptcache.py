@@ -29,9 +29,9 @@ HBM bound.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable
+from ..utils import sync
 
 _MISSING = object()
 
@@ -46,7 +46,7 @@ class PromptCache:
         # "misses" / "evictions" — the MetricsRegistry hit-rate surface
         self.counter = counter
         self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._hits = 0
         self._misses = 0
 

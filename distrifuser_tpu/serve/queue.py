@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
@@ -30,6 +29,7 @@ from typing import Any, Callable, List, Optional
 # Historical home of these errors — re-exported so `from .queue import
 # QueueFullError` keeps working; the full typed hierarchy (Retryable vs
 # Fatal) lives in serve/errors.py.
+from ..utils import sync
 from .errors import (  # noqa: F401  (re-exports)
     DeadlineExceededError,
     QueueFullError,
@@ -120,8 +120,8 @@ class RequestQueue:
         assert max_depth >= 1, max_depth
         self.max_depth = max_depth
         self._items: List[Request] = []
-        self._lock = threading.Lock()
-        self._nonempty = threading.Condition(self._lock)
+        self._lock = sync.Lock()
+        self._nonempty = sync.Condition(self._lock)
         self._closed = False
         self._seq = 0  # bumped on every put; lets waiters sleep until an
         # ARRIVAL rather than mere non-emptiness (batcher linger loop)

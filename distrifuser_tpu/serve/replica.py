@@ -54,6 +54,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import sync
 from ..utils.config import ServeConfig
 from .errors import LifecycleError, ServerClosedError
 from .faults import FaultPlan, InjectedReplicaKilled
@@ -165,10 +166,11 @@ class Replica:
         # _on_killed); joined by the next start() before metric pruning
         self._bg_stop: Optional[threading.Thread] = None
         self._state = REPLICA_STARTING
+        self._warm_nonce = 0  # which start() owns the current WARMING
         self._history: List[Tuple[float, str, str]] = []
         # RLock: lifecycle methods nest (restart = stop + start), and the
         # kill path transitions from a watchdog worker thread
-        self._lock = threading.RLock()
+        self._lock = sync.RLock()
 
     # -- state machine ------------------------------------------------------
 
@@ -208,13 +210,21 @@ class Replica:
         compiles take minutes, and `stop()`/`drain()` must stay
         responsive (their timeout contract).  Concurrent starts are
         excluded by the WARMING transition itself; a `stop()` landing
-        mid-warm wins — the freshly built server is discarded."""
+        mid-warm wins — the freshly built server is discarded.  The
+        warming NONCE makes the discard check generation-exact: a
+        stop+restart pair landing mid-warm re-enters WARMING, and
+        without the nonce the first starter would adopt the second's
+        WARMING state and serve its own (conceptually dead) server —
+        an interleaving distrisched found (two racing restart()s could
+        both report success yet leave the replica stopped)."""
         with self._lock:
             if self._state not in (REPLICA_STARTING, REPLICA_STOPPED):
                 raise LifecycleError(
                     f"replica {self.name} cannot start from {self._state}"
                 )
             self._transition(REPLICA_WARMING)
+            self._warm_nonce += 1
+            nonce = self._warm_nonce
             self.killed = False
             bg, old = self._bg_stop, self.server
             self._bg_stop = None
@@ -260,13 +270,15 @@ class Replica:
             server.start(warmup=True)
         except Exception:
             with self._lock:
-                if self._state == REPLICA_WARMING:
+                if (self._state == REPLICA_WARMING
+                        and self._warm_nonce == nonce):
                     self._transition(REPLICA_STOPPED)
             raise
         with self._lock:
-            if self._state != REPLICA_WARMING:
-                # stop() raced the warmup and won: the handle is STOPPED,
-                # so the fresh server must not serve
+            if self._state != REPLICA_WARMING or self._warm_nonce != nonce:
+                # stop() (or a full stop+restart cycle) raced the warmup
+                # and won: THIS warming is over, so the fresh server must
+                # not serve — and must not adopt a successor's WARMING
                 server.stop(timeout=5.0)
                 return self
             self.server = server
@@ -370,11 +382,20 @@ class Replica:
             self._transition(REPLICA_STOPPED)
         if server is not None:
             server.request_stop()
-            self._bg_stop = threading.Thread(
+            bg = sync.Thread(
                 target=lambda: server.stop(timeout=10.0),
                 name=f"replica-kill-{self.name}", daemon=True,
             )
-            self._bg_stop.start()
+            # started BEFORE it is published: a racing restart that reads
+            # the handle must never join an unstarted thread (stdlib join
+            # raises, wedging the replica in WARMING).  A reader in the
+            # gap sees None, which is safe — start() falls back to
+            # old.stop(), whose join covers the same shutdown.  The store
+            # itself takes the lock (distrisched pinned the unlocked
+            # write-write race against start()'s clear).
+            bg.start()
+            with self._lock:
+                self._bg_stop = bg
 
     # -- signals ------------------------------------------------------------
 

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import sync
 from ..utils.config import ResilienceConfig
 from ..utils.metrics import RingLog
 from .cache import ExecKey
@@ -126,9 +127,9 @@ class RetryBudget:
         self.clock = clock
         self._tokens = float(total)
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
-    def _refill(self) -> None:
+    def _refill_locked(self) -> None:
         now = self.clock()
         if self.refill_per_s > 0 and now > self._last:
             self._tokens = min(
@@ -139,7 +140,7 @@ class RetryBudget:
 
     def acquire(self) -> bool:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             if self._tokens < 1.0:
                 return False
             self._tokens -= 1.0
@@ -148,7 +149,7 @@ class RetryBudget:
     @property
     def remaining(self) -> int:
         with self._lock:
-            self._refill()
+            self._refill_locked()
             return int(self._tokens)
 
 
@@ -290,7 +291,7 @@ class Watchdog:
                     f"further {self.timeout_s:.3f}s; shedding this dispatch"
                 )
             self._abandoned = None
-        done = threading.Event()
+        done = sync.Event()
         holder: List[Tuple[str, Any]] = []
 
         def work():
@@ -301,7 +302,7 @@ class Watchdog:
             finally:
                 done.set()
 
-        t = threading.Thread(target=work, name="serve-watchdog-work",
+        t = sync.Thread(target=work, name="serve-watchdog-work",
                              daemon=True)
         t.start()
         if not done.wait(self.timeout_s):
@@ -524,7 +525,7 @@ class ResilienceEngine:
         from collections import OrderedDict
 
         self._keys: "OrderedDict[ExecKey, KeyResilience]" = OrderedDict()
-        self._keys_lock = threading.Lock()
+        self._keys_lock = sync.Lock()
 
     # -- per-key state ------------------------------------------------------
 

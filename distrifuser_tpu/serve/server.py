@@ -48,6 +48,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils import sync
 from ..utils.config import ServeConfig
 from ..utils.metrics import Counter, MetricsRegistry
 from ..utils.trace import RequestTrace, Tracer
@@ -193,7 +194,7 @@ class InferenceServer:
             clock=clock,
             batch_cap=self._batch_cap_for,
         )
-        self._stop = threading.Event()
+        self._stop = sync.Event()
         self.resilience = ResilienceEngine(
             self.config.resilience,
             buckets=self.batcher.table.buckets,
@@ -261,6 +262,13 @@ class InferenceServer:
             )
         self._thread: Optional[threading.Thread] = None
         self._started = False
+        # guards the two lifecycle cells concurrent stop()/start() callers
+        # mutate: stop() is documented idempotent-from-any-thread, and
+        # distrisched pinned the unlocked handle/flag writes as races
+        # (a concurrent stop pair could even None the handle between
+        # another stopper's check and join).  Reads stay unlocked under
+        # the blessed snapshot-read policy.
+        self._lifecycle_lock = sync.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -283,11 +291,16 @@ class InferenceServer:
                 and self.metrics_endpoint is None):
             self.start_metrics_endpoint()
         self._stop.clear()
-        self._started = True
-        self._thread = threading.Thread(
+        t = sync.Thread(
             target=self._loop, name="distrifuser-serve", daemon=True
         )
-        self._thread.start()
+        with self._lifecycle_lock:
+            self._started = True
+            self._thread = t
+            # started inside the lock: a concurrent stop() reads the
+            # handle under the same lock and joins it — publishing an
+            # unstarted thread would hand it a join that raises
+            t.start()
         return self
 
     def request_stop(self) -> None:
@@ -319,20 +332,25 @@ class InferenceServer:
             # stage invocation in progress finishes, bounded by its
             # watchdog), so no staged future is left unresolved either
             self.staging.stop(timeout)
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
+        with self._lifecycle_lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
                 # still draining a long dispatch: KEEP the handle —
                 # health() must keep reporting scheduler_alive truthfully,
                 # and start()'s assert must refuse to spawn a second
                 # scheduler over the one still owning the mesh
                 self.counters.inc("stop_join_timeouts")
             else:
-                self._thread = None
+                with self._lifecycle_lock:
+                    if self._thread is t:
+                        self._thread = None
         if self.metrics_endpoint is not None:
             self.metrics_endpoint.stop()
             self.metrics_endpoint = None
-        self._started = False
+        with self._lifecycle_lock:
+            self._started = False
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
@@ -1163,11 +1181,11 @@ class InferenceServer:
         res = self.resilience.snapshot()
         c = self.counters.snapshot()
         degraded = bool(res["open_circuits"] or res["degradations"])
+        t = self._thread  # one read: a concurrent stop may None the attr
         return {
             "status": "degraded" if degraded else "ok",
             "queue_depth": len(self.queue),
-            "scheduler_alive": bool(self._thread is not None
-                                    and self._thread.is_alive()),
+            "scheduler_alive": bool(t is not None and t.is_alive()),
             "requests": {
                 k: c.get(k, 0)
                 for k in ("submitted", "completed", "completed_late",

@@ -62,11 +62,11 @@ overlap failed to hide.  The overlap is measured, not asserted.
 from __future__ import annotations
 
 import queue as queue_mod
-import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import sync
 from ..utils.metrics import Counter, GapTracker, LatencyHistogram
 from .cache import ExecKey
 from .errors import (
@@ -177,14 +177,14 @@ class StagePipeline:
         self.on_success = on_success
         self.on_failure = on_failure
         self.on_release = on_release
-        self._slots = threading.Semaphore(max_inflight)
-        self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._slots = sync.Semaphore(max_inflight)
+        self._stop = sync.Event()
+        self._lock = sync.Lock()
         # serializes submit()'s stop-check-then-enqueue against stop()'s
         # flag-set: without it a submit racing stop() could enqueue AFTER
         # the worker consumed its sentinel and exited, orphaning the
         # batch's futures forever
-        self._submit_lock = threading.Lock()
+        self._submit_lock = sync.Lock()
         self._inflight = 0
         self.peak_inflight = 0
         self.submitted = 0
@@ -210,11 +210,11 @@ class StagePipeline:
             self.hist_wait = {s: LatencyHistogram() for s in STAGES}
             self.hist_service = {s: LatencyHistogram() for s in STAGES}
             self.denoise_gap = GapTracker()
-        self._queues = {s: queue_mod.Queue() for s in STAGES}
+        self._queues = {s: sync.Queue() for s in STAGES}
         self._watchdogs = {s: Watchdog(watchdog_timeout_s) for s in STAGES}
         self._outcomes: "deque[Tuple[ExecKey, ExecKey, Optional[Exception]]]" = deque()
         self._threads = [
-            threading.Thread(target=self._worker, args=(s,),
+            sync.Thread(target=self._worker, args=(s,),
                              name=f"serve-stage-{s}", daemon=True)
             for s in STAGES
         ]
@@ -280,7 +280,7 @@ class StagePipeline:
             after.wait()
             self.on_release(sb)
 
-        threading.Thread(target=waiter, name="serve-stage-deferred-unpin",
+        sync.Thread(target=waiter, name="serve-stage-deferred-unpin",
                          daemon=True).start()
 
     def _fail(self, sb: StagedBatch, exc: Exception, *,

@@ -17,6 +17,7 @@ from typing import Any, List
 
 import numpy as np
 
+from ..utils import sync
 from .cache import ExecKey
 
 
@@ -142,9 +143,8 @@ class ExecutionLedger:
     concurrently."""
 
     def __init__(self):
-        import threading
 
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._counts: dict = {}
 
     def record(self, prompt: str, seed: int, replica: str = "") -> None:
@@ -214,9 +214,8 @@ class StageTracker:
     the pipeline's own semaphore accounting."""
 
     def __init__(self):
-        import threading
 
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self.current = 0
         self.peak = 0
 

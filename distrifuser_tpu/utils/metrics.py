@@ -29,6 +29,21 @@ request count, JSON-friendly snapshots for `bench.py`-style artifacts.
 """
 
 from __future__ import annotations
+try:
+    from . import sync
+except ImportError:  # scripts/compute_metrics.py execs this file by path
+    # (no package parent — an offline metrics box need not import jax via
+    # the distrifuser_tpu package): load the sibling passthrough the same
+    # way, so there is still exactly one sync implementation
+    import importlib.util as _ilu
+    import os as _os
+
+    _spec = _ilu.spec_from_file_location(
+        "_distrifuser_sync",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "sync.py"))
+    sync = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(sync)
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -275,7 +290,6 @@ class LatencyHistogram:
         self.lo = lo
         self.hi = hi
         import math
-        import threading
 
         self._n_buckets = (
             int(math.ceil(math.log(hi / lo) / math.log(self._FACTOR))) + 1
@@ -288,7 +302,7 @@ class LatencyHistogram:
         # observe() is a read-modify-write on numpy storage; the staged
         # serving pipeline observes from stage workers concurrently with
         # the scheduler thread (serve/staging.py), same reason as Counter
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
     def _bucket(self, v: float) -> int:
         import math
@@ -362,10 +376,9 @@ class Counter:
     read-modify-write would drop counts under that interleaving."""
 
     def __init__(self):
-        import threading
 
         self._c: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -393,9 +406,8 @@ class GapTracker:
     any-thread."""
 
     def __init__(self):
-        import threading
 
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._t0 = None  # current interval start
         self.first_start = None
         self.last_end = None
@@ -447,14 +459,13 @@ class RingLog:
     reason as `Counter` (scheduler + watchdog + snapshot threads)."""
 
     def __init__(self, capacity: int = 16):
-        import threading
         from collections import deque
 
         assert capacity >= 1, capacity
         self.capacity = capacity
         self._items = deque(maxlen=capacity)
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
     def add(self, message: str) -> None:
         with self._lock:
@@ -489,11 +500,10 @@ class Gauge:
     reads (the callable owns its own consistency)."""
 
     def __init__(self, fn: Optional[Callable[[], float]] = None):
-        import threading
 
         self._fn = fn
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
     def set(self, value: float) -> None:
         assert self._fn is None, "callback gauge cannot be set"
@@ -538,7 +548,6 @@ class RollingQuantile:
     def __init__(self, window: int = 512,
                  clock: Optional[Callable[[], float]] = None,
                  max_age_s: Optional[float] = None):
-        import threading
         import time as _time
 
         assert window >= 1, window
@@ -549,7 +558,7 @@ class RollingQuantile:
         self._buf = np.zeros(window, np.float64)
         self._ts = np.zeros(window, np.float64)
         self._n = 0  # total ever observed
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
 
     def observe(self, v: float) -> None:
         t = self.clock() if self.max_age_s is not None else 0.0
@@ -656,9 +665,8 @@ class MetricsRegistry:
     the numeric exposition)."""
 
     def __init__(self):
-        import threading
 
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         # name -> list of (labels_dict, metric); list keeps insertion
         # order so renders are stable
         self._families: Dict[str, list] = {}
@@ -1046,7 +1054,6 @@ class MetricsHTTPEndpoint:
     def start(self) -> "MetricsHTTPEndpoint":
         import http.server
         import json as json_mod
-        import threading
 
         endpoint = self
 
@@ -1092,7 +1099,7 @@ class MetricsHTTPEndpoint:
 
         self._httpd = Server((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
+        self._thread = sync.Thread(
             target=self._httpd.serve_forever,
             name="distrifuser-metrics-http", daemon=True,
         )

@@ -40,10 +40,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
+from . import sync
 
 # One synthetic process for the whole service; tracks are "threads".
 _PID = 1
@@ -86,7 +86,7 @@ class Tracer:
         assert capacity >= 1, capacity
         self.clock = clock
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self._records: deque = deque()
         self._open: Dict[int, Dict[str, Any]] = {}
         self._next_trace = 0
@@ -316,7 +316,7 @@ class StepTimeline:
         self.clock = clock
         self.tracer = tracer
         self.track = track
-        self._lock = threading.Lock()
+        self._lock = sync.Lock()
         self.runs: List[Dict[str, Any]] = []
         self._cur: Optional[Dict[str, Any]] = None
         self._phase_of: Optional[Callable[[int], str]] = None

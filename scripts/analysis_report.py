@@ -1,4 +1,4 @@
-"""One schema-1 JSON summary line for the distrilint run.
+"""One schema-1 JSON summary line for the distrilint + distrisched run.
 
 The bench-line convention (scripts/common.py emit_bench_line) applied to
 static analysis: findings by checker and severity, baseline size, and
@@ -6,9 +6,17 @@ stale-entry count, so the trajectory of suppressed debt is trackable
 across PRs exactly like steps/sec and wire bytes are.  A shrinking
 ``baseline_size`` is paid-down debt; a growing one is a review flag.
 
+Since ISSUE 14 the line also carries the CONCURRENCY debt trajectory:
+``races`` / ``deadlocks`` / ``guard_registry_drift`` (raw finding
+counts from the distrisched gate, suppressed included) and
+``schedules_explored`` — pass the gate's ``--json`` report via
+``--concurrency-json`` (what CI does); without it the four keys emit as
+0 with ``schedules_explored`` 0, so the schema is stable either way.
+
 Exit code mirrors the gate (``--gate``): nonzero when the strict run
-would fail (new findings or stale baseline entries), so the report can
-double as the CI step where wiring two commands is awkward.
+would fail (new findings or stale baseline entries — in either the
+static report or the concurrency one), so the report can double as the
+CI step where wiring two commands is awkward.
 """
 
 from __future__ import annotations
@@ -34,7 +42,38 @@ def main() -> int:
                         "`python -m distrifuser_tpu.analysis` instead of "
                         "re-running the checkers (what CI does — the "
                         "jaxpr traces are not free)")
+    parser.add_argument("--concurrency-json", default=None, metavar="PATH",
+                        help="fold in a distrisched gate report "
+                        "(`python -m distrifuser_tpu.analysis.concurrency"
+                        " --json`): races/deadlocks/drift counts and "
+                        "schedules_explored join the schema-1 line, and "
+                        "--gate also fails on its new findings, scenario "
+                        "failures, or stale entries")
     args = parser.parse_args()
+
+    def concurrency_fields():
+        """The schema-1 concurrency keys (zeros without a report) and
+        whether the distrisched gate would fail."""
+        if not args.concurrency_json:
+            return {
+                "schedules_explored": 0,
+                "races": 0,
+                "deadlocks": 0,
+                "guard_registry_drift": 0,
+            }, False
+        import json
+
+        with open(args.concurrency_json) as f:
+            c = json.load(f)
+        fields = {
+            "schedules_explored": c["schedules_explored"],
+            "races": c["races"],
+            "deadlocks": c["deadlocks"],
+            "guard_registry_drift": c["guard_registry_drift"],
+        }
+        failed = bool(c["new"] or c.get("failures", 0)
+                      or c["stale_baseline"])
+        return fields, failed
 
     if args.from_json:
         import json
@@ -46,6 +85,8 @@ def main() -> int:
                    + report.get("suppressed_findings", [])):
             sev = f_.get("severity", "error")
             by_severity[sev] = by_severity.get(sev, 0) + 1
+        conc, conc_failed = concurrency_fields()
+        static_failed = bool(report["new"] or report["stale_baseline"])
         emit_bench_line({
             "bench": "analysis",
             "findings_total": (report["new"] + report["suppressed"]),
@@ -55,9 +96,10 @@ def main() -> int:
             "by_severity": by_severity,
             "baseline_size": report["baseline_size"],
             "stale_baseline": report["stale_baseline"],
-            "clean": not report["new"] and not report["stale_baseline"],
+            **conc,
+            "clean": not static_failed and not conc_failed,
         }, out=args.out)
-        if args.gate and (report["new"] or report["stale_baseline"]):
+        if args.gate and (static_failed or conc_failed):
             return 1
         return 0
 
@@ -89,6 +131,8 @@ def main() -> int:
     by_severity = {}
     for f in findings:
         by_severity[f.severity] = by_severity.get(f.severity, 0) + 1
+    conc, conc_failed = concurrency_fields()
+    static_failed = bool(applied.new or applied.stale)
     emit_bench_line({
         "bench": "analysis",
         "findings_total": len(findings),
@@ -99,9 +143,10 @@ def main() -> int:
         "by_severity": by_severity,
         "baseline_size": len(baseline.entries),
         "stale_baseline": len(applied.stale),
-        "clean": not applied.new and not applied.stale,
+        **conc,
+        "clean": not static_failed and not conc_failed,
     }, out=args.out)
-    if args.gate and (applied.new or applied.stale):
+    if args.gate and (static_failed or conc_failed):
         return 1
     return 0
 

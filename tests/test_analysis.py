@@ -57,10 +57,11 @@ def test_shipped_tree_strict_clean():
     applied = apply_baseline(findings, baseline)
     assert not applied.new, [f.render() for f in applied.new]
     assert not applied.stale, [e.fingerprint for e in applied.stale]
-    # all six checkers actually ran (a crashed checker emits findings)
+    # all seven checkers actually ran (a crashed checker emits findings)
     assert set(results) == {
-        "typed-raises", "collective-containment", "lock-discipline",
-        "compile-identity", "route-tables", "jaxpr-overlap",
+        "typed-raises", "collective-containment", "sync-containment",
+        "lock-discipline", "compile-identity", "route-tables",
+        "jaxpr-overlap",
     }
 
 
