@@ -66,6 +66,10 @@ SERVE_RUNTIME_ALLOWLIST: Dict[str, str] = {
     "max_inflight_batches": "staging HBM cap, host-side semaphore",
     "prompt_cache_capacity": "host-side embedding LRU bound",
     "controller": "sub-config: tier walks rewrite keys via apply_tier",
+    "step_batching": "sub-config: enabled resolves into ExecKey."
+                     "exec_mode='step' in _exec_key_for (compile-"
+                     "distinct); slots/preview/preempt knobs are "
+                     "host-side scheduling policy",
     "resilience": "sub-config: ladder rungs rewrite keys via "
                   "DegradationLadder.apply",
     "observability": "host-side tracing/metrics plane",
@@ -73,10 +77,12 @@ SERVE_RUNTIME_ALLOWLIST: Dict[str, str] = {
 
 #: ExecKey fields _exec_key_for does not thread from ServeConfig —
 #: set only by degradation machinery downstream of key construction.
-LADDER_ONLY_ALLOWLIST: Dict[str, str] = {
-    "exec_mode": "set only by the resilience ladder's stepwise rung "
-                 "(DegradationLadder.apply); ServeConfig has no such knob",
-}
+#: (exec_mode left this list when step-level continuous batching made
+#: the server thread it: ServeConfig.step_batching.enabled keys every
+#: bucket at exec_mode="step", so _exec_key_for passes it and the
+#: key-for station checks it like any other field; the stepwise ladder
+#: rung still rewrites it downstream.)
+LADDER_ONLY_ALLOWLIST: Dict[str, str] = {}
 
 #: ExecKey fields apply_key_policy leaves to build_pipeline: the builder
 #: constructs its DistriConfig/weights from these, and no degradation

@@ -109,10 +109,12 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
             via="RequestQueue._lock hand-off (submit -> scheduler)"),
     },
     "distrifuser_tpu/serve/controller.py": {
-        # observe_batch is documented any-thread; _classes/_service move
-        # under _lock so snapshot() copies are consistent
+        # observe_batch/observe_step are documented any-thread; _classes
+        # and both service rings move under _lock so snapshot() copies
+        # are consistent
         "SLOController": guard(
-            "_lock", ["_classes", "_service", "_service_sum"]),
+            "_lock", ["_classes", "_service", "_service_sum",
+                      "_step_service", "_step_service_sum"]),
     },
     "distrifuser_tpu/serve/promptcache.py": {
         "PromptCache": guard("_lock", ["_entries", "_hits", "_misses"]),
@@ -161,6 +163,27 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
             "_lock",
             ["_inflight", "peak_inflight", "submitted", "completed",
              "failed"]),
+    },
+    "distrifuser_tpu/serve/stepbatch.py": {
+        # the ENTIRE slot pool is scheduler-thread-owned (module
+        # docstring): InferenceServer._loop drives every mutation from
+        # its single step-round loop; gauges/snapshots read under the
+        # blessed snapshot policy.  No lock exists to scan — distrisched
+        # validates the single-owner claim dynamically (the three
+        # stepbatch scenarios run at 85 seeds each in tier-1).
+        "StepBatcher": guard(
+            "_lock",
+            ["_slots", "_parked", "_ewma", "_round_s_total",
+             "_rounds_timed", "joins", "leaves", "preempt_count",
+             "resumes", "rounds"],
+            via="scheduler-thread single owner (InferenceServer._loop "
+                "step rounds; reads are snapshot-blessed)"),
+        "SlotState": guard(
+            "_lock",
+            ["work", "steps_done", "slot", "parked", "preempts",
+             "previews", "first_preview_s"],
+            via="scheduler-thread single owner (mutated only inside "
+                "_step_round paths)"),
     },
     # utils/ classes the serve plane shares across threads (brought under
     # the registry by ISSUE 14's sync_containment migration)

@@ -27,7 +27,8 @@ def main(argv=None) -> int:
                     "(docs/ANALYSIS.md)")
     parser.add_argument("--schedules", type=int, default=50,
                         help="seeded schedules per scenario (seeds "
-                        "0..N-1; default 50 — the CI gate's 250 total)")
+                        "0..N-1; default 50 — the CI gate passes 85 for "
+                        "680 total across the eight scenarios)")
     parser.add_argument("--seed", type=int, default=None,
                         help="replay exactly ONE seed per scenario "
                         "(failure reproduction) instead of the range")

@@ -53,6 +53,7 @@ OBSERVED_MODULES = (
     "distrifuser_tpu.serve.fleet",
     "distrifuser_tpu.serve.replica",
     "distrifuser_tpu.serve.staging",
+    "distrifuser_tpu.serve.stepbatch",
     "distrifuser_tpu.serve.resilience",
     "distrifuser_tpu.serve.cache",
     "distrifuser_tpu.serve.controller",

@@ -23,6 +23,17 @@ end of every one:
 * ``staging_stop_midpipeline`` — stop() against the three-stage
   pipeline with batches in flight: every future resolves, the stage
   workers exit.
+* ``stepbatch_join_while_stepping`` — clients submitting into the
+  step-granular slot pool while it is mid-denoise: every admitted
+  future resolves to the request's own deterministic image (joins
+  around a request never touch its numerics).
+* ``stepbatch_preempt_cancel_race`` — a tight-deadline arrival forcing
+  preemption of the occupied slot while a client concurrently cancels
+  the victim's future: no wedge, the preemptor completes, the victim
+  resolves or stays cancelled — never hangs.
+* ``stepbatch_stop_midpreview`` — stop() against the slot pool while
+  previews are streaming: every future resolves, the scheduler drains
+  occupied AND parked carries deterministically.
 
 Keep scenarios clock-clean: every serve object takes ``ctx.clock``, no
 real sleeps, tick threads off (tick()/housekeeping driven explicitly) —
@@ -245,10 +256,128 @@ def staging_stop_midpipeline(ctx: ScenarioContext) -> None:
         ctx.result(f, tolerate=(ServeError,))
 
 
+def _step_config(**step_kw):
+    from ...utils.config import StepBatchConfig
+
+    step_kw.setdefault("enabled", True)
+    step_kw.setdefault("slots", 2)
+    step_kw.setdefault("step_service_prior_s", 0.01)
+    return _serve_config(step_batching=StepBatchConfig(**step_kw))
+
+
+def stepbatch_join_while_stepping(ctx: ScenarioContext) -> None:
+    """clients joining the in-flight slot pool between steps: every
+    admitted future resolves to ITS OWN deterministic image — who
+    joined or left around a request never touches its numerics."""
+    import numpy as np
+
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory, fake_image
+
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+        _step_config(), clock=ctx.clock)
+    server.start(warmup=False)
+    futures = {}
+
+    def client(i: int) -> None:
+        try:
+            futures[i] = server.submit(f"prompt-{i}", height=64, width=64,
+                                       seed=i)
+        except ServeError:
+            pass  # admission raced the stop: a typed reject is correct
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(4)]
+    for t in clients:
+        t.join()
+    results = {i: ctx.result(f, tolerate=(ServeError,))
+               for i, f in futures.items()}
+    server.stop(timeout=60.0)
+    key = server._exec_key_for(64, 64, 2, cfg=True)
+    for i, r in results.items():
+        if isinstance(r, Exception):
+            continue
+        assert np.array_equal(r.output, fake_image(f"prompt-{i}", i, key)), (
+            f"request {i} got someone else's image under interleaving")
+
+
+def stepbatch_preempt_cancel_race(ctx: ScenarioContext) -> None:
+    """a tight-deadline arrival preempting the only slot while the
+    victim's client concurrently cancels: no wedge, the preemptor
+    completes, the victim resolves or stays cancelled."""
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory
+
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.05),
+        _step_config(slots=1, step_service_prior_s=0.05),
+        clock=ctx.clock)
+    server.start(warmup=False)
+    victim = server.submit("victim", height=64, width=64, seed=0,
+                           num_inference_steps=4, ttl_s=300.0)
+    ctx.wait_until(lambda: server.stepbatch.occupied(), "victim admitted")
+    # needs 4 x 0.05 = 0.2s; ttl 0.3 => waiting out the victim's ~0.2s
+    # remaining would miss, admitted-now makes it: the preemption shape
+    tight = server.submit("tight", height=64, width=64, seed=1,
+                          num_inference_steps=4, ttl_s=0.3)
+    canceller = ctx.spawn("canceller", victim.cancel)
+    # the preemptor must COMPLETE (ctx.result waiting out a hang is the
+    # step budget's job); a typed reject is also legal under some
+    # interleavings — what is not legal is an unresolved future
+    ctx.result(tight, tolerate=(ServeError,))
+    canceller.join()
+    # the victim must SETTLE (result, typed error, or cancelled) — a
+    # preempted-then-cancelled slot must never hang its future
+    ctx.wait_until(victim.done, "victim future settles")
+    server.stop(timeout=60.0)
+    sb = server.stepbatch
+    assert not sb.occupied() and not sb.parked, "slots leaked at stop"
+
+
+def stepbatch_stop_midpreview(ctx: ScenarioContext) -> None:
+    """stop() against the slot pool mid-preview-stream: every future
+    resolves; occupied and parked carries drain deterministically."""
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory
+
+    previews = []
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+        _step_config(preview_interval=1), clock=ctx.clock)
+    server.start(warmup=False)
+    futures = []
+
+    def client(i: int) -> None:
+        try:
+            futures.append(server.submit(
+                f"prompt-{i}", height=64, width=64, seed=i,
+                num_inference_steps=4,
+                on_progress=lambda s, t, img: previews.append((s, t))))
+        except ServeError:
+            pass
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(3)]
+    stopper = ctx.spawn("stopper", lambda: server.stop(timeout=60.0))
+    for t in clients:
+        t.join()
+    stopper.join()
+    server.stop(timeout=60.0)
+    for f in futures:
+        ctx.result(f, tolerate=(ServeError,))
+    sb = server.stepbatch
+    assert not sb.occupied() and not sb.parked, "carries leaked at stop"
+
+
 SCENARIOS: Dict[str, object] = {
     "submit_stop_race": submit_stop_race,
     "failover_exactly_once": failover_exactly_once,
     "drain_completes_inflight": drain_completes_inflight,
     "kill_restart_generation": kill_restart_generation,
     "staging_stop_midpipeline": staging_stop_midpipeline,
+    "stepbatch_join_while_stepping": stepbatch_join_while_stepping,
+    "stepbatch_preempt_cancel_race": stepbatch_preempt_cancel_race,
+    "stepbatch_stop_midpreview": stepbatch_stop_midpreview,
 }

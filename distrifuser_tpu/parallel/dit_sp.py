@@ -698,6 +698,41 @@ class DiTDenoiseRunner:
                          dit_mod.unpatchify(dcfg, x, dcfg.in_channels))
         return dit_mod.unpatchify(dcfg, x, dcfg.in_channels)
 
+    # -- explicit-carry stepwise API (step-granular serve substrate) -------
+
+    def stepwise_carry_init(self, latents, num_steps: int):
+        """Start a host-driven denoise with the carry held EXTERNALLY:
+        ``(x, sstate, kv)`` — the state one `_generate_stepwise`
+        iteration threads, so the step-granular serve layer
+        (serve/stepbatch.py) can park/resume/interleave requests between
+        steps while each carry replays the identical per-step programs."""
+        self.scheduler.set_timesteps(num_steps)
+        x = dit_mod.patchify(self.dcfg, jnp.asarray(latents, jnp.float32))
+        return (x, self.scheduler.init_state(x.shape),
+                self._kv0_global(latents.shape[0]))
+
+    def stepwise_carry_step(self, carry, i: int, enc, cap_mask, gs,
+                            num_steps: int):
+        """Advance one explicit carry by exactly step ``i`` — the SAME
+        compiled stepper `_generate_stepwise` dispatches for this
+        (phase, shallow) signature, so solo and interleaved executions
+        are byte-identical."""
+        cfg = self.cfg
+        x, sstate, kv = carry
+        n_sync = self._exec_phases(num_steps)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        shallow = cfg.step_cache_enabled and is_shallow_at(
+            i, n_sync, cfg.step_cache_interval)
+        return self._ensure_stepper(
+            num_steps, one_phase or i < n_sync, shallow
+        )(self.params, jnp.asarray(i), x, kv, sstate, enc, cap_mask, gs)
+
+    def stepwise_carry_latent(self, carry):
+        """The carry's current GLOBAL latent [B, H/8, W/8, C] (preview +
+        decode input) — does not consume the carry."""
+        return dit_mod.unpatchify(self.dcfg, carry[0],
+                                  self.dcfg.in_channels)
+
     def _fire_callback(self, i, t, x):
         """Host trampoline for the compiled-loop callback (io_callback)."""
         cb = self._active_callback

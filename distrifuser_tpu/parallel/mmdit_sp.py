@@ -643,6 +643,41 @@ class MMDiTDenoiseRunner:
                          dit_mod.unpatchify(mcfg, x, mcfg.out_channels))
         return dit_mod.unpatchify(mcfg, x, mcfg.out_channels)
 
+    # -- explicit-carry stepwise API (step-granular serve substrate) -------
+
+    def stepwise_carry_init(self, latents, num_steps: int):
+        """Start a host-driven denoise with the carry held EXTERNALLY:
+        ``(x, sstate, kv)`` — the state one `_generate_stepwise`
+        iteration threads, so the step-granular serve layer
+        (serve/stepbatch.py) can park/resume/interleave requests between
+        steps while each carry replays the identical per-step programs."""
+        self.scheduler.set_timesteps(num_steps)
+        x = dit_mod.patchify(self.mcfg, jnp.asarray(latents, jnp.float32))
+        return (x, self.scheduler.init_state(x.shape),
+                self._kv0_global(latents.shape[0]))
+
+    def stepwise_carry_step(self, carry, i: int, enc, pooled, gs,
+                            num_steps: int):
+        """Advance one explicit carry by exactly step ``i`` — the SAME
+        compiled stepper `_generate_stepwise` dispatches for this
+        (phase, shallow) signature, so solo and interleaved executions
+        are byte-identical."""
+        cfg = self.cfg
+        x, sstate, kv = carry
+        _, n_sync = self._exec_window(num_steps, 0, None)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        sync = one_phase or i < n_sync
+        shallow = cfg.step_cache_enabled and is_shallow_at(
+            i, n_sync, cfg.step_cache_interval)
+        return self._ensure_stepper(num_steps, sync, shallow)(
+            self.params, jnp.asarray(i), x, kv, sstate, enc, pooled, gs)
+
+    def stepwise_carry_latent(self, carry):
+        """The carry's current GLOBAL latent [B, H/8, W/8, C] (preview +
+        decode input) — does not consume the carry."""
+        return dit_mod.unpatchify(self.mcfg, carry[0],
+                                  self.mcfg.out_channels)
+
     def _build_stale_scan(self, num_steps: int, n_start: int):
         """Fused stale steady-state ONLY (cfg.hybrid_loop; the MMDiT analog
         of DenoiseRunner._build_stale_scan): the sync warmup runs through

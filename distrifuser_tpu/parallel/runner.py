@@ -697,6 +697,39 @@ class DenoiseRunner:
 
         return jax.jit(loop)
 
+    def _stepwise_phase(self, i: int, start_step: int, num_exec_end: int):
+        """(phase, shallow) of step ``i`` in a host-driven loop — a pure
+        function of the step index and config, shared by the in-place
+        stepwise loop and the explicit-carry API so interleaved and
+        contiguous executions replay the identical per-step programs."""
+        cfg = self.cfg
+        sc = cfg.step_cache_enabled
+        one_phase = (cfg.parallelism != "patch" or cfg.mode == "full_sync"
+                     or not cfg.is_sp)
+        n_sync = (num_exec_end - start_step if one_phase and not sc
+                  else min(cfg.warmup_steps + 1, num_exec_end - start_step))
+        phase = (PHASE_SYNC if one_phase or i < start_step + n_sync
+                 else PHASE_STALE)
+        # the same shallow-first pattern run_cadence compiles
+        shallow = sc and is_shallow_at(
+            i, start_step + n_sync, cfg.step_cache_interval
+        )
+        return phase, shallow
+
+    def _stepwise_fn(self, num_steps: int, phase, with_state: bool,
+                     shallow: bool):
+        """The jitted single-step program for one (phase, state, shallow)
+        signature, built on first use and shared by every host-driven
+        loop at this step count."""
+        key = ("stepwise", num_steps)
+        if key not in self._compiled:
+            self._compiled[key] = {}
+        fns = self._compiled[key]
+        fkey = (phase, with_state, shallow)
+        if fkey not in fns:
+            fns[fkey] = self._build_stepwise(phase, with_state, shallow)
+        return fns[fkey]
+
     def _generate_stepwise(self, latents, enc, added, gs, num_steps,
                            start_step=0, end_step=None, callback=None):
         """Python loop over per-step compiled calls (reference no-CUDA-graph
@@ -706,38 +739,58 @@ class DenoiseRunner:
         the diffusers legacy-callback signature; only this mode has a host
         loop to fire it from."""
         num_exec_end = num_steps if end_step is None else end_step
-        cfg = self.cfg
         self.scheduler.set_timesteps(num_steps)
         x = jnp.asarray(latents, jnp.float32)
         sstate = self.scheduler.init_state(x.shape)
         pstate: Any = self._stepwise_state_seed()
-        sc = cfg.step_cache_enabled
-        one_phase = (cfg.parallelism != "patch" or cfg.mode == "full_sync"
-                     or not cfg.is_sp)
-        n_sync = (num_exec_end - start_step if one_phase and not sc
-                  else min(cfg.warmup_steps + 1, num_exec_end - start_step))
-
-        key = ("stepwise", num_steps)
-        if key not in self._compiled:
-            self._compiled[key] = {}
-        fns = self._compiled[key]
         for i in range(start_step, num_exec_end):
-            phase = (PHASE_SYNC if one_phase or i < start_step + n_sync
-                     else PHASE_STALE)
-            # the same shallow-first pattern run_cadence compiles
-            shallow = sc and is_shallow_at(
-                i, start_step + n_sync, cfg.step_cache_interval
-            )
-            with_state = pstate is not None
-            fkey = (phase, with_state, shallow)
-            if fkey not in fns:
-                fns[fkey] = self._build_stepwise(phase, with_state, shallow)
-            x, pstate, sstate = fns[fkey](
+            phase, shallow = self._stepwise_phase(i, start_step,
+                                                  num_exec_end)
+            fn = self._stepwise_fn(num_steps, phase, pstate is not None,
+                                   shallow)
+            x, pstate, sstate = fn(
                 self.params, jnp.asarray(i), x, pstate, sstate, enc, added, gs
             )
             if callback is not None:
                 callback(i, self.scheduler.timesteps()[i], x)
         return x
+
+    # ------------------------------------------------------------------
+    # explicit-carry stepwise API (the step-granular serve substrate)
+    # ------------------------------------------------------------------
+
+    def stepwise_carry_init(self, latents, num_steps: int):
+        """Start a host-driven denoise with the carry held EXTERNALLY:
+        returns ``(x, pstate, sstate)`` — exactly the state one iteration
+        of `_generate_stepwise` threads.  The step-granular serve layer
+        (serve/stepbatch.py) holds one carry per slot, so requests park,
+        resume, and interleave between steps while each carry replays the
+        identical per-step programs a contiguous solo loop runs —
+        bit-identical by construction."""
+        self.scheduler.set_timesteps(num_steps)
+        x = jnp.asarray(latents, jnp.float32)
+        return (x, self._stepwise_state_seed(),
+                self.scheduler.init_state(x.shape))
+
+    def stepwise_carry_step(self, carry, i: int, enc, added, gs,
+                            num_steps: int):
+        """Advance one explicit carry by exactly step ``i``; returns the
+        new carry.  The per-step program is the SAME compiled fn
+        `_generate_stepwise` dispatches for this (phase, state, shallow)
+        signature, so solo, interleaved, and parked-then-resumed
+        executions of one request are byte-identical.  ``enc`` must be
+        dtype-pinned like generate() pins it (the serve executor does)."""
+        x, pstate, sstate = carry
+        phase, shallow = self._stepwise_phase(i, 0, num_steps)
+        fn = self._stepwise_fn(num_steps, phase, pstate is not None,
+                               shallow)
+        return fn(self.params, jnp.asarray(i), x, pstate, sstate, enc,
+                  added, gs)
+
+    def stepwise_carry_latent(self, carry):
+        """The carry's current latent [B, H/8, W/8, C] (preview + decode
+        input) — does not consume the carry."""
+        return carry[0]
 
     # ------------------------------------------------------------------
     # observability

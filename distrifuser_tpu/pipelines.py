@@ -1038,6 +1038,27 @@ class _DistriPipelineBase(_GenerationMixin):
             callback=callback,
         )
 
+    # -- step-granular carry hooks (serve/stepbatch.py; see mixin doc) ----
+    def step_carry_init(self, latents, num_inference_steps):
+        return self.runner.stepwise_carry_init(latents, num_inference_steps)
+
+    def step_carry_step(self, carry, i, enc, guidance_scale,
+                        num_inference_steps):
+        embeds, added = enc
+        # the dtype pinning runner.generate applies before its stepwise
+        # loop — identical inputs => identical per-step programs
+        embeds = jnp.asarray(embeds, self.distri_config.dtype)
+        if added is not None and "text_embeds" in added:
+            added = dict(added)
+            added["text_embeds"] = jnp.asarray(added["text_embeds"],
+                                               self.distri_config.dtype)
+        return self.runner.stepwise_carry_step(
+            carry, i, embeds, added,
+            jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
+
+    def step_carry_latent(self, carry):
+        return self.runner.stepwise_carry_latent(carry)
+
 
 class DistriSDXLPipeline(_DistriPipelineBase):
     """SDXL: two text encoders, penultimate hidden states concatenated, pooled
@@ -1518,6 +1539,24 @@ class DistriPixArtPipeline(_GenerationMixin):
             callback=callback,
         )
 
+    # -- step-granular carry hooks (serve/stepbatch.py) -------------------
+    def step_carry_init(self, latents, num_inference_steps):
+        return self.runner.stepwise_carry_init(latents, num_inference_steps)
+
+    def step_carry_step(self, carry, i, enc, guidance_scale,
+                        num_inference_steps):
+        emb, mask = enc
+        # the mask default + pinning generate() applies before its
+        # stepwise loop — identical inputs => identical per-step programs
+        if mask is None:
+            mask = jnp.ones(emb.shape[:3], jnp.float32)
+        return self.runner.stepwise_carry_step(
+            carry, i, emb, jnp.asarray(mask, jnp.float32),
+            jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
+
+    def step_carry_latent(self, carry):
+        return self.runner.stepwise_carry_latent(carry)
+
 
 def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
     """transformers T5 tokenizer from the snapshot dir, else the hash
@@ -1851,3 +1890,19 @@ class DistriSD3Pipeline(_GenerationMixin):
             start_step=start_step,
             callback=callback,
         )
+
+    # -- step-granular carry hooks (serve/stepbatch.py) -------------------
+    def step_carry_init(self, latents, num_inference_steps):
+        return self.runner.stepwise_carry_init(latents, num_inference_steps)
+
+    def step_carry_step(self, carry, i, enc, guidance_scale,
+                        num_inference_steps):
+        emb, pooled = enc
+        # the pooled pinning _generate_stepwise applies — identical
+        # inputs => identical per-step programs
+        return self.runner.stepwise_carry_step(
+            carry, i, emb, jnp.asarray(pooled),
+            jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
+
+    def step_carry_latent(self, carry):
+        return self.runner.stepwise_carry_latent(carry)

@@ -18,6 +18,11 @@ star: heavy traffic, mesh never idle):
 * `StagePipeline` — staged pipelining (serve/staging.py, behind
   ``ServeConfig.pipeline_stages``): overlap text-encode, denoise, and
   VAE-decode across micro-batches, bit-identical to monolithic dispatch;
+* `StepBatcher` — step-level continuous batching (serve/stepbatch.py,
+  behind ``ServeConfig.step_batching``): the denoise loop as a slot pool
+  of per-request carries — join/leave between steps, EDF reordering,
+  deadline-aware preemption with bit-identical resume, progressive
+  previews every K steps;
 * `PipelineExecutor` — adapter from the repo's pipelines
   (serve/executors.py); `serve.testing` has the weightless fakes;
 * `Replica` + `FleetRouter` — the multi-replica control plane
@@ -39,6 +44,7 @@ from ..utils.config import (
     ObservabilityConfig,
     ResilienceConfig,
     ServeConfig,
+    StepBatchConfig,
 )
 from ..utils.metrics import MetricsRegistry
 from ..utils.trace import StepTimeline, Tracer
@@ -71,6 +77,7 @@ from .faults import FaultPlan, FaultRule, install_fault_plan
 from .fleet import FleetRouter, build_fleet, routing_weight
 from .promptcache import PromptCache
 from .queue import Request, RequestQueue, ServeResult
+from .stepbatch import SlotState, StepBatcher
 from .replica import (
     REPLICA_DRAINING,
     REPLICA_SERVING,
@@ -155,8 +162,11 @@ __all__ = [
     "ServeError",
     "ServeResult",
     "ServerClosedError",
+    "SlotState",
     "StagePipeline",
     "StagedBatch",
+    "StepBatchConfig",
+    "StepBatcher",
     "StepTimeline",
     "TierSpec",
     "Tracer",

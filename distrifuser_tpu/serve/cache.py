@@ -52,7 +52,13 @@ class ExecKey:
     compiled scan, or the host-driven stepwise loop — same numerics, a
     much smaller program; the resilience layer's degradation ladder
     (serve/resilience.py) switches a failing key to "stepwise" as a
-    policy fallback.  ``parallelism`` ("patch" | "pipefusion") and
+    policy fallback.  "step" is the step-granular serve mode
+    (serve/stepbatch.py): the same per-step compiled programs as
+    "stepwise", but driven one step at a time by the slot-pool
+    scheduler with the carry held EXTERNALLY per request — compile-
+    distinct from "fused" (different program set) and kept distinct
+    from "stepwise" so the per-executor ledgers never alias the two
+    dispatch disciplines.  ``parallelism`` ("patch" | "pipefusion") and
     ``pipe_patches`` (0 = the builder's default, one patch per stage)
     are compile-identity fields too: displaced patch parallelism and the
     PipeFusion depth-sharded tick pipeline are entirely different XLA
@@ -93,9 +99,9 @@ class ExecKey:
     pipe_patches: int = 0
 
     def __post_init__(self):
-        if self.exec_mode not in ("fused", "stepwise"):
+        if self.exec_mode not in ("fused", "stepwise", "step"):
             raise ValueError(
-                f"exec_mode must be 'fused' or 'stepwise', got "
+                f"exec_mode must be 'fused', 'stepwise', or 'step', got "
                 f"{self.exec_mode!r}"
             )
         from ..parallel.compress import (
@@ -141,9 +147,10 @@ class ExecKey:
             )
         if self.parallelism == "pipefusion" and self.exec_mode != "fused":
             raise ValueError(
-                "exec_mode='stepwise' does not exist for pipefusion keys "
-                "(no host-driven loop) — the ladder degrades them via "
-                "pipeline_off instead"
+                f"exec_mode={self.exec_mode!r} does not exist for "
+                "pipefusion keys (no host-driven per-step loop) — the "
+                "ladder degrades them via pipeline_off instead, and step "
+                "batching requires patch buckets"
             )
 
     def short(self) -> str:

@@ -224,6 +224,13 @@ class SLOController:
         # full-tier-equivalent service time the predictions scale from
         self._service: List[float] = []
         self._service_sum = 0.0
+        # step-granular calibration (step-level continuous batching,
+        # serve/stepbatch.py): cost-normalized per-STEP service ring —
+        # one cohort step completing in t at mean member cost c
+        # contributes t / c.  Feeds both the EDF slack clock and the
+        # step-mode occupancy prediction below.
+        self._step_service: List[float] = []
+        self._step_service_sum = 0.0
         self._dispatches = (registry.counter("serve_controller_dispatches")
                             if registry is not None else None)
         self._transitions = (
@@ -271,6 +278,27 @@ class SLOController:
             if len(self._service) > self.config.service_window:
                 self._service_sum -= self._service.pop(0)
 
+    def observe_step(self, mean_cost: float, step_s: float) -> None:
+        """Record one cohort denoise step's wall seconds at the cohort's
+        mean tier cost (scheduler thread; step-granular servers call this
+        instead of per-batch observations — occupancy there is per-step,
+        not per-batch)."""
+        v = float(step_s) / max(float(mean_cost), 1e-9)
+        with self._lock:
+            self._step_service.append(v)
+            self._step_service_sum += v
+            if len(self._step_service) > self.config.service_window:
+                self._step_service_sum -= self._step_service.pop(0)
+
+    def step_service_estimate(self) -> Optional[float]:
+        """Calibrated full-tier-equivalent per-STEP service seconds, or
+        None before any step completed (the step batcher then falls back
+        to its own prior/EWMA)."""
+        with self._lock:
+            if not self._step_service:
+                return None
+            return self._step_service_sum / len(self._step_service)
+
     # -- the decision loop (scheduler thread) -------------------------------
 
     def _predicted(self, idx: int, s_full: float, load_batches: float) -> float:
@@ -286,11 +314,41 @@ class SLOController:
             s *= 1.0 - share * self.prompt_cache.hit_rate()
         return s
 
+    def _step_predictor(self, snapshot: Dict[str, Any]):
+        """Step-granular occupancy forward model (step-level continuous
+        batching; the satellite accounting fix): a whole-batch server's
+        request waits out (1 + backlog-in-batches) BATCH services, but a
+        slot-pool request only waits its own steps plus the backlog's
+        steps amortized over the slot width — predicting whole-batch
+        completion there over-escalates tiers by roughly the pool width.
+        Returns predicted(idx) over ``cost x per_step x (own_steps +
+        backlog_steps / slots)``, or None when the snapshot carries no
+        step block (whole-batch server)."""
+        step = snapshot.get("step")
+        if not step:
+            return None
+        calibrated = self.step_service_estimate()
+        per_step = (calibrated if calibrated is not None
+                    else float(step.get("per_step_s", 0.0))
+                    or self.config.service_prior_s / self.batch_hint)
+        own_steps = float(step.get("steps_hint", 1))
+        backlog = (snapshot.get("queue_depth", 0) * own_steps
+                   + float(step.get("remaining_steps_total", 0)))
+        slots = max(1, int(step.get("slots", 1)))
+
+        def predicted(idx: int) -> float:
+            return (self.tiers[idx].cost * per_step
+                    * (own_steps + backlog / slots))
+
+        return predicted
+
     def poll(self, snapshot: Dict[str, Any]) -> None:
         """One decision tick over every known SLO class (scheduler
         thread): walk each class one rung toward the least-degraded tier
         whose predicted latency holds its target, under the hysteresis
-        cooldowns.  ``snapshot`` is `slo_snapshot()`."""
+        cooldowns.  ``snapshot`` is `slo_snapshot()` — when it carries a
+        ``"step"`` occupancy block the step-granular forward model
+        replaces the whole-batch one (see `_step_predictor`)."""
         now = self.clock()
         cfgc = self.config
         s_full = self._effective_service()
@@ -298,6 +356,10 @@ class SLOController:
             snapshot.get("queue_depth", 0) + snapshot.get(
                 "inflight_requests", 0)
         ) / self.batch_hint
+        predicted = self._step_predictor(snapshot)
+        if predicted is None:
+            def predicted(idx: int) -> float:  # noqa: E306 — whole-batch
+                return self._predicted(idx, s_full, load_batches)
         with self._lock:
             classes = set(self._classes)
         classes.update(snapshot.get("classes", {}))
@@ -307,7 +369,7 @@ class SLOController:
             # least-degraded tier whose prediction holds the target
             desired = len(self.tiers)
             for idx in range(len(self.tiers)):
-                if self._predicted(idx, s_full, load_batches) <= target:
+                if predicted(idx) <= target:
                     desired = idx
                     break
             # measured breach forces at least one rung down: the forward
@@ -327,9 +389,7 @@ class SLOController:
                     self._move(cls, st, st.tier + 1, now, "escalate")
             elif desired < st.tier:
                 if (now - st.last_change >= cfgc.retract_cooldown_s
-                        and self._predicted(
-                            min(st.tier - 1, len(self.tiers) - 1), s_full,
-                            load_batches)
+                        and predicted(min(st.tier - 1, len(self.tiers) - 1))
                         <= cfgc.retract_margin * target):
                     self._move(cls, st, st.tier - 1, now, "retract")
 
@@ -396,5 +456,8 @@ class SLOController:
         return {
             "tiers": [t.name for t in self.tiers] + [ADMISSION],
             "service_estimate_s": self.service_estimate(),
+            # step-granular calibration (None until a step-mode server
+            # observed its first cohort step)
+            "step_service_estimate_s": self.step_service_estimate(),
             "classes": classes,
         }

@@ -286,6 +286,133 @@ class PipelineExecutor:
         _release_buffers(work.pop("latent"))
         return list(images)[:work["n_real"]]
 
+    # -- step-granular contract (serve/stepbatch.py) -----------------------
+    #
+    # One request per work: the slot pool holds each request's denoise
+    # carry (latent + patch/KV state + scheduler state) EXTERNALLY and
+    # advances it one step at a time, so requests join/leave/park between
+    # steps.  Every step runs the request padded to the compiled batch
+    # width alone — batch rows are independent end to end (the PR-1
+    # coalescing invariant), so who else occupies the pool can never
+    # touch this request's numerics, and a parked carry resumes
+    # bit-identically: same per-step programs, same inputs, same order.
+
+    def step_begin(self, prompt: str, negative_prompt: str, seed: int,
+                   guidance_scale: float) -> Dict[str, Any]:
+        """Admit one request into step-granular execution: encode (via
+        the prompt cache when attached), draw the request's seeded
+        latent, and initialize the explicit denoise carry.  Returns the
+        work dict `step_run`/`step_finish`/`step_preview` consume."""
+        import jax
+
+        pipe = self.pipeline
+        if not hasattr(pipe, "step_carry_init"):
+            raise AttributeError(
+                f"{type(pipe).__name__} has no step-granular carry hooks "
+                "(PipeFusion runners have no host-driven per-step loop)"
+            )
+        stages = self.prepare_stages()
+        prompts, negs, seeds, _ = self._pad_batch(
+            [prompt], [negative_prompt], [seed])
+        bs = self.batch_size
+        enc = self._encode_chunk(stages, prompts[:bs], negs[:bs])
+        latents = self._draw_latents(seeds[:bs])
+        # __call__ forces guidance_scale to 1 when CFG is off; the step
+        # path applies the same normalization for identity (the exact
+        # rule prepare_stages' denoise program uses)
+        cfg_on = pipe.distri_config.do_classifier_free_guidance
+        carry = pipe.step_carry_init(latents, self.steps)
+        jax.block_until_ready(jax.tree_util.tree_leaves((carry[0], latents)))
+        return {
+            "carry": carry,
+            "enc": enc,
+            "gs": guidance_scale if cfg_on else 1.0,
+            "i": 0,
+            "encode_cached": self.prompt_cache is not None,
+        }
+
+    def step_run(self, works: List[Dict[str, Any]]) -> None:
+        """Advance each work by exactly ONE denoise step (its own step
+        index — cohort members may sit at different timesteps).  Blocks
+        until the cohort's step compute is done so the step batcher's
+        calibrated per-step service time is honest.
+
+        Cohort members currently run as SEQUENTIAL per-slot dispatches,
+        each padded to the compiled width — correctness-first: identical
+        programs and inputs to a solo run, so bit-identity is by
+        construction.  The mesh-throughput form (pack same-step-index
+        members into one dispatch's batch rows — legal by the same
+        row-independence invariant) is ROADMAP item 2's named follow-up;
+        until then step mode trades per-step dispatch overhead for
+        request-shaped latency (docs/PERF.md)."""
+        import jax
+
+        pipe = self.pipeline
+        for w in works:
+            w["carry"] = pipe.step_carry_step(
+                w["carry"], w["i"], w["enc"], w["gs"], self.steps)
+            w["i"] += 1
+        jax.block_until_ready([w["carry"][0] for w in works])
+
+    def step_done(self, work: Dict[str, Any]) -> bool:
+        return work["i"] >= self.steps
+
+    def step_finish(self, work: Dict[str, Any]):
+        """Decode the finished carry to the request's np image (row 0 —
+        the single real request in the padded width)."""
+        stages = self.prepare_stages()
+        pipe = self.pipeline
+        latent = pipe.step_carry_latent(work["carry"])
+        images = stages.decode(latent)
+        _release_buffers(work.pop("carry"))
+        enc = work.pop("enc", None)
+        if not work.get("encode_cached"):
+            # prompt-cache-owned embeddings stay resident for future hits
+            _release_buffers(enc)
+        return list(images)[0]
+
+    def step_abort(self, work: Dict[str, Any]) -> None:
+        """Release a work's device buffers without decoding (failed or
+        stopped mid-denoise) — the step path's `_release_buffers`
+        donation, same convention as the staged pipeline."""
+        _release_buffers(work.pop("carry", None))
+        enc = work.pop("enc", None)
+        if not work.get("encode_cached"):
+            _release_buffers(enc)
+
+    def step_park(self, work: Dict[str, Any]) -> None:
+        """Preemption: pull the carry to HOST memory so the parked
+        request stops holding device residency (the slot it frees goes
+        to the preemptor).  device->host->device is an exact byte
+        round-trip, so the resumed denoise is bit-identical — pinned by
+        tests/test_stepbatch.py."""
+        import jax
+
+        work["carry"] = jax.device_get(work["carry"])
+
+    def step_resume(self, work: Dict[str, Any]) -> None:
+        """Resume a parked carry: nothing to do eagerly — the next
+        `step_run` re-uploads the host leaves through its jitted call,
+        byte-exactly."""
+
+    def step_preview(self, work: Dict[str, Any],
+                     max_size: int = 64):
+        """Cheap intermediate preview: the request's CURRENT latent,
+        host-side — first three latent channels min-max normalized and
+        stride-downsampled to at most ``max_size`` per edge.  No compiled
+        program, no VAE: previews cost O(latent bytes) host work, never
+        mesh time."""
+        import numpy as np
+
+        pipe = self.pipeline
+        lat = np.asarray(pipe.step_carry_latent(work["carry"]))[0]
+        rgb = (lat[..., :3] if lat.shape[-1] >= 3
+               else np.repeat(lat[..., :1], 3, axis=-1))
+        lo, hi = float(rgb.min()), float(rgb.max())
+        rgb = (rgb - lo) / ((hi - lo) or 1.0)
+        stride = max(1, -(-max(rgb.shape[0], rgb.shape[1]) // int(max_size)))
+        return rgb[::stride, ::stride].astype(np.float32)
+
 
 def apply_key_policy(pipeline, key: ExecKey) -> None:
     """Make the built pipeline honor the key's degradation-relevant
@@ -394,7 +521,12 @@ def apply_key_policy(pipeline, key: ExecKey) -> None:
     if (key.quant_compute != getattr(dcfg, "quant_compute", "auto")
             and hasattr(pipeline, "set_quant_compute")):
         pipeline.set_quant_compute(key.quant_compute)
-    if key.exec_mode == "stepwise":
+    if key.exec_mode in ("stepwise", "step"):
+        # both host-driven modes run the per-step compiled programs; the
+        # "step" mode additionally exposes the explicit carry the slot
+        # pool (serve/stepbatch.py) holds per request.  set_stepwise
+        # keeps the monolithic __call__ on the SAME programs, so a solo
+        # monolithic run at this key is bit-identical to the step path.
         try:
             pipeline.set_stepwise(True)
         except ValueError as exc:

@@ -368,6 +368,7 @@ class FleetRouter:
         seed: int = 0,
         ttl_s: Optional[float] = None,
         slo_class: str = "default",
+        on_progress: Optional[Callable[..., Any]] = None,
     ) -> Future:
         """Admit one request to the fleet; returns a Future of
         `ServeResult` (whose ``replica``/``tier``/``exec_key`` fields say
@@ -375,7 +376,9 @@ class FleetRouter:
         replica's admission error — or `NoHealthyReplicaError` when no
         replica can admit at all — immediately; later failures fail over
         transparently and only surface when the failover policy is
-        exhausted."""
+        exhausted.  ``on_progress`` (progressive previews, step-batching
+        replicas only) rides every dispatch, including failover
+        re-dispatches — a preview stream may restart on the new replica."""
         if not self._started or self._stopping:
             raise ServerClosedError("fleet is not running")
         params = dict(
@@ -383,7 +386,7 @@ class FleetRouter:
             negative_prompt=negative_prompt,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, seed=seed, ttl_s=ttl_s,
-            slo_class=slo_class,
+            slo_class=slo_class, on_progress=on_progress,
         )
         ttl = self._default_ttl if ttl_s is None else float(ttl_s)
         fr = _FleetRequest(params=params, future=Future(),

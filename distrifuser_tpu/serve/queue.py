@@ -78,6 +78,12 @@ class Request:
     # tracer-local ids the lifecycle hooks close spans against); None —
     # and completely untouched — when tracing is off
     trace: Any = None
+    # progressive-preview callback (step-level continuous batching,
+    # serve/stepbatch.py): ``on_progress(step, total_steps, preview)``
+    # fires on the SCHEDULER thread every preview_interval steps with a
+    # cheap downsampled-latent image — keep it fast; a slow callback
+    # stalls the whole step loop.  Set at construction, never mutated.
+    on_progress: Any = None
 
     def expired(self, now: float) -> bool:
         return now >= self.deadline
@@ -111,6 +117,15 @@ class ServeResult:
     exec_key: str = ""
     tier: Optional[str] = None
     replica: Optional[str] = None
+    # step-level continuous batching (serve/stepbatch.py): how many
+    # progressive previews this request's on_progress callback received,
+    # the time from enqueue to the FIRST of them (the perceived-latency
+    # number the bench gates), and how many times the request was
+    # preempted mid-denoise (parked + resumed bit-identically).  All
+    # zero/None on whole-batch servers.
+    previews: int = 0
+    first_preview_s: Optional[float] = None
+    preempts: int = 0
 
 
 class RequestQueue:
@@ -199,6 +214,31 @@ class RequestQueue:
                     kept.append(r)
             self._items = kept
             return taken
+
+    def peek_best(self, score: Callable[[Request], float]) -> Optional[Request]:
+        """The queued request minimizing ``score`` (ties broken by
+        arrival order — min() returns the first), NOT removed.  The
+        step-granular scheduler's EDF admission: deadline slack
+        deliberately supersedes FIFO there, because a slot pool has no
+        compatibility classes to keep ordered — fill and preemption peek
+        the tightest-slack candidate, weigh it against parked carries or
+        a potential victim, and only then `remove` it (single consumer:
+        the scheduler thread is the only popper, so peek-then-remove
+        cannot race another taker)."""
+        with self._lock:
+            if not self._items:
+                return None
+            return min(self._items, key=score)
+
+    def remove(self, req: Request) -> bool:
+        """Remove one specific request (identity match); False if it is
+        no longer queued."""
+        with self._lock:
+            for i, r in enumerate(self._items):
+                if r is req:
+                    del self._items[i]
+                    return True
+            return False
 
     def close(self) -> List[Request]:
         """Stop admitting; return whatever was still queued (the server

@@ -240,6 +240,36 @@ class InferenceServer:
         self.registry.gauge(
             "serve_watchdog_timeouts",
             lambda: float(self.resilience.watchdog.timeouts))
+        # Step-level continuous batching (serve/stepbatch.py): the denoise
+        # loop becomes a slot pool of per-request carries — requests join
+        # and leave BETWEEN STEPS, EDF reorders the cohort, low-slack
+        # arrivals preempt the slackest slot, and occupied slots stream
+        # progressive previews.  None when off — the whole-batch dispatch
+        # path runs zero step-pool code, the tracer/controller convention.
+        self.stepbatch = None
+        if self.config.step_batching.enabled:
+            from .stepbatch import StepBatcher
+
+            self.stepbatch = StepBatcher(
+                self.config.step_batching,
+                clock=clock,
+                # calibrated per-step service from the PR-9 controller
+                # when it is on (EDF's clock unit); the batcher's own
+                # EWMA otherwise
+                step_estimate=(self.controller.step_service_estimate
+                               if self.controller is not None else None),
+            )
+            self.hist_first_preview = self.registry.histogram(
+                "serve_latency_seconds", labels={"phase": "first_preview"})
+            self.registry.gauge(
+                "serve_slot_occupied",
+                lambda: float(len(self.stepbatch.occupied())))
+            self.registry.gauge(
+                "serve_slot_parked",
+                lambda: float(len(self.stepbatch.parked)))
+            self.registry.gauge(
+                "serve_slot_capacity",
+                lambda: float(self.config.step_batching.slots))
         # Staged pipelining (serve/staging.py): three stage workers overlap
         # text-encode, denoise, and VAE-decode across micro-batches.  The
         # scheduler thread submits and drains outcome events; futures
@@ -413,6 +443,11 @@ class InferenceServer:
                               if parallelism == "patch" else 1.0),
             weight_quant=self.config.weight_quant,
             quant_compute=self.config.quant_compute,
+            # the step-granular dispatch discipline is compile-distinct:
+            # a slot-pool server's executors run the per-step programs
+            # with an explicit external carry, never the fused scan
+            exec_mode=("step" if self.config.step_batching.enabled
+                       else "fused"),
             parallelism=parallelism,
             pipe_patches=pipe_patches,
         )
@@ -438,6 +473,7 @@ class InferenceServer:
         seed: int = 0,
         ttl_s: Optional[float] = None,
         slo_class: str = "default",
+        on_progress: Optional[Callable[..., Any]] = None,
     ) -> Future:
         """Admit one request; returns a Future of `ServeResult`.
 
@@ -450,7 +486,13 @@ class InferenceServer:
 
         ``slo_class`` tags the request for the per-class rolling-latency
         windows (`slo_snapshot`) — the signal the SLO controller steers
-        on; it does NOT affect scheduling today."""
+        on; it does NOT affect scheduling today.
+
+        ``on_progress(step, total_steps, preview)`` — progressive
+        previews (step-level continuous batching only): fires on the
+        scheduler thread every ``step_batching.preview_interval`` steps
+        with a cheap downsampled-latent image.  Keep it fast; ignored on
+        whole-batch servers."""
         if not self._started or self._stop.is_set():
             raise ServerClosedError("server is not running")
         if self.controller is not None and not self.controller.admit(
@@ -478,6 +520,7 @@ class InferenceServer:
             slo_class=str(slo_class),
             deadline=self.clock() + ttl,
             enqueue_ts=self.clock(),
+            on_progress=on_progress,
         )
         if self.tracer is not None:
             self._trace_submit(req, steps)
@@ -591,6 +634,30 @@ class InferenceServer:
         # loudly in metrics and keep serving, never die silently.
         import traceback
 
+        if self.stepbatch is not None:
+            # step-level continuous batching: the slot-pool round loop
+            # replaces whole-batch dispatch entirely for this server
+            try:
+                while not self._stop.is_set():
+                    try:
+                        if self.controller is not None:
+                            self.controller.poll(self.slo_snapshot())
+                        busy = self._step_round()
+                    except Exception:  # noqa: BLE001
+                        self.counters.inc("scheduler_errors")
+                        traceback.print_exc()
+                        continue
+                    if not busy:
+                        # idle: sleep until an arrival (or the stop flag's
+                        # next check) instead of spinning the pool
+                        self.queue.wait_nonempty(0.05)
+            finally:
+                # deterministic drain on the owner thread: every resident
+                # carry (occupied AND parked) resolves its future — the
+                # step-mode analog of close() draining the queue
+                self._step_drain()
+            return
+
         while not self._stop.is_set():
             try:
                 # staged outcomes ride an event queue back here: the
@@ -621,6 +688,541 @@ class InferenceServer:
                 self.counters.inc("scheduler_errors")
                 traceback.print_exc()
                 self._fail_batch(batch, exc)
+
+    # -- the step-granular scheduling round (serve/stepbatch.py) -----------
+    #
+    # One round = reap -> fill -> preempt -> advance one step -> previews
+    # -> retire.  Everything here runs on the single scheduler thread; the
+    # slot pool is its private state (lock-discipline registry entry).
+
+    def _step_round(self) -> bool:
+        """One slot-pool scheduling round; returns whether any work
+        happened (False lets the loop sleep on the queue condition)."""
+        sb = self.stepbatch
+        now = self.clock()
+        busy = False
+        for req in self.queue.pop_expired(now):
+            self._reject(req, DeadlineExceededError(
+                f"request {req.request_id} expired after "
+                f"{now - req.enqueue_ts:.3f}s in queue"
+            ))
+            busy = True
+        busy = self._step_reap(now) or busy
+        busy = self._step_fill(now) or busy
+        busy = self._step_preempt(now) or busy
+        cohort = sb.cohort(self.clock())
+        if cohort:
+            sb.rounds += 1
+            stepped = self._step_advance(cohort)
+            if stepped:
+                self._step_previews(stepped)
+            self._step_retire_finished()
+            busy = True
+        return busy
+
+    def _step_slack_score(self, now: float):
+        sb = self.stepbatch
+
+        def score(req: Request) -> float:
+            return sb.request_slack(req, now)
+
+        return score
+
+    def _step_release(self, state, *, abort: bool) -> None:
+        """Common teardown for one slot state leaving the pool: buffers,
+        pin, pool membership, inflight gauge."""
+        self.stepbatch.remove(state)
+        self._inflight_c.inc("requests", -1)
+        if abort:
+            try:
+                state.executor.step_abort(state.work)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+        self.cache.unpin(state.executor)
+
+    def _step_fail_state(self, state, exc: Exception) -> None:
+        outcome = self._OUTCOMES.get(type(exc).__name__,
+                                     type(exc).__name__)
+        self._step_release(state, abort=True)
+        self._trace_finish(state.request, outcome)
+        self._resolve(state.request.future, exc=exc)
+
+    def _step_fail_group_deferred(self, members, exc: Exception,
+                                  after) -> None:
+        """Fail a watchdog-ABANDONED cohort group: resolve the futures
+        and free the slots NOW, but defer every member's buffer release
+        and executor unpin behind the orphaned worker's done-event with
+        ONE waiter thread — the staged pipeline's deferral protocol (the
+        abandoned thread still mutates the work dicts and runs the
+        compiled program; freeing either under it would be a
+        use-after-free)."""
+        outcome = self._OUTCOMES.get(type(exc).__name__,
+                                     type(exc).__name__)
+        for m in members:
+            self.stepbatch.remove(m)
+            self._inflight_c.inc("requests", -1)
+            self._trace_finish(m.request, outcome)
+            self._resolve(m.request.future, exc=exc)
+
+        def waiter(_members=list(members), _ev=after):
+            _ev.wait()
+            for m in _members:
+                try:
+                    m.executor.step_abort(m.work)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                self.cache.unpin(m.executor)
+
+        sync.Thread(target=waiter, name="serve-step-deferred-release",
+                    daemon=True).start()
+
+    def _step_reap(self, now: float) -> bool:
+        """Drop cancelled futures (client gave up — free the slot early)
+        and fail PARKED states whose deadline lapsed: a parked request is
+        not on the mesh, so the in-flight completes-late exemption does
+        not apply to it."""
+        sb = self.stepbatch
+        busy = False
+        for state in list(sb.occupied()) + list(sb.parked):
+            if state.request.future.cancelled():
+                self.counters.inc("step_cancelled")
+                self._step_release(state, abort=True)
+                self._trace_finish(state.request, "cancelled")
+                busy = True
+            elif state.parked and state.request.expired(now):
+                self.counters.inc("rejected_deadline")
+                self._step_fail_state(state, DeadlineExceededError(
+                    f"request {state.request.request_id} expired while "
+                    f"parked at step {state.steps_done}/"
+                    f"{state.steps_total}"
+                ))
+                busy = True
+        return busy
+
+    def _step_fill(self, now: float) -> bool:
+        """Fill free slots in ascending-slack (EDF) order from the parked
+        list and the queue jointly — a resumed carry competes with fresh
+        arrivals on the same deadline math."""
+        sb = self.stepbatch
+        busy = False
+        score = self._step_slack_score(now)
+        while sb.free_slots() > 0:
+            parked = (min(sb.parked, key=lambda s: sb.state_slack(s, now))
+                      if sb.parked else None)
+            queued = self.queue.peek_best(score)
+            take_parked = parked is not None and (
+                queued is None
+                or sb.state_slack(parked, now) <= score(queued))
+            if take_parked:
+                try:
+                    parked.executor.step_resume(parked.work)
+                except Exception as exc:  # noqa: BLE001 — typed fail
+                    self.counters.inc("failed_execute")
+                    self._step_fail_state(parked, ExecuteFailedError(
+                        f"step resume failed for {parked.ekey.short()}: "
+                        f"{type(exc).__name__}: {exc}"))
+                    busy = True
+                    continue
+                sb.unpark(parked)
+                self.counters.inc("step_resumes")
+                if self.tracer is not None and parked.request.trace:
+                    rt = parked.request.trace
+                    self.tracer.event("resume", track=rt.track,
+                                      trace=rt.trace_id,
+                                      args={"step": parked.steps_done})
+                busy = True
+            elif queued is not None:
+                if not self.queue.remove(queued):
+                    break  # raced close(); the drain path owns it now
+                self._step_admit(queued, now)
+                busy = True
+            else:
+                break
+        return busy
+
+    def _step_request_key(self, req: Request):
+        """The ONE derivation of a request's admission identity — bucket
+        snap -> base key -> controller tier — shared by `_step_admit` and
+        the preemption pre-check so the two can never drift.  Returns
+        ``(bucket, base_key, tier_idx)``; raises `NoBucketError`."""
+        bh, bw = self.batcher.table.snap(req.height, req.width)
+        base_key = self._exec_key_for(bh, bw, req.num_inference_steps,
+                                      cfg=req.guidance_scale > 1.0)
+        tier_idx = None
+        if self.controller is not None:
+            from .controller import apply_tier
+
+            tier_idx, tier = self.controller.tier_for_batch([req.slo_class])
+            base_key = apply_tier(base_key, tier)
+        return (bh, bw), base_key, tier_idx
+
+    def _step_admit(self, req: Request, now: float) -> bool:
+        """Admit one request into a free slot: snap, tier-map, breaker
+        gate, pinned executor fetch, and `step_begin` (encode + seeded
+        latent + carry init).  Failures are ONE terminal dispatch failure
+        (no step-granular retry loop — the staged-pipeline convention),
+        with the ladder advancing on OOM/compile kinds."""
+        sb = self.stepbatch
+        try:
+            (bh, bw), base_key, tier_idx = self._step_request_key(req)
+        except NoBucketError as exc:
+            self._reject(req, exc)
+            return False
+        if not self.resilience.allow(base_key):
+            self._shed(base_key, [req])
+            return False
+        ekey = self.resilience.degraded_key(base_key)
+        try:
+            executor, hit = self.cache.get(ekey, pin=True)
+        except Exception as exc:  # noqa: BLE001 — typed below
+            bexc = exc if isinstance(exc, ServeError) else BuildFailedError(
+                f"executor build failed for {ekey.short()}: "
+                f"{type(exc).__name__}: {exc}")
+            self._step_admit_failure(req, base_key, ekey, bexc,
+                                     invalidate=False)
+            return False
+        if not hasattr(executor, "step_begin"):
+            self.cache.unpin(executor)
+            self._step_admit_failure(req, base_key, ekey, BuildFailedError(
+                f"executor for {ekey.short()} has no step-granular "
+                "contract (step_begin/step_run) — step batching needs a "
+                "patch-parallel pipeline or a step-capable fake"),
+                invalidate=False)
+            return False
+        try:
+            work = executor.step_begin(req.prompt, req.negative_prompt,
+                                       req.seed, req.guidance_scale)
+        except Exception as exc:  # noqa: BLE001 — typed below
+            self.cache.unpin(executor)
+            wexc = exc if isinstance(exc, ServeError) else (
+                ResourceExhaustedError(
+                    f"step admit OOM for {ekey.short()}: {exc}")
+                if is_oom(exc) else ExecuteFailedError(
+                    f"step admit failed for {ekey.short()}: "
+                    f"{type(exc).__name__}: {exc}"))
+            self._step_admit_failure(req, base_key, ekey, wexc,
+                                     invalidate=True)
+            return False
+        from .stepbatch import SlotState
+
+        state = SlotState(
+            request=req, work=work, base_key=base_key, ekey=ekey,
+            executor=executor, compile_hit=hit, steps_total=ekey.steps,
+            tier_idx=tier_idx, admit_ts=self.clock(),
+        )
+        slot = sb.admit(state)
+        self._inflight_c.inc("requests", 1)
+        req.bucket = (bh, bw)
+        req.dequeue_ts = state.admit_ts
+        self.counters.inc("step_joins")
+        if tier_idx is not None:
+            self.controller.count_dispatch(tier_idx, 1)
+        if self.tracer is not None and req.trace is not None:
+            rt = req.trace
+            if rt.queue_span is not None:
+                self.tracer.end(rt.queue_span, t=state.admit_ts)
+                rt.queue_span = None
+            self.tracer.event("join", track=rt.track, trace=rt.trace_id,
+                              args={"slot": slot, "key": ekey.short(),
+                                    "steps": state.steps_total})
+        return True
+
+    def _step_admit_failure(self, req: Request, base_key: ExecKey,
+                            ekey: ExecKey, exc: Exception,
+                            invalidate: bool) -> None:
+        self.resilience.on_failure(base_key, exc)
+        kind = failure_kind(exc)
+        if kind in ("oom", "compile"):
+            rung = self.resilience.degrade(base_key, kind, 1)
+            if rung is not None:
+                self.counters.inc("degraded_" + rung)
+                if invalidate:
+                    self.cache.invalidate(ekey)
+        self.counters.inc("failed_build"
+                          if isinstance(exc, BuildFailedError)
+                          else "failed_execute")
+        self._fail_batch([req], exc)
+
+    def _step_preempt(self, now: float) -> bool:
+        """Deadline-aware preemption: when the pool is full and the
+        tightest queued request would miss its deadline waiting for the
+        earliest natural free slot — but still makes it if admitted now —
+        park the slackest occupied slot (bit-identical resume later) and
+        admit the newcomer.  At most one preemption per round."""
+        sb = self.stepbatch
+        if (not self.config.step_batching.allow_preemption
+                or sb.free_slots() > 0):
+            return False
+        occupied = sb.occupied()
+        if not occupied:
+            return False
+        cand = self.queue.peek_best(self._step_slack_score(now))
+        if cand is None:
+            return False
+        slack_now = sb.request_slack(cand, now)
+        if slack_now < 0:
+            return False  # already doomed — preempting trades a second miss
+        min_remaining = min(s.remaining for s in occupied)
+        waits_out = sb.slack(cand.deadline,
+                             cand.num_inference_steps + min_remaining, now)
+        if waits_out >= 0:
+            return False  # waiting is safe; don't pay the park
+        # cheap admission pre-checks BEFORE touching a victim: a newcomer
+        # its bucket table or circuit breaker would reject anyway must
+        # not cost an innocent slot a carry round-trip and its one-time
+        # no-thrash budget — SAME derivation as _step_admit, so the two
+        # gates cannot drift
+        try:
+            _, cand_key, _ = self._step_request_key(cand)
+        except NoBucketError:
+            return False  # the regular fill path rejects it typed
+        if not self.resilience.allow(cand_key):
+            return False  # shedding would free no slot for the newcomer
+        victim = sb.pick_victim(slack_now, now)
+        if victim is None:
+            return False
+        try:
+            victim.executor.step_park(victim.work)
+        except Exception as exc:  # noqa: BLE001 — typed fail, no park
+            self.counters.inc("failed_execute")
+            self._step_fail_state(victim, ExecuteFailedError(
+                f"step park failed for {victim.ekey.short()}: "
+                f"{type(exc).__name__}: {exc}"))
+            return True
+        sb.park(victim)
+        self.counters.inc("step_preempts")
+        if self.tracer is not None and victim.request.trace is not None:
+            rt = victim.request.trace
+            self.tracer.event("preempt", track=rt.track, trace=rt.trace_id,
+                              args={"step": victim.steps_done,
+                                    "by": cand.request_id})
+        admitted = (self.queue.remove(cand)
+                    and self._step_admit(cand, now))
+        if not admitted and sb.free_slots() > 0:
+            # the preemption fizzled past the pre-checks (build/encode
+            # failure, raced close): give the victim its slot — and its
+            # no-thrash budget — straight back instead of leaving it
+            # parked for a vacant pool
+            sb.unpark(victim)
+            sb.resumes -= 1
+            sb.preempt_count -= 1
+            victim.preempts -= 1
+            self.counters.inc("step_preempts", -1)
+            try:
+                victim.executor.step_resume(victim.work)
+            except Exception as exc:  # noqa: BLE001 — typed fail
+                self.counters.inc("failed_execute")
+                self._step_fail_state(victim, ExecuteFailedError(
+                    f"step resume failed for {victim.ekey.short()}: "
+                    f"{type(exc).__name__}: {exc}"))
+        return True
+
+    def _step_advance(self, cohort) -> list:
+        """Advance the cohort one denoise step, grouped by executor (a
+        group shares one compiled program; its step is one watchdog-
+        bounded mesh dispatch).  A group failure is ONE terminal dispatch
+        failure for every member — no step-granular retry.  Returns the
+        states that actually stepped."""
+        sb = self.stepbatch
+        stepped = []
+        groups: Dict[int, list] = {}
+        for state in cohort:
+            groups.setdefault(id(state.executor), []).append(state)
+        round_t0 = self.clock()
+        for members in groups.values():
+            executor = members[0].executor
+            ekey = members[0].ekey
+            base_key = members[0].base_key
+            works = [m.work for m in members]
+
+            def call(_ex=executor, _works=works, _ekey=ekey):
+                if self.fault_plan is not None:
+                    self.fault_plan.check("execute", key=_ekey,
+                                          batch_size=len(_works))
+                _ex.step_run(_works)
+
+            wd = self.resilience.watchdog
+            prev_abandoned = wd.abandoned_event
+            try:
+                wd.run(call)
+            except Exception as exc:  # noqa: BLE001 — typed below
+                # a FRESH abandonment means the watchdog's orphaned
+                # thread is still executing THIS group's step: the
+                # members' buffer release and executor unpin must wait
+                # for it (the staged pipeline's deferral protocol)
+                abandoned = wd.abandoned_event
+                fresh_abandon = (isinstance(exc, WatchdogTimeoutError)
+                                 and abandoned is not None
+                                 and abandoned is not prev_abandoned)
+                if isinstance(exc, WatchdogTimeoutError):
+                    self.counters.inc("watchdog_timeouts")
+                    texc = exc
+                elif isinstance(exc, ServeError):
+                    texc = exc
+                elif is_oom(exc):
+                    texc = ResourceExhaustedError(
+                        f"step execute OOM for {ekey.short()} at cohort "
+                        f"{len(works)}: {exc}")
+                else:
+                    texc = ExecuteFailedError(
+                        f"step execute failed for {ekey.short()}: "
+                        f"{type(exc).__name__}: {exc}")
+                # one terminal dispatch failure for the whole group
+                # (members share base_key through the shared executor)
+                self.resilience.on_failure(base_key, texc)
+                kind = failure_kind(texc)
+                if kind in ("oom", "compile"):
+                    rung = self.resilience.degrade(base_key, kind, 1)
+                    if rung is not None:
+                        self.counters.inc("degraded_" + rung)
+                        self.cache.invalidate(ekey)
+                self.counters.inc("failed_execute", len(members))
+                if fresh_abandon:
+                    self._step_fail_group_deferred(members, texc,
+                                                   abandoned)
+                else:
+                    for m in members:
+                        self._step_fail_state(m, texc)
+                continue
+            self.resilience.on_success(base_key)
+            for m in members:
+                m.steps_done += 1
+                stepped.append(m)
+            self.counters.inc("steps_executed", len(members))
+        if stepped:
+            # calibrate on the WHOLE round, not per executor group: the
+            # EDF clock unit is "one more step for this slot", and a slot
+            # advances once per round — a round that serially dispatches
+            # three bucket groups costs the sum, and slack math priced at
+            # a single group's time would flatter every deadline
+            round_dt = self.clock() - round_t0
+            sb.note_round(round_dt)
+            if self.controller is not None:
+                costs = [self.controller.tiers[
+                    min(m.tier_idx or 0, len(self.controller.tiers) - 1)
+                ].cost for m in stepped]
+                self.controller.observe_step(sum(costs) / len(costs),
+                                             round_dt)
+        return stepped
+
+    def _step_previews(self, stepped) -> None:
+        """Emit progressive previews for stepped slots that are due: a
+        cheap host-side downsampled latent through the request's
+        on_progress callback, traced as its own span.  Callback errors
+        are counted, never fatal — a client's slow/broken callback must
+        not take down the step loop."""
+        k = self.config.step_batching.preview_interval
+        if not k:
+            return
+        for state in stepped:
+            req = state.request
+            if req.on_progress is None or state.steps_done % k:
+                continue
+            t0 = self.clock()
+            try:
+                img = state.executor.step_preview(
+                    state.work, self.config.step_batching.preview_size)
+                req.on_progress(state.steps_done, state.steps_total, img)
+            except Exception:  # noqa: BLE001 — counted, never fatal
+                self.counters.inc("preview_errors")
+                continue
+            t1 = self.clock()
+            state.previews += 1
+            self.counters.inc("step_previews")
+            if state.first_preview_s is None:
+                state.first_preview_s = t1 - req.enqueue_ts
+                self.hist_first_preview.observe(state.first_preview_s)
+            if self.tracer is not None and req.trace is not None:
+                rt = req.trace
+                self.tracer.complete("preview", t0, t1, track=rt.track,
+                                     trace=rt.trace_id, parent=rt.root,
+                                     args={"step": state.steps_done,
+                                           "of": state.steps_total})
+
+    def _step_retire_finished(self) -> None:
+        """Decode + resolve every occupied slot whose denoise finished —
+        the leave side of continuous batching, freeing slots for the next
+        round's joiners."""
+        for state in list(self.stepbatch.occupied()):
+            if state.steps_done < state.steps_total:
+                continue
+            try:
+                out = state.executor.step_finish(state.work)
+            except Exception as exc:  # noqa: BLE001 — typed fail
+                texc = exc if isinstance(exc, ServeError) else (
+                    ExecuteFailedError(
+                        f"step decode failed for {state.ekey.short()}: "
+                        f"{type(exc).__name__}: {exc}"))
+                self.resilience.on_failure(state.base_key, texc)
+                self.counters.inc("failed_execute")
+                self._step_fail_state(state, texc)
+                continue
+            self._step_complete(state, out, self.clock())
+
+    def _step_complete(self, state, out, t1: float) -> None:
+        """Success bookkeeping for one step-granular request — the
+        request-shaped mirror of `_complete_batch`."""
+        req = state.request
+        queue_wait = state.admit_ts - req.enqueue_ts
+        exec_s = t1 - state.admit_ts
+        e2e = t1 - req.enqueue_ts
+        self.hist_queue_wait.observe(queue_wait)
+        self.hist_execute.observe(exec_s)
+        self.hist_e2e.observe(e2e)
+        self.slo_window(req.slo_class).observe(e2e)
+        self.counters.inc("completed")
+        self.counters.inc("requests_compile_hit" if state.compile_hit
+                          else "requests_compile_miss")
+        self.counters.inc("denoise_steps_total", state.steps_total)
+        if req.expired(t1):
+            self.counters.inc("completed_late")
+        tier_name = (self.controller.tiers[state.tier_idx].name
+                     if state.tier_idx is not None
+                     and self.controller is not None else None)
+        degradations = tuple(
+            self.resilience.key_state(state.base_key).rungs)
+        if req.trace is not None and self.tracer is not None:
+            rt = req.trace
+            self.tracer.complete(
+                "execute", state.admit_ts, t1, track=rt.track,
+                trace=rt.trace_id, parent=rt.root,
+                args={"bucket": f"{state.ekey.height}x{state.ekey.width}",
+                      "steps": state.steps_total,
+                      "preempts": state.preempts,
+                      "compile_hit": state.compile_hit})
+            self._trace_finish(req, "completed", args={
+                "previews": state.previews,
+                "preempts": state.preempts})
+        result = ServeResult(
+            request_id=req.request_id,
+            output=out,
+            bucket=(state.ekey.height, state.ekey.width),
+            requested_size=(req.height, req.width),
+            queue_wait_s=queue_wait,
+            execute_s=exec_s,
+            e2e_s=e2e,
+            batch_size=1,
+            compile_hit=state.compile_hit,
+            retries=0,
+            degradations=degradations,
+            exec_key=state.ekey.short(),
+            tier=tier_name,
+            replica=self.replica_name,
+            previews=state.previews,
+            first_preview_s=state.first_preview_s,
+            preempts=state.preempts,
+        )
+        self._step_release(state, abort=False)
+        self._resolve(req.future, result=result)
+
+    def _step_drain(self) -> None:
+        """Deterministic stop: every resident carry (occupied + parked)
+        resolves its future with `ServerClosedError` and releases its
+        buffers — no step-mode future is ever left unresolved."""
+        sb = self.stepbatch
+        for state in list(sb.occupied()) + list(sb.parked):
+            self.counters.inc("rejected_server_closed")
+            self._step_fail_state(state, ServerClosedError("server stopped"))
 
     # -- the resilient execute path ---------------------------------------
 
@@ -1108,12 +1710,27 @@ class InferenceServer:
         # poll this, and a scrape must not pay for every histogram
         for lbls, window in self.registry.family("serve_slo_e2e_seconds"):
             classes[lbls.get("slo_class", "default")] = window.snapshot()
-        return {
+        snap = {
             "queue_depth": len(self.queue),
             "inflight_requests": self._inflight_c.get("requests"),
             "slo_window": self._slo_window,
             "classes": classes,
         }
+        if self.stepbatch is not None:
+            # step-granular occupancy block: the controller's forward
+            # model switches to per-step accounting when this is present
+            # (SLOController._step_predictor) — occupancy is per-step,
+            # not per-batch, on a slot-pool server
+            sb = self.stepbatch
+            snap["step"] = {
+                "slots": self.config.step_batching.slots,
+                "occupied": len(sb.occupied()),
+                "parked": len(sb.parked),
+                "remaining_steps_total": sb.remaining_steps_total(),
+                "per_step_s": sb.per_step_s(),
+                "steps_hint": self.config.default_steps,
+            }
+        return snap
 
     def metrics_prometheus(self) -> str:
         """The unified registry in Prometheus text exposition format —
@@ -1252,6 +1869,10 @@ class InferenceServer:
             # fraction (None on monolithic servers)
             "staging": (self.staging.snapshot()
                         if self.staging is not None else None),
+            # slot-pool state + join/leave/preempt/resume lifetime
+            # counters (None on whole-batch servers)
+            "step_batching": (self.stepbatch.snapshot()
+                              if self.stepbatch is not None else None),
             # the tracing + SLO plane (docs/OBSERVABILITY.md): trace ring
             # stats (None when tracing is off) and the rolling-window SLO
             # signals the closed-loop controller reads

@@ -125,6 +125,96 @@ class FakeExecutorFactory:
         return [n for ex in self.executors for n in ex.batch_sizes]
 
 
+def fake_preview(prompt: str, seed: int, key: ExecKey,
+                 step: int) -> np.ndarray:
+    """Deterministic tiny preview for (prompt, seed, key, step): a 4x4x3
+    float array — a pure function, so preview streams replay exactly."""
+    h = zlib.crc32(
+        f"preview|{prompt}|{seed}|{key.height}x{key.width}|{key.steps}|"
+        f"{step}".encode()
+    )
+    rng = np.random.RandomState(h % (2**31))
+    return rng.rand(4, 4, 3).astype(np.float32)
+
+
+class StepFakeExecutor(FakeExecutor):
+    """Serve-executor fake implementing the step-granular contract
+    (serve/stepbatch.py) alongside the monolithic ``__call__``.
+
+    One `step_run` call advances its whole cohort one denoise step and
+    sleeps ONE key-aware step time (``effective_service_s() / steps``)
+    regardless of cohort size — a batched step costs one pass, the same
+    coalescing premise `FakeExecutor.__call__` models for whole batches.
+    That is what makes continuous mode measurably request-shaped on the
+    fakes: a joiner rides the next cohort step instead of waiting out a
+    whole batch.  NOTE this models the TARGET cohort cost: the real
+    `PipelineExecutor.step_run` currently dispatches per slot (cohort
+    row-packing is ROADMAP item 2's named follow-up), so fake-measured
+    ratios are scheduler-shape numbers, not real-mesh throughput.
+    Outputs are `fake_image` either way, so solo, joined,
+    preempted-and-resumed, and monolithic runs are byte-identical by
+    construction — the scheduler behavior is what the tests interrogate.
+
+    ``step_calls`` records every cohort step's size; ``park_calls`` /
+    ``resume_calls`` count the preemption hand-offs.
+    """
+
+    def __init__(self, key: ExecKey, batch_size: int = 8,
+                 step_time_s: float = 0.0):
+        super().__init__(key, batch_size=batch_size,
+                         step_time_s=step_time_s)
+        self.step_calls: List[int] = []
+        self.park_calls = 0
+        self.resume_calls = 0
+
+    def step_time_per_step_s(self) -> float:
+        return (self.effective_service_s() / self.key.steps
+                if self.key.steps else 0.0)
+
+    def step_begin(self, prompt: str, negative_prompt: str, seed: int,
+                   guidance_scale: float) -> dict:
+        return {"prompt": prompt, "seed": int(seed), "i": 0}
+
+    def step_run(self, works: List[dict]) -> None:
+        self.step_calls.append(len(works))
+        if self.step_time_s:
+            time.sleep(self.step_time_per_step_s())
+        for w in works:
+            w["i"] += 1
+
+    def step_done(self, work: dict) -> bool:
+        return work["i"] >= self.key.steps
+
+    def step_finish(self, work: dict):
+        return fake_image(work["prompt"], work["seed"], self.key)
+
+    def step_abort(self, work: dict) -> None:
+        pass  # no device buffers to release
+
+    def step_park(self, work: dict) -> None:
+        self.park_calls += 1
+
+    def step_resume(self, work: dict) -> None:
+        self.resume_calls += 1
+
+    def step_preview(self, work: dict, max_size: int = 64) -> np.ndarray:
+        return fake_preview(work["prompt"], work["seed"], self.key,
+                            work["i"])
+
+
+class StepFakeExecutorFactory(FakeExecutorFactory):
+    """FakeExecutorFactory building step-granular fakes."""
+
+    def _new_executor(self, key: ExecKey) -> StepFakeExecutor:
+        return StepFakeExecutor(key, batch_size=self.batch_size,
+                                step_time_s=self.step_time_s)
+
+    def step_calls(self) -> List[int]:
+        """Every cohort step's size, across all executors."""
+        return [n for ex in self.executors
+                for n in getattr(ex, "step_calls", ())]
+
+
 class ExecutionLedger:
     """Fleet-wide completed-execution counter keyed by (prompt, seed).
 

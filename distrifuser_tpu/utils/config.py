@@ -570,6 +570,86 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass
+class StepBatchConfig:
+    """Step-level continuous batching (serve/stepbatch.py `StepBatcher`);
+    lives beside ServeConfig so one module owns every run-shaping knob.
+
+    With ``enabled``, the server's denoise loop becomes a SLOT POOL of
+    per-request (latent, PRNG, step-index, timestep-schedule) state:
+    between any two denoise steps the scheduler admits queued requests
+    into free slots, retires finished ones, reorders the step cohort by
+    deadline slack (EDF over remaining-steps x calibrated per-step
+    service), and can preempt the slackest running request mid-denoise —
+    its slot state parks and later resumes bit-identically.  Executors
+    run step-granular (``ExecKey.exec_mode="step"``, compile-distinct
+    from the fused loop).  Mutually exclusive with ``pipeline_stages``
+    (the staged pipeline owns whole batches; the slot pool owns steps)
+    and with pipefusion buckets (no host-driven per-step loop exists
+    there).
+
+    Knobs:
+      * ``slots`` — slot-pool capacity: how many requests hold denoise
+        state (latents + patch carry) resident at once.  The HBM analog
+        of ``max_inflight_batches``.
+      * ``step_width`` — max slots advanced per scheduling round (0 =
+        all occupied).  Below ``slots`` it turns EDF from an admission
+        policy into true per-round step reordering: the cohort is the
+        ``step_width`` tightest-slack slots.
+      * ``preview_interval`` — every K steps an occupied slot emits a
+        cheap downsampled-latent preview through the request's
+        ``on_progress`` callback (0 disables).  Previews are host-side
+        (no new compiled program) and traced as their own span.
+      * ``preview_size`` — max edge length of the preview image (the
+        latent decode is downsampled to at most this).
+      * ``allow_preemption`` — let an arriving request that would miss
+        its deadline park the occupied slot with the MOST deadline
+        slack (state resumes bit-identically when a slot frees).
+      * ``preempt_margin_s`` — a victim is only parked when its own
+        slack exceeds the newcomer's shortfall by this margin, so
+        preemption never trades one miss for another.
+      * ``step_service_prior_s`` — per-step service-time estimate used
+        for EDF slack until measured steps calibrate it (the controller's
+        calibrated estimate takes over when the controller is on).
+    """
+
+    enabled: bool = False
+    slots: int = 8
+    step_width: int = 0
+    preview_interval: int = 0
+    preview_size: int = 64
+    allow_preemption: bool = True
+    preempt_margin_s: float = 0.0
+    step_service_prior_s: float = 0.01
+
+    def __post_init__(self) -> None:
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.step_width < 0:
+            raise ValueError(
+                f"step_width must be >= 0 (0 = all occupied), got "
+                f"{self.step_width}"
+            )
+        if self.preview_interval < 0:
+            raise ValueError(
+                f"preview_interval must be >= 0 (0 disables), got "
+                f"{self.preview_interval}"
+            )
+        if self.preview_size < 1:
+            raise ValueError(
+                f"preview_size must be >= 1, got {self.preview_size}"
+            )
+        if self.preempt_margin_s < 0:
+            raise ValueError(
+                f"preempt_margin_s must be >= 0, got {self.preempt_margin_s}"
+            )
+        if self.step_service_prior_s <= 0:
+            raise ValueError(
+                "step_service_prior_s must be > 0, got "
+                f"{self.step_service_prior_s}"
+            )
+
+
+@dataclasses.dataclass
 class ResilienceConfig:
     """Failure-handling policy for the serve layer (serve/resilience.py);
     lives beside ServeConfig so one module owns every run-shaping knob.
@@ -1023,6 +1103,18 @@ class ServeConfig:
     # including the staging_off rung — handle repeat offenders).
     pipeline_stages: bool = False
     max_inflight_batches: int = 2
+    # Step-level continuous batching (serve/stepbatch.py, docs/SERVING.md
+    # "Step-level continuous batching"): the denoise loop becomes a slot
+    # pool of per-request state — requests join and leave the in-flight
+    # denoise BETWEEN STEPS, the cohort reorders by deadline slack (EDF),
+    # low-slack arrivals can preempt the slackest slot (park + bit-
+    # identical resume), and occupied slots stream cheap latent previews
+    # every K steps.  Executors key at ExecKey.exec_mode="step" (compile-
+    # distinct).  Off by default; see StepBatchConfig above.  Mutually
+    # exclusive with pipeline_stages and with pipefusion parallelism.
+    step_batching: "StepBatchConfig" = dataclasses.field(
+        default_factory=StepBatchConfig
+    )
     # Prompt/embedding LRU cache in front of the text-encode stage
     # (serve/promptcache.py): repeated prompts — the dominant production
     # pattern — skip text-encode entirely.  Keyed by (family, tokenizer
@@ -1146,6 +1238,26 @@ class ServeConfig:
                 "controller must be a ControllerConfig, got "
                 f"{type(self.controller).__name__}"
             )
+        if not isinstance(self.step_batching, StepBatchConfig):
+            raise ValueError(
+                "step_batching must be a StepBatchConfig, got "
+                f"{type(self.step_batching).__name__}"
+            )
+        if self.step_batching.enabled:
+            if self.pipeline_stages:
+                raise ValueError(
+                    "step_batching and pipeline_stages are mutually "
+                    "exclusive: the staged pipeline owns whole batches "
+                    "while the slot pool owns individual steps — pick one "
+                    "dispatch mode per server"
+                )
+            if (self.parallelism == "pipefusion"
+                    or "pipefusion" in set(self.bucket_parallelism.values())):
+                raise ValueError(
+                    "step_batching requires patch-parallel buckets: the "
+                    "PipeFusion tick pipeline has no host-driven per-step "
+                    "loop to schedule at step granularity"
+                )
         if not isinstance(self.observability, ObservabilityConfig):
             raise ValueError(
                 "observability must be an ObservabilityConfig, got "

@@ -29,6 +29,17 @@ box has no egress, so that path is exercised on real hardware only.
 staged/monolithic throughput ratio, per-stage queue-wait/service
 histograms, and the denoise-gap (mesh-idle) fraction; ``--gate_ratio``
 turns the ratio into an exit-code gate (tier1.yml runs it at 1.15x).
+
+``--continuous`` runs the SAME open-loop mixed load twice — whole-batch,
+then with ``ServeConfig.step_batching`` (serve/stepbatch.py, step-level
+continuous batching) — on the key-aware deterministic fakes, and reports
+the REQUEST-SHAPED queue-wait p50/p99 both ways (the batch-shaped vs
+request-shaped tail the slot pool exists to fix), time-to-first-preview,
+and mean slot occupancy.  ``--gate_p99_ratio`` gates the whole-batch /
+continuous queue-wait p99 ratio (tier1.yml runs it at 1.4x);
+``--gate_ttfp_mult`` gates TYPICAL (p50) join-relative
+time-to-first-preview at ``mult x preview_interval x calibrated
+per-step service`` (p99 is reported alongside, not gated).
 """
 
 from __future__ import annotations
@@ -48,6 +59,7 @@ from distrifuser_tpu.serve import (  # noqa: E402
     ObservabilityConfig,
     QueueFullError,
     ServeConfig,
+    StepBatchConfig,
 )
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -80,12 +92,22 @@ def _pick_resolution(rng: random.Random):
     return RESOLUTION_MIX[-1][:2]
 
 
-def _make_dry_factory(args):
+def _make_dry_factory(args, continuous: bool = False):
     from distrifuser_tpu.serve.testing import (
         FakeExecutorFactory,
         StagedFakeExecutorFactory,
+        StepFakeExecutorFactory,
     )
 
+    if continuous:
+        # key-aware step fakes: one cohort step sleeps one key-aware step
+        # time regardless of cohort size — the per-step analog of the
+        # batched-invocation premise the whole-batch fake models
+        return StepFakeExecutorFactory(
+            batch_size=args.max_batch_size,
+            build_delay_s=args.fake_build_s,
+            step_time_s=args.fake_step_s,
+        ), "fake"
     if args.stages:
         # staged fakes sleep per stage (encode/denoise/decode); their
         # monolithic __call__ sleeps the SUM, so the staged-vs-monolithic
@@ -138,11 +160,27 @@ def _make_tiny_factory(args):
     return pipeline_executor_factory(build_pipeline), mesh_plan
 
 
+def _percentiles(xs):
+    if not xs:
+        return None
+    xs = sorted(xs)
+
+    def q(p):
+        return xs[min(len(xs) - 1, int(p * (len(xs) - 1) + 0.5))]
+
+    return {"p50": q(0.5), "p99": q(0.99), "mean": sum(xs) / len(xs),
+            "n": len(xs)}
+
+
 def run_load(server: InferenceServer, args) -> dict:
     rng = random.Random(args.seed)
     futures = []
     rejected = {"queue_full": 0}
     lock = threading.Lock()
+    # progressive-preview consumer (continuous mode): cheap on purpose —
+    # the callback runs on the scheduler thread
+    on_progress = ((lambda step, total, img: None)
+                   if getattr(args, "continuous", False) else None)
 
     def submit_one(i: int):
         if getattr(args, "stages", False):
@@ -161,6 +199,7 @@ def run_load(server: InferenceServer, args) -> dict:
                 num_inference_steps=args.steps,
                 seed=i,
                 ttl_s=args.ttl_s,
+                on_progress=on_progress,
             )
         except QueueFullError:
             with lock:
@@ -203,10 +242,16 @@ def run_load(server: InferenceServer, args) -> dict:
 
     completed, failed = 0, 0
     failures_by_type = {}
+    queue_waits, e2es, ttfp_enqueue, ttfp_join = [], [], [], []
     for f in futures:
         try:
-            f.result(timeout=args.ttl_s + 60)
+            r = f.result(timeout=args.ttl_s + 60)
             completed += 1
+            queue_waits.append(r.queue_wait_s)
+            e2es.append(r.e2e_s)
+            if r.first_preview_s is not None:
+                ttfp_enqueue.append(r.first_preview_s)
+                ttfp_join.append(r.first_preview_s - r.queue_wait_s)
         except Exception as exc:
             failed += 1
             t = type(exc).__name__
@@ -225,6 +270,15 @@ def run_load(server: InferenceServer, args) -> dict:
         # compare on the same denominator
         "availability": (completed / admitted) if admitted else 1.0,
         "throughput_rps": completed / wall if wall > 0 else 0.0,
+        # request-shaped latency: per-request queue wait / e2e percentiles
+        # (the continuous-batching compare gates on queue-wait p99)
+        "queue_wait_s": _percentiles(queue_waits),
+        "e2e_s": _percentiles(e2es),
+        # time-to-first-preview (continuous mode only): from enqueue (the
+        # perceived-latency number) and from join (the gate's number —
+        # pure denoise progress, no queueing)
+        "first_preview_s": _percentiles(ttfp_enqueue),
+        "first_preview_from_join_s": _percentiles(ttfp_join),
     }
 
 
@@ -278,6 +332,26 @@ def main(argv=None) -> int:
                          "throughput >= this ratio OR the denoise-gap "
                          "fraction shrank >= 2x vs the serial stage "
                          "shares (0 disables the gate)")
+    ap.add_argument("--continuous", action="store_true",
+                    help="step-level continuous batching compare: run the "
+                         "same load whole-batch then with ServeConfig."
+                         "step_batching and report request-shaped "
+                         "queue-wait p50/p99, time-to-first-preview, and "
+                         "slot occupancy")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="continuous: slot-pool size (0 = max_batch_size)")
+    ap.add_argument("--preview_interval", type=int, default=2,
+                    help="continuous: emit a preview every K steps")
+    ap.add_argument("--gate_p99_ratio", type=float, default=0.0,
+                    help="continuous: fail (exit 1) unless whole-batch "
+                         "queue-wait p99 / continuous queue-wait p99 >= "
+                         "this ratio (0 disables)")
+    ap.add_argument("--gate_ttfp_mult", type=float, default=0.0,
+                    help="continuous: fail (exit 1) unless TYPICAL (p50) "
+                         "join-relative time-to-first-preview <= mult x "
+                         "preview_interval x calibrated per-step service "
+                         "(p99 is reported, not gated — the budget is a "
+                         "run mean; 0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default=None,
                     help="write the full JSON artifact here")
@@ -295,7 +369,8 @@ def main(argv=None) -> int:
             tuple(int(x) for x in b.split("x")) for b in spec.split(",") if b
         )
 
-    def run_one(staged: bool, observe: bool = True):
+    def run_one(staged: bool, observe: bool = True,
+                continuous: bool = False):
         config = ServeConfig(
             max_queue_depth=args.max_queue_depth,
             max_batch_size=args.max_batch_size,
@@ -308,12 +383,18 @@ def main(argv=None) -> int:
             default_ttl_s=args.ttl_s,
             pipeline_stages=staged,
             max_inflight_batches=args.max_inflight,
+            step_batching=StepBatchConfig(
+                enabled=continuous,
+                slots=args.slots or args.max_batch_size,
+                preview_interval=args.preview_interval,
+            ),
             observability=ObservabilityConfig(
                 trace=bool(args.trace_out) and observe,
             ),
         )
         if args.dry_run:
-            factory, mesh_plan = _make_dry_factory(args)
+            factory, mesh_plan = _make_dry_factory(args,
+                                                   continuous=continuous)
             model_id = "dry-run"
         else:
             factory, mesh_plan = _make_tiny_factory(args)
@@ -406,6 +487,88 @@ def main(argv=None) -> int:
                 )
                 return 1
         return 0
+
+    if args.continuous:
+        # same open-loop mixed load twice — whole-batch baseline, then
+        # step-level continuous batching — so the artifact records the
+        # batch-shaped vs request-shaped tail as a measured ratio
+        whole_load, whole_metrics = run_one(staged=False, observe=False)
+        cont_load, cont_metrics = run_one(staged=False, continuous=True)
+        wq, cq = whole_load["queue_wait_s"], cont_load["queue_wait_s"]
+        p99_ratio = (wq["p99"] / cq["p99"]
+                     if wq and cq and cq["p99"] > 0 else 0.0)
+        sbm = cont_metrics["step_batching"]
+        steps_exec = cont_metrics["requests"].get("steps_executed", 0)
+        occupancy = (steps_exec / (sbm["rounds"] * sbm["slots"])
+                     if sbm["rounds"] else 0.0)
+        ttfp = cont_load["first_preview_from_join_s"]
+        # budget from the run-mean round time (the unweighted calibrated
+        # per-step service) — the EWMA is recency-weighted and tail-
+        # biased low by the drain phase's near-empty rounds
+        per_step_cal = sbm["round_s_mean"] or sbm["per_step_s"]
+        ttfp_budget_s = (args.preview_interval * per_step_cal
+                         * (args.gate_ttfp_mult or 1.0))
+        artifact = {
+            "bench": {**bench_block, "continuous_compare": True,
+                      "slots": args.slots or args.max_batch_size,
+                      "preview_interval": args.preview_interval,
+                      "gate_p99_ratio": args.gate_p99_ratio,
+                      "gate_ttfp_mult": args.gate_ttfp_mult},
+            "whole_batch": {"load": whole_load, "metrics": whole_metrics},
+            "continuous": {"load": cont_load, "metrics": cont_metrics},
+            "queue_wait_p99_ratio": p99_ratio,
+            "slot_occupancy_mean": occupancy,
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+                f.write("\n")
+        emit_bench_line({
+            "metric": "serve_continuous_queue_p99_ratio",
+            "value": round(p99_ratio, 3),
+            "unit": "x",
+            "whole_batch_queue_p99_s": round(wq["p99"], 4) if wq else None,
+            "continuous_queue_p99_s": round(cq["p99"], 4) if cq else None,
+            "whole_batch_queue_p50_s": round(wq["p50"], 4) if wq else None,
+            "continuous_queue_p50_s": round(cq["p50"], 4) if cq else None,
+            "ttfp_from_join_p99_s": (round(ttfp["p99"], 4)
+                                     if ttfp else None),
+            "ttfp_from_enqueue_p50_s": (
+                round(cont_load["first_preview_s"]["p50"], 4)
+                if cont_load["first_preview_s"] else None),
+            "per_step_s": round(sbm["per_step_s"], 5),
+            "slot_occupancy_mean": round(occupancy, 3),
+            "joins": sbm["joins"],
+            "preempts": sbm["preempts"],
+            "previews": cont_metrics["requests"].get("step_previews", 0),
+            "availability": round(cont_load["availability"], 4),
+        })
+        rc = 0
+        if args.gate_p99_ratio > 0 and p99_ratio < args.gate_p99_ratio:
+            print(
+                f"GATE FAILED: whole-batch/continuous queue-wait p99 "
+                f"ratio {p99_ratio:.3f}x < {args.gate_p99_ratio}x",
+                file=sys.stderr,
+            )
+            rc = 1
+        if args.gate_ttfp_mult > 0:
+            # gate the TYPICAL (p50) join-relative preview latency against
+            # the calibrated budget: per_step_s is a mean, so holding the
+            # p99 of multi-group rounds to it would be a units mismatch —
+            # the p99 still lands in the artifact and the summary line
+            if ttfp is None:
+                print("GATE FAILED: no previews observed", file=sys.stderr)
+                rc = 1
+            elif ttfp["p50"] > ttfp_budget_s:
+                print(
+                    f"GATE FAILED: time-to-first-preview p50 "
+                    f"{ttfp['p50']:.4f}s > {args.gate_ttfp_mult} x "
+                    f"{args.preview_interval} steps x "
+                    f"{per_step_cal:.5f}s = {ttfp_budget_s:.4f}s",
+                    file=sys.stderr,
+                )
+                rc = 1
+        return rc
 
     load, metrics = run_one(staged=False)
     artifact = {

@@ -256,6 +256,9 @@ def test_scenario_suite_covers_the_issue_catalog():
         "submit_stop_race", "failover_exactly_once",
         "drain_completes_inflight", "kill_restart_generation",
         "staging_stop_midpipeline",
+        # ISSUE 15: step-level continuous batching
+        "stepbatch_join_while_stepping", "stepbatch_preempt_cancel_race",
+        "stepbatch_stop_midpreview",
     }
 
 

@@ -1,0 +1,671 @@
+"""Step-level continuous batching (distrifuser_tpu/serve/stepbatch.py):
+slot-pool policy (EDF slack, cohort choice, preemption), the server's
+step-granular scheduling round on the deterministic fakes, the
+bit-identity pins (solo == joined-mid-flight == preempted-and-resumed,
+fakes for all three families plus the real tiny SD config), progressive
+previews, the controller's step-granular occupancy model, and the
+serve_bench --continuous artifact."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.serve import (
+    ExecKey,
+    InferenceServer,
+    ServeConfig,
+    ServerClosedError,
+    StepBatchConfig,
+)
+from distrifuser_tpu.serve.queue import Request, RequestQueue
+from distrifuser_tpu.serve.stepbatch import SlotState, StepBatcher
+from distrifuser_tpu.serve.testing import (
+    FakeExecutorFactory,
+    StepFakeExecutorFactory,
+    fake_image,
+    fake_preview,
+)
+
+
+def key_for(model="m", h=64, w=64, steps=4, exec_mode="step", **kw):
+    return ExecKey(model_id=model, scheduler="ddim", height=h, width=w,
+                   steps=steps, cfg=True, mesh_plan="dp1.cfg1.sp1",
+                   exec_mode=exec_mode, **kw)
+
+
+def step_config(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("slots", 4)
+    return StepBatchConfig(**kw)
+
+
+def serve_config(**kw):
+    kw.setdefault("max_queue_depth", 32)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_window_s", 0.001)
+    kw.setdefault("buckets", ((64, 64),))
+    kw.setdefault("warmup_buckets", ())
+    kw.setdefault("default_steps", 4)
+    kw.setdefault("default_ttl_s", 60.0)
+    kw.setdefault("step_batching", step_config())
+    return ServeConfig(**kw)
+
+
+def mk_request(prompt="p", steps=4, ttl=60.0, seed=0, now=None):
+    now = time.monotonic() if now is None else now
+    return Request(prompt=prompt, height=64, width=64,
+                   num_inference_steps=steps, deadline=now + ttl,
+                   seed=seed, enqueue_ts=now)
+
+
+def mk_state(req, steps_total=None, **kw):
+    k = key_for(steps=steps_total or req.num_inference_steps)
+    kw.setdefault("base_key", k)
+    kw.setdefault("ekey", k)
+    kw.setdefault("executor", object())
+    kw.setdefault("compile_hit", True)
+    return SlotState(request=req, work={}, steps_total=k.steps, **kw)
+
+
+# --------------------------------------------------------------------------
+# config + key validation
+# --------------------------------------------------------------------------
+
+
+def test_step_batch_config_validates():
+    with pytest.raises(ValueError, match="slots"):
+        StepBatchConfig(slots=0)
+    with pytest.raises(ValueError, match="preview_interval"):
+        StepBatchConfig(preview_interval=-1)
+    with pytest.raises(ValueError, match="step_service_prior_s"):
+        StepBatchConfig(step_service_prior_s=0.0)
+    with pytest.raises(ValueError, match="step_width"):
+        StepBatchConfig(step_width=-1)
+
+
+def test_step_batching_excludes_staging_and_pipefusion():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        serve_config(pipeline_stages=True)
+    with pytest.raises(ValueError, match="patch-parallel"):
+        serve_config(parallelism="pipefusion", pipe_patches=2)
+    with pytest.raises(ValueError, match="patch-parallel"):
+        serve_config(buckets=((64, 64), (128, 128)),
+                     bucket_parallelism={(128, 128): "pipefusion"})
+
+
+def test_exec_key_step_mode():
+    k = key_for()
+    assert ":step" in k.short()
+    # step and stepwise keys must never collide to one ledger tag
+    assert key_for(exec_mode="stepwise").short() != k.short()
+    with pytest.raises(ValueError, match="pipefusion"):
+        key_for(exec_mode="step", parallelism="pipefusion", pipe_patches=2)
+    with pytest.raises(ValueError, match="exec_mode"):
+        key_for(exec_mode="warp")
+
+
+def test_stepwise_rung_never_applies_to_step_keys():
+    """The ladder's stepwise_fallback is for FUSED keys — a step key
+    already runs the per-step programs, so the rung must skip it."""
+    from distrifuser_tpu.serve.resilience import (
+        RUNG_STEPWISE,
+        DegradationLadder,
+    )
+    from distrifuser_tpu.utils.config import ResilienceConfig
+
+    ladder = DegradationLadder(ResilienceConfig(), buckets=((64, 64),))
+    assert ladder._applicable(RUNG_STEPWISE, key_for(exec_mode="fused"))
+    assert not ladder._applicable(RUNG_STEPWISE, key_for(exec_mode="step"))
+
+
+def test_server_keys_buckets_at_step_mode():
+    server = InferenceServer(StepFakeExecutorFactory(), serve_config())
+    assert server._exec_key_for(64, 64, 4, cfg=True).exec_mode == "step"
+    mono = InferenceServer(FakeExecutorFactory(),
+                           serve_config(step_batching=StepBatchConfig()))
+    assert mono._exec_key_for(64, 64, 4, cfg=True).exec_mode == "fused"
+
+
+# --------------------------------------------------------------------------
+# slot-pool policy (no server, injected clock)
+# --------------------------------------------------------------------------
+
+
+def test_slack_and_cohort_edf_order():
+    clock = [100.0]
+    sb = StepBatcher(step_config(slots=3, step_service_prior_s=0.1),
+                     clock=lambda: clock[0])
+    # 4 remaining steps x 0.1s = 0.4s predicted service
+    tight = mk_state(mk_request("tight", ttl=0.5, now=100.0))
+    loose = mk_state(mk_request("loose", ttl=5.0, now=100.0))
+    sb.admit(loose)
+    sb.admit(tight)
+    assert sb.state_slack(tight, 100.0) == pytest.approx(0.1)
+    assert sb.state_slack(loose, 100.0) == pytest.approx(4.6)
+    assert [s.request.prompt for s in sb.cohort(100.0)] == ["tight",
+                                                            "loose"]
+
+
+def test_step_width_truncates_cohort():
+    sb = StepBatcher(step_config(slots=4, step_width=2,
+                                 step_service_prior_s=0.1),
+                     clock=lambda: 0.0)
+    for i, ttl in enumerate((5.0, 1.0, 3.0, 0.7)):
+        sb.admit(mk_state(mk_request(f"r{i}", ttl=ttl, now=0.0)))
+    cohort = sb.cohort(0.0)
+    assert [s.request.prompt for s in cohort] == ["r3", "r1"]
+
+
+def test_pick_victim_policy():
+    sb = StepBatcher(step_config(slots=2, step_service_prior_s=0.1,
+                                 preempt_margin_s=0.5),
+                     clock=lambda: 0.0)
+    tight = mk_state(mk_request("tight", ttl=0.6, now=0.0))   # slack 0.2
+    loose = mk_state(mk_request("loose", ttl=9.0, now=0.0))   # slack 8.6
+    sb.admit(tight)
+    sb.admit(loose)
+    v = sb.pick_victim(newcomer_slack=1.0, now=0.0)
+    assert v is loose
+    # margin: a victim barely better than the newcomer is not worth it
+    assert sb.pick_victim(newcomer_slack=8.5, now=0.0) is None
+    # no thrash: a once-preempted request is never parked again
+    loose.preempts = 1
+    assert sb.pick_victim(newcomer_slack=1.0, now=0.0) is None
+    # preemption off => never a victim
+    sb2 = StepBatcher(step_config(slots=1, allow_preemption=False),
+                      clock=lambda: 0.0)
+    sb2.admit(mk_state(mk_request("loose2", ttl=9.0, now=0.0)))
+    assert sb2.pick_victim(newcomer_slack=0.0, now=0.0) is None
+
+
+def test_park_unpark_remove_accounting():
+    sb = StepBatcher(step_config(slots=2), clock=lambda: 0.0)
+    a = mk_state(mk_request("a", now=0.0))
+    b = mk_state(mk_request("b", now=0.0))
+    sb.admit(a), sb.admit(b)
+    assert sb.free_slots() == 0 and sb.joins == 2
+    sb.park(a)
+    assert sb.free_slots() == 1 and a.parked and a.preempts == 1
+    assert sb.parked == [a] and sb.preempt_count == 1
+    assert sb.remaining_steps_total() == 8  # parked still counts
+    sb.unpark(a)
+    assert sb.free_slots() == 0 and sb.resumes == 1 and sb.joins == 2
+    sb.remove(a), sb.remove(b)
+    assert sb.free_slots() == 2 and sb.leaves == 2
+    snap = sb.snapshot()
+    assert snap["occupied"] == 0 and snap["joins"] == 2
+    assert snap["preempts"] == 1 and snap["resumes"] == 1
+
+
+def test_per_step_estimate_sources():
+    est = [None]
+    sb = StepBatcher(step_config(step_service_prior_s=0.25),
+                     clock=lambda: 0.0, step_estimate=lambda: est[0])
+    assert sb.per_step_s() == 0.25            # prior
+    sb.note_round(0.1)
+    assert sb.per_step_s() == pytest.approx(0.1)   # EWMA
+    est[0] = 0.05                             # controller calibration wins
+    assert sb.per_step_s() == 0.05
+    assert sb.snapshot()["round_s_mean"] == pytest.approx(0.1)
+
+
+def test_queue_peek_best_and_remove():
+    q = RequestQueue(8)
+    now = time.monotonic()
+    reqs = [mk_request(f"r{i}", ttl=ttl, now=now)
+            for i, ttl in enumerate((5.0, 1.0, 3.0))]
+    for r in reqs:
+        q.put(r)
+    assert q.peek_best(lambda r: r.deadline) is reqs[1]
+    assert len(q) == 3  # peek never removes
+    assert q.remove(reqs[1]) and not q.remove(reqs[1])
+    assert q.peek_best(lambda r: r.deadline) is reqs[2]
+    assert q.remove(reqs[2]) and q.remove(reqs[0])
+    assert len(q) == 0
+    assert q.peek_best(lambda r: r.deadline) is None
+
+
+# --------------------------------------------------------------------------
+# end-to-end on the fakes: scheduling, previews, preemption, stop
+# --------------------------------------------------------------------------
+
+
+def test_continuous_server_completes_request_shaped():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.002)
+    with InferenceServer(fac, serve_config()) as server:
+        futs = [server.submit(f"p{i}", height=64, width=64, seed=i)
+                for i in range(6)]
+        results = [f.result(timeout=30) for f in futs]
+    key = fac.built[0]
+    assert key.exec_mode == "step"
+    for i, r in enumerate(results):
+        assert r.batch_size == 1
+        assert ":step" in r.exec_key
+        assert np.array_equal(r.output, fake_image(f"p{i}", i, key))
+    snap = server.metrics_snapshot()
+    sb = snap["step_batching"]
+    assert sb["joins"] == 6 and sb["leaves"] == 6
+    assert snap["requests"]["completed"] == 6
+    assert snap["requests"]["steps_executed"] == 6 * 4
+
+
+@pytest.mark.parametrize("model", ["unet", "dit", "mmdit"])
+def test_bit_identity_solo_vs_joined_fakes(model):
+    """The correctness bar on all three families' fakes: a solo run and
+    a joined-mid-flight run produce byte-equal images per (prompt, seed,
+    steps) — and both equal the whole-batch server's output."""
+    def run(submissions, stagger_s=0.0, continuous=True):
+        cfg = serve_config() if continuous else serve_config(
+            step_batching=StepBatchConfig())
+        fac = (StepFakeExecutorFactory(batch_size=4, step_time_s=0.003)
+               if continuous else
+               FakeExecutorFactory(batch_size=4, step_time_s=0.003))
+        with InferenceServer(fac, cfg, model_id=model) as server:
+            futs = []
+            for prompt, seed in submissions:
+                futs.append(server.submit(prompt, height=64, width=64,
+                                          seed=seed))
+                if stagger_s:
+                    time.sleep(stagger_s)  # join mid-flight
+            return [f.result(timeout=30).output for f in futs]
+
+    solo = run([("a cat", 7)])
+    joined = run([("a cat", 7), ("a dog", 9), ("a fox", 11)],
+                 stagger_s=0.004)
+    whole = run([("a cat", 7)], continuous=False)
+    np.testing.assert_array_equal(solo[0], joined[0])
+    np.testing.assert_array_equal(solo[0], whole[0])
+    # and the joiners got THEIR own images
+    k = key_for(model=model, steps=4)
+    np.testing.assert_array_equal(joined[1], fake_image("a dog", 9, k))
+    np.testing.assert_array_equal(joined[2], fake_image("a fox", 11, k))
+
+
+def test_previews_stream_and_ttfp_recorded():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.002)
+    cfg = serve_config(step_batching=step_config(preview_interval=2),
+                       default_steps=6)
+    seen = []
+    with InferenceServer(fac, cfg) as server:
+        f_on = server.submit("p", height=64, width=64, seed=1,
+                             num_inference_steps=6,
+                             on_progress=lambda s, t, img:
+                             seen.append((s, t, img.copy())))
+        f_off = server.submit("q", height=64, width=64, seed=2,
+                              num_inference_steps=6)
+        r_on, r_off = f_on.result(timeout=30), f_off.result(timeout=30)
+    assert [s for s, _, _ in seen] == [2, 4, 6]
+    assert all(t == 6 for _, t, _ in seen)
+    key = fac.built[0]
+    np.testing.assert_array_equal(seen[0][2], fake_preview("p", 1, key, 2))
+    assert r_on.previews == 3
+    assert r_on.first_preview_s is not None and r_on.first_preview_s > 0
+    # no callback => no previews, and the result says so
+    assert r_off.previews == 0 and r_off.first_preview_s is None
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["step_previews"] == 3
+    # the time-to-first-preview histogram saw exactly one sample
+    hist = snap["latency_s"]  # whole-batch phases only; check registry
+    fp = [w for lbls, w in server.registry.family("serve_latency_seconds")
+          if lbls.get("phase") == "first_preview"]
+    assert fp and fp[0].snapshot()["count"] == 1
+    assert hist["e2e"]["count"] == 2
+
+
+def test_preemption_parks_and_resumes_bit_identically():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.005)
+    cfg = serve_config(
+        default_steps=30,
+        step_batching=step_config(slots=1, step_service_prior_s=0.005))
+    with InferenceServer(fac, cfg) as server:
+        fa = server.submit("slack", height=64, width=64, seed=1,
+                           ttl_s=60.0)
+        deadline = time.monotonic() + 10
+        while not server.stepbatch.occupied():
+            assert time.monotonic() < deadline, "victim never admitted"
+            time.sleep(0.002)
+        time.sleep(0.02)  # let it make progress mid-denoise
+        # needs 30 x 5ms = 150ms; waiting out the victim would miss
+        fb = server.submit("tight", height=64, width=64, seed=2,
+                           ttl_s=0.22)
+        ra, rb = fa.result(timeout=30), fb.result(timeout=30)
+    key = fac.built[0]
+    assert ra.preempts == 1 and rb.preempts == 0
+    ex = fac.executors[0]
+    assert ex.park_calls == 1 and ex.resume_calls == 1
+    snap = server.metrics_snapshot()["step_batching"]
+    assert snap["preempts"] == 1 and snap["resumes"] == 1
+    # the preempted-and-resumed image is byte-identical to solo
+    np.testing.assert_array_equal(ra.output, fake_image("slack", 1, key))
+    np.testing.assert_array_equal(rb.output, fake_image("tight", 2, key))
+
+
+def test_cancelled_future_frees_slot():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.005)
+    cfg = serve_config(default_steps=40,
+                       step_batching=step_config(slots=1,
+                                                 allow_preemption=False))
+    with InferenceServer(fac, cfg) as server:
+        fa = server.submit("long", height=64, width=64, seed=1)
+        deadline = time.monotonic() + 10
+        while not server.stepbatch.occupied():
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        fb = server.submit("next", height=64, width=64, seed=2)
+        fa.cancel()
+        rb = fb.result(timeout=30)
+    assert np.array_equal(rb.output,
+                          fake_image("next", 2, fac.built[0]))
+    assert server.metrics_snapshot()["requests"].get("step_cancelled",
+                                                     0) == 1
+
+
+def test_queued_deadline_rejected_not_executed():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.01)
+    cfg = serve_config(default_steps=20,
+                       step_batching=step_config(slots=1))
+    from distrifuser_tpu.serve import DeadlineExceededError
+
+    with InferenceServer(fac, cfg) as server:
+        server.submit("hog", height=64, width=64, seed=1)
+        deadline = time.monotonic() + 10
+        while not server.stepbatch.occupied():
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        # hopeless from birth (slack < 0): never preempts, never admits —
+        # expires in the queue and is rejected, not executed
+        doomed = server.submit("doomed", height=64, width=64, seed=2,
+                               ttl_s=0.01)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=30)
+    assert server.metrics_snapshot()["requests"]["rejected_deadline"] == 1
+
+
+def test_stop_resolves_every_resident_future():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.01)
+    cfg = serve_config(default_steps=200,
+                       step_batching=step_config(slots=2))
+    server = InferenceServer(fac, cfg).start(warmup=False)
+    futs = [server.submit(f"p{i}", height=64, width=64, seed=i)
+            for i in range(5)]
+    deadline = time.monotonic() + 10
+    while not server.stepbatch.occupied():
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    server.stop(timeout=30.0)
+    for f in futs:
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=5)
+    assert not server.stepbatch.occupied()
+    assert not server.stepbatch.parked
+
+
+def test_step_failure_is_terminal_and_counted():
+    class BoomStepFactory(StepFakeExecutorFactory):
+        def _new_executor(self, key):
+            ex = super()._new_executor(key)
+            orig = ex.step_run
+
+            def boom(works, _orig=orig, _ex=ex):
+                if len(_ex.step_calls) >= 2:
+                    raise RuntimeError("injected step failure")
+                return _orig(works)
+
+            ex.step_run = boom
+            return ex
+
+    from distrifuser_tpu.serve import ExecuteFailedError
+
+    fac = BoomStepFactory(batch_size=4, step_time_s=0.001)
+    with InferenceServer(fac, serve_config()) as server:
+        f = server.submit("p", height=64, width=64, seed=1)
+        with pytest.raises(ExecuteFailedError):
+            f.result(timeout=30)
+    snap = server.metrics_snapshot()
+    assert snap["requests"]["failed_execute"] >= 1
+    assert not server.stepbatch.occupied()
+
+
+def test_watchdog_abandoned_step_defers_release():
+    """A hung cohort step that the watchdog abandons must fail the
+    members' futures immediately but DEFER the buffer release and the
+    executor unpin until the orphaned worker drains — freeing either
+    under the still-running thread would be a use-after-free (the
+    staged pipeline's deferral protocol)."""
+    import threading
+
+    from distrifuser_tpu.serve import WatchdogTimeoutError
+    from distrifuser_tpu.utils.config import ResilienceConfig
+
+    hang = threading.Event()
+    aborted = threading.Event()
+
+    class HangStepFactory(StepFakeExecutorFactory):
+        def _new_executor(self, key):
+            ex = super()._new_executor(key)
+            ex.step_run = lambda works: hang.wait(10)
+            orig_abort = ex.step_abort
+            ex.step_abort = lambda w: (aborted.set(), orig_abort(w))[1]
+            return ex
+
+    fac = HangStepFactory(batch_size=4)
+    cfg = serve_config(
+        resilience=ResilienceConfig(watchdog_timeout_s=0.05,
+                                    max_retries=0))
+    with InferenceServer(fac, cfg) as server:
+        f = server.submit("p", height=64, width=64, seed=1)
+        with pytest.raises(WatchdogTimeoutError):
+            f.result(timeout=30)
+        ex = fac.executors[0]
+        # the future failed, but the orphaned worker still runs: the
+        # executor stays pinned and the work is NOT aborted yet
+        assert not aborted.wait(0.1), "buffers released under the worker"
+        assert server.cache.pin_count(ex) >= 1
+        hang.set()  # the worker drains -> deferred release fires
+        deadline = time.monotonic() + 10
+        while not aborted.is_set() or server.cache.pin_count(ex):
+            assert time.monotonic() < deadline, "deferred release never ran"
+            time.sleep(0.005)
+
+
+def test_slo_snapshot_carries_step_block():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.0)
+    with InferenceServer(fac, serve_config()) as server:
+        snap = server.slo_snapshot()
+        assert snap["step"]["slots"] == 4
+        assert snap["step"]["steps_hint"] == 4
+        assert "per_step_s" in snap["step"]
+    mono = InferenceServer(FakeExecutorFactory(),
+                           serve_config(step_batching=StepBatchConfig()))
+    assert "step" not in mono.slo_snapshot()
+
+
+# --------------------------------------------------------------------------
+# controller: step-granular occupancy accounting (the satellite fix)
+# --------------------------------------------------------------------------
+
+
+def _controller(clock, **kw):
+    from distrifuser_tpu.serve.controller import SLOController
+    from distrifuser_tpu.utils.config import ControllerConfig
+
+    kw.setdefault("enabled", True)
+    kw.setdefault("slo_p99_s", {"default": 0.5})
+    kw.setdefault("escalate_cooldown_s", 0.0)
+    kw.setdefault("service_prior_s", 0.4)
+    return SLOController(ControllerConfig(**kw), clock=clock,
+                         batch_hint=4)
+
+
+def test_step_occupancy_prevents_over_escalation():
+    """8 queued requests on a whole-batch server mean two more BATCH
+    services of wait (escalate); on an 8-slot step server they amortize
+    to one extra request's worth of steps across the pool — the
+    step-granular term must keep the class at full quality where the
+    whole-batch model would walk down."""
+    now = [0.0]
+    ctl_batch = _controller(lambda: now[0])
+    ctl_step = _controller(lambda: now[0])
+    base = {"queue_depth": 8, "inflight_requests": 0,
+            "classes": {"default": {"p99": 0.0, "window": 0}}}
+    step_block = {"slots": 8, "occupied": 8, "parked": 0,
+                  # 8 queued x 4 steps + 16 in-pool = 48 backlog steps
+                  "remaining_steps_total": 16, "per_step_s": 0.01,
+                  "steps_hint": 4}
+    for _ in range(3):
+        now[0] += 1.0
+        ctl_batch.poll(dict(base))
+        ctl_step.poll({**base, "step": dict(step_block)})
+    # whole-batch model: (1 + 2 batches) x 0.4s = 1.2s > 0.5 target
+    assert ctl_batch.snapshot()["classes"]["default"]["tier"] > 0
+    # step model: 0.01 x (4 + 48/8) = 0.1s <= 0.5 — no escalation
+    assert ctl_step.snapshot()["classes"]["default"]["tier"] == 0
+
+
+def test_step_occupancy_still_escalates_under_real_pressure():
+    now = [0.0]
+    ctl = _controller(lambda: now[0])
+    snap = {"queue_depth": 64, "inflight_requests": 0,
+            "classes": {"default": {"p99": 0.0, "window": 0}},
+            "step": {"slots": 4, "occupied": 4, "parked": 0,
+                     "remaining_steps_total": 16, "per_step_s": 0.05,
+                     "steps_hint": 8}}
+    now[0] += 1.0
+    ctl.poll(snap)
+    # 0.05 x (8 + (64x8 + 16)/4) = 7s >> 0.5 — the walk starts
+    assert ctl.snapshot()["classes"]["default"]["tier"] == 1
+
+
+def test_observe_step_calibration():
+    ctl = _controller(lambda: 0.0)
+    assert ctl.step_service_estimate() is None
+    ctl.observe_step(1.0, 0.02)
+    ctl.observe_step(0.5, 0.02)  # cheaper tier, same wall => 0.04 full-eq
+    assert ctl.step_service_estimate() == pytest.approx(0.03)
+    assert ctl.snapshot()["step_service_estimate_s"] == pytest.approx(0.03)
+
+
+def test_server_feeds_controller_step_calibration():
+    from distrifuser_tpu.utils.config import ControllerConfig
+
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.002)
+    cfg = serve_config(
+        controller=ControllerConfig(enabled=True,
+                                    slo_p99_s={"default": 30.0}))
+    with InferenceServer(fac, cfg) as server:
+        server.submit("p", height=64, width=64, seed=1).result(timeout=30)
+    est = server.controller.step_service_estimate()
+    assert est is not None and est > 0
+
+
+# --------------------------------------------------------------------------
+# serve_bench --continuous artifact
+# --------------------------------------------------------------------------
+
+
+def test_serve_bench_continuous_artifact(tmp_path):
+    import json
+    import sys
+
+    sys.path.insert(0, "scripts")
+    import serve_bench
+
+    out = tmp_path / "continuous.json"
+    rc = serve_bench.main([
+        "--dry-run", "--continuous", "--mode", "open", "--rate", "25",
+        "--duration", "0.8", "--steps", "6", "--fake_build_s", "0",
+        "--fake_step_s", "0.004", "--preview_interval", "2",
+        "--slots", "4", "--out", str(out),
+    ])
+    assert rc == 0
+    artifact = json.loads(out.read_text())
+    assert artifact["bench"]["continuous_compare"] is True
+    assert artifact["queue_wait_p99_ratio"] > 0
+    cont = artifact["continuous"]
+    assert cont["metrics"]["step_batching"]["joins"] > 0
+    assert cont["load"]["first_preview_s"] is not None
+    assert artifact["whole_batch"]["metrics"]["step_batching"] is None
+
+
+# --------------------------------------------------------------------------
+# real tiny pipeline: the step path is bit-identical to monolithic
+# --------------------------------------------------------------------------
+
+
+def _step_drive_bit_identity(pipe, steps=3):
+    """Drive one real pipeline's step contract through the canonical
+    interleaving (solo monolithic vs solo step vs joined vs preempted-
+    and-resumed) and assert byte equality — the tentpole correctness
+    bar, exercising the family's stepwise_carry_* runner hooks."""
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    pipe.set_stepwise(True)  # what apply_key_policy does for step keys
+    ex = PipelineExecutor(pipe, steps=steps)
+    mono = np.asarray(ex(["a cat"], [""], 5.0, [7])[0])
+
+    # joined + preempted interleaving
+    wa = ex.step_begin("a cat", "", 7, 5.0)
+    ex.step_run([wa])                        # a: 1
+    wb = ex.step_begin("a dog", "", 9, 5.0)  # joins mid-flight
+    ex.step_run([wa, wb])                    # a: 2, b: 1
+    ex.step_park(wa)                         # preempt a
+    ex.step_run([wb])                        # b: 2
+    ex.step_resume(wa)
+    ex.step_run([wa, wb])                    # a: 3 done, b: 3 done
+    assert ex.step_done(wa) and ex.step_done(wb)
+    img_a = np.asarray(ex.step_finish(wa))
+    img_b = np.asarray(ex.step_finish(wb))
+
+    # solo step-granular references
+    wc = ex.step_begin("a dog", "", 9, 5.0)
+    for _ in range(steps):
+        ex.step_run([wc])
+    img_c = np.asarray(ex.step_finish(wc))
+
+    np.testing.assert_array_equal(mono, img_a)
+    np.testing.assert_array_equal(img_b, img_c)
+    mono_b = np.asarray(ex(["a dog"], [""], 5.0, [9])[0])
+    np.testing.assert_array_equal(mono_b, img_b)
+
+    # previews are cheap host work with a bounded size
+    wd = ex.step_begin("a cat", "", 7, 5.0)
+    ex.step_run([wd])
+    pv = ex.step_preview(wd, 8)
+    assert pv.shape[0] <= 8 and pv.shape[1] <= 8 and pv.shape[2] == 3
+    assert pv.dtype == np.float32
+    ex.step_abort(wd)
+
+
+def test_real_pipeline_step_bit_identity(devices8):
+    """UNet/SD family: the canonical step-contract drive on the real
+    tiny config (the carry threads (x, pstate, sstate) through
+    DenoiseRunner's per-step programs)."""
+    from test_pipelines import build_sd_pipeline
+
+    pipe, _ = build_sd_pipeline(devices8, 1, batch_size=2)
+    _step_drive_bit_identity(pipe)
+
+
+def test_real_pipeline_step_bit_identity_dit(devices8):
+    """DiT/PixArt family: the same drive through DiTDenoiseRunner's
+    stepwise_carry hooks — its (x, sstate, kv) carry and
+    _exec_phases-based phase math must match _generate_stepwise
+    exactly, or joined runs drift from solo ones."""
+    from test_staging import build_pixart_pipeline
+
+    pipe = build_pixart_pipeline(devices8, 1, batch_size=2)
+    _step_drive_bit_identity(pipe)
+
+
+@pytest.mark.slow
+def test_real_pipeline_step_bit_identity_mmdit(devices8):
+    """SD3/MMDiT family: the same drive through MMDiTDenoiseRunner's
+    stepwise_carry hooks (_exec_window-based sync flag).  Slow-marked:
+    the tiny SD3 stack is the heaviest of the three compiles and the
+    DiT test already covers the shared kv-carry shape on the 2-core
+    runner."""
+    from test_sd3_pipeline import build_sd3_pipeline
+
+    pipe, _ = build_sd3_pipeline(devices8, 1, batch_size=2)
+    _step_drive_bit_identity(pipe)
