@@ -73,6 +73,9 @@ SERVE_RUNTIME_ALLOWLIST: Dict[str, str] = {
     "resilience": "sub-config: ladder rungs rewrite keys via "
                   "DegradationLadder.apply",
     "observability": "host-side tracing/metrics plane",
+    "gateway": "sub-config: HTTP/SSE transport + tenant fairness "
+               "policy — pure host-side admission/scheduling, never "
+               "touches what compiles or executes",
 }
 
 #: ExecKey fields _exec_key_for does not thread from ServeConfig —

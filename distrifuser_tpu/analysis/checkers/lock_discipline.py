@@ -185,6 +185,42 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
             via="scheduler-thread single owner (mutated only inside "
                 "_step_round paths)"),
     },
+    "distrifuser_tpu/serve/gateway.py": {
+        # connection table + drain flag: mutated by HTTP handler threads
+        # (register, stop) under the gateway lock
+        "Gateway": guard("_lock", ["_requests", "_stopping"]),
+        # per-request event buffer + terminal state: every mutation is
+        # inside this entry's own locked methods (push/finish/close);
+        # `future` is written exactly once by handle_generate before the
+        # entry is shared through Gateway._lock (the registration
+        # hand-off) — distrisched's gateway scenarios validate both
+        "_GatewayRequest": guard(
+            "_lock",
+            ["_events", "_next_seq", "dropped", "done", "closed",
+             "outcome", "result", "error", "future"],
+            via="entry-local locked methods; `future` set-once before "
+                "the Gateway._lock registration hand-off"),
+    },
+    "distrifuser_tpu/serve/tenancy.py": {
+        # the tenancy policy owns NO lock: every call (admit from
+        # producer threads via put(), select/charge from the scheduler
+        # via peek_best/remove) happens under RequestQueue._lock — the
+        # queue IS the policy's lock.  distrisched validates via the
+        # gateway scenarios (tenanted submits racing stop).
+        "TenancyPolicy": guard(
+            "_lock", ["_state", "_order", "_cursor", "_pending"],
+            via="RequestQueue._lock (policy invoked only by queue "
+                "methods holding it)"),
+        "_TenantState": guard(
+            "_lock", ["deficit", "admitted", "rejected_quota",
+                      "dequeued"],
+            via="RequestQueue._lock (policy invoked only by queue "
+                "methods holding it)"),
+        "TokenBucket": guard(
+            "_lock", ["tokens", "last_refill"],
+            via="RequestQueue._lock (refill/take only inside "
+                "policy.admit under the queue lock)"),
+    },
     # utils/ classes the serve plane shares across threads (brought under
     # the registry by ISSUE 14's sync_containment migration)
     "distrifuser_tpu/utils/metrics.py": {

@@ -50,6 +50,8 @@ from .sched import DeterministicRuntime, ScheduleAbort
 OBSERVED_MODULES = (
     "distrifuser_tpu.serve.queue",
     "distrifuser_tpu.serve.server",
+    "distrifuser_tpu.serve.gateway",
+    "distrifuser_tpu.serve.tenancy",
     "distrifuser_tpu.serve.fleet",
     "distrifuser_tpu.serve.replica",
     "distrifuser_tpu.serve.staging",

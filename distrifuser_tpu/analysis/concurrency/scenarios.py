@@ -34,6 +34,16 @@ end of every one:
 * ``stepbatch_stop_midpreview`` — stop() against the slot pool while
   previews are streaming: every future resolves, the scheduler drains
   occupied AND parked carries deterministically.
+* ``gateway_stop_midstream`` — gateway stop() while SSE consumers are
+  mid-stream and requests are mid-denoise: every open stream resolves
+  (readers terminate), every admitted future settles, nothing wedges.
+* ``gateway_cancel_final_race`` — HTTP cancel racing the scheduler's
+  completion of the same request: exactly ONE terminal event lands,
+  and the polled status agrees with it under every interleaving.
+
+Gateway scenarios drive the SOCKET-FREE core (`handle_generate` /
+`next_events` / `handle_cancel` / `stop`) — the HTTP listener is a thin
+translation over it, and a real socket would block the virtual clock.
 
 Keep scenarios clock-clean: every serve object takes ``ctx.clock``, no
 real sleeps, tick threads off (tick()/housekeeping driven explicitly) —
@@ -256,13 +266,14 @@ def staging_stop_midpipeline(ctx: ScenarioContext) -> None:
         ctx.result(f, tolerate=(ServeError,))
 
 
-def _step_config(**step_kw):
+def _step_config(_serve_overrides=None, **step_kw):
     from ...utils.config import StepBatchConfig
 
     step_kw.setdefault("enabled", True)
     step_kw.setdefault("slots", 2)
     step_kw.setdefault("step_service_prior_s", 0.01)
-    return _serve_config(step_batching=StepBatchConfig(**step_kw))
+    return _serve_config(step_batching=StepBatchConfig(**step_kw),
+                         **(_serve_overrides or {}))
 
 
 def stepbatch_join_while_stepping(ctx: ScenarioContext) -> None:
@@ -371,6 +382,116 @@ def stepbatch_stop_midpreview(ctx: ScenarioContext) -> None:
     assert not sb.occupied() and not sb.parked, "carries leaked at stop"
 
 
+def gateway_stop_midstream(ctx: ScenarioContext) -> None:
+    """gateway stop() while SSE consumers are mid-stream: every open
+    stream resolves (no reader left waiting), every admitted future
+    settles, and the draining gateway rejects new work with a typed
+    503 — never a hang."""
+    from ...serve.errors import ServeError, ServerClosedError
+    from ...serve.gateway import Gateway
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory
+    from ...utils.config import GatewayConfig, TenantConfig
+
+    gw_cfg = GatewayConfig(tenants=(TenantConfig(name="a", weight=2.0),
+                                    TenantConfig(name="b", weight=1.0)))
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+        _step_config({"gateway": gw_cfg}, preview_interval=1),
+        clock=ctx.clock)
+    server.start(warmup=False)
+    gateway = Gateway(server, config=gw_cfg, clock=ctx.clock)
+    subs = []
+
+    def client(i: int) -> None:
+        status, body = gateway.handle_generate({
+            "prompt": f"prompt-{i}", "height": 64, "width": 64,
+            "steps": 4, "seed": i, "tenant": "a" if i % 2 else "b"})
+        if status == 202:
+            subs.append(body["id"])
+        else:
+            # admission raced the drain: typed rejection is correct
+            assert status in (429, 503), (status, body)
+
+    streams = {}
+
+    def reader(i: int) -> None:
+        # waits out client i's submission, then consumes its stream to
+        # resolution — exactly what the HTTP SSE handler loop does
+        ctx.wait_until(lambda: len(subs) > i or gateway._stopping,
+                       f"stream {i} has a request id")
+        if len(subs) <= i:
+            return
+        rid, cursor, names = subs[i], -1, []
+        while True:
+            evs, resolved = gateway.next_events(rid, cursor, timeout=0.05)
+            for seq, name, _ in evs:
+                cursor, _ = seq, names.append(name)
+            if resolved and not evs:
+                break
+        streams[i] = names
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(3)]
+    readers = [ctx.spawn(f"reader{i}", reader, i) for i in range(3)]
+    stopper = ctx.spawn("stopper", gateway.stop)
+    for t in clients:
+        t.join()
+    stopper.join()
+    for t in readers:
+        t.join()  # the invariant: NO reader is left waiting after stop
+    # a draining gateway turns new work away with the typed 503
+    status, body = gateway.handle_generate({"prompt": "late"})
+    assert status == 503 and body["error"] == "ServerClosedError"
+    server.stop(timeout=60.0)
+    for rid in subs:
+        # every admitted future settles (result, typed error, cancel)
+        gr = gateway._get(rid)
+        ctx.result(gr.future, tolerate=(ServeError, ServerClosedError))
+    for names in streams.values():
+        # a consumed stream always starts at queued; at most one
+        # terminal event ever lands, whatever the stop interleaving
+        assert not names or names[0] == "queued", names
+        terminals = [n for n in names
+                     if n in ("final", "error", "cancelled")]
+        assert len(terminals) <= 1, names
+
+
+def gateway_cancel_final_race(ctx: ScenarioContext) -> None:
+    """cancel racing the scheduler's own completion: exactly one
+    terminal event, and handle_status agrees with it."""
+    from ...serve.gateway import Gateway
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory
+
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+        _step_config(preview_interval=1), clock=ctx.clock)
+    server.start(warmup=False)
+    gateway = Gateway(server, clock=ctx.clock)
+    status, sub = gateway.handle_generate({
+        "prompt": "contested", "height": 64, "width": 64, "steps": 2})
+    assert status == 202
+    rid = sub["id"]
+    canceller = ctx.spawn(
+        "canceller", lambda: gateway.handle_cancel(rid))
+    canceller.join()
+    gr = gateway._get(rid)
+    ctx.wait_until(gr.future.done, "contested future settles")
+    ctx.wait_until(lambda: gr.done, "terminal event lands")
+    server.stop(timeout=60.0)
+    evs, resolved = gateway.next_events(rid, -1, timeout=0)
+    assert resolved
+    names = [n for _, n, _ in evs]
+    terminals = [n for n in names if n in ("final", "error", "cancelled")]
+    assert len(terminals) == 1, names      # exactly one winner
+    _, st = gateway.handle_status(rid)
+    # the polled status is the event stream's terminal, never a mix
+    assert (terminals[0], st["status"]) in (
+        ("final", "completed"), ("error", "error"),
+        ("cancelled", "cancelled")), (terminals, st)
+    gateway.stop()
+
+
 SCENARIOS: Dict[str, object] = {
     "submit_stop_race": submit_stop_race,
     "failover_exactly_once": failover_exactly_once,
@@ -380,4 +501,6 @@ SCENARIOS: Dict[str, object] = {
     "stepbatch_join_while_stepping": stepbatch_join_while_stepping,
     "stepbatch_preempt_cancel_race": stepbatch_preempt_cancel_race,
     "stepbatch_stop_midpreview": stepbatch_stop_midpreview,
+    "gateway_stop_midstream": gateway_stop_midstream,
+    "gateway_cancel_final_race": gateway_cancel_final_race,
 }

@@ -29,7 +29,13 @@ star: heavy traffic, mesh never idle):
   (serve/replica.py, serve/fleet.py): lifecycle-managed replicas
   (starting → warming → serving → draining → stopped) behind a
   health-scored, failover-capable front router; a 1-replica fleet is
-  behaviorally the bare `InferenceServer`.
+  behaviorally the bare `InferenceServer`;
+* `Gateway` + `TenancyPolicy` — distrigate, the streaming HTTP/SSE
+  front end (serve/gateway.py, behind ``ServeConfig.gateway``):
+  stdlib-only ``POST /v1/generate`` + SSE progress/preview streams +
+  cancel, over per-tenant token-bucket quotas and weighted
+  deficit-round-robin fairness in the queue (serve/tenancy.py), on the
+  shared bounded-thread HTTP host (serve/httpbase.py).
 
 ``python -m distrifuser_tpu.serve --demo`` runs a CPU-only end-to-end
 demonstration (serve/__main__.py); ``scripts/serve_bench.py`` is the
@@ -41,10 +47,12 @@ from ..utils.config import (
     DEFAULT_BUCKETS,
     ControllerConfig,
     FleetConfig,
+    GatewayConfig,
     ObservabilityConfig,
     ResilienceConfig,
     ServeConfig,
     StepBatchConfig,
+    TenantConfig,
 )
 from ..utils.metrics import MetricsRegistry
 from ..utils.trace import StepTimeline, Tracer
@@ -71,10 +79,13 @@ from .errors import (
     RetryableError,
     ServeError,
     ServerClosedError,
+    TenantQuotaError,
     WatchdogTimeoutError,
 )
 from .faults import FaultPlan, FaultRule, install_fault_plan
 from .fleet import FleetRouter, build_fleet, routing_weight
+from .gateway import Gateway, decode_image, encode_image
+from .httpbase import HTTPServerHost
 from .promptcache import PromptCache
 from .queue import Request, RequestQueue, ServeResult
 from .stepbatch import SlotState, StepBatcher
@@ -96,6 +107,7 @@ from .resilience import (
     Watchdog,
 )
 from .server import InferenceServer
+from .tenancy import TenancyPolicy, TokenBucket
 
 
 def __getattr__(name):
@@ -134,6 +146,9 @@ __all__ = [
     "FaultRule",
     "FleetConfig",
     "FleetRouter",
+    "Gateway",
+    "GatewayConfig",
+    "HTTPServerHost",
     "InferenceServer",
     "MetricsRegistry",
     "MicroBatcher",
@@ -168,12 +183,18 @@ __all__ = [
     "StepBatchConfig",
     "StepBatcher",
     "StepTimeline",
+    "TenancyPolicy",
+    "TenantConfig",
+    "TenantQuotaError",
     "TierSpec",
+    "TokenBucket",
     "Tracer",
     "Watchdog",
     "WatchdogTimeoutError",
     "apply_tier",
     "build_fleet",
+    "decode_image",
+    "encode_image",
     "install_fault_plan",
     "pipeline_executor_factory",
     "routing_weight",

@@ -67,6 +67,17 @@ class AdmissionRejectedError(RetryableError):
     replica or after the load subsides."""
 
 
+class TenantQuotaError(RetryableError):
+    """The tenant-aware fair queue (serve/tenancy.py) rejected the
+    request at admission: the submitting tenant's token bucket is empty
+    (its configured ``rate_rps``/``burst`` quota is exhausted) or the
+    tenant is unknown to the configured tenant table.  HTTP-429 analog,
+    like `QueueFullError`, but scoped to ONE tenant — other tenants'
+    requests still admit, which is the point of per-tenant quotas.
+    Retry after the bucket refills (``1/rate_rps`` seconds buys one
+    token)."""
+
+
 class NoHealthyReplicaError(RetryableError):
     """The fleet router (serve/fleet.py) found no replica able to admit
     this request right now: every replica is draining, stopped, faulted,

@@ -368,6 +368,7 @@ class FleetRouter:
         seed: int = 0,
         ttl_s: Optional[float] = None,
         slo_class: str = "default",
+        tenant: str = "default",
         on_progress: Optional[Callable[..., Any]] = None,
     ) -> Future:
         """Admit one request to the fleet; returns a Future of
@@ -376,9 +377,13 @@ class FleetRouter:
         replica's admission error — or `NoHealthyReplicaError` when no
         replica can admit at all — immediately; later failures fail over
         transparently and only surface when the failover policy is
-        exhausted.  ``on_progress`` (progressive previews, step-batching
-        replicas only) rides every dispatch, including failover
-        re-dispatches — a preview stream may restart on the new replica."""
+        exhausted.  ``tenant`` (per-tenant fair queuing, tenancy-
+        configured replicas only) rides every dispatch like
+        ``slo_class`` — each replica holds the request to that tenant's
+        quota and DRR share.  ``on_progress`` (progressive previews,
+        step-batching replicas only) rides every dispatch, including
+        failover re-dispatches — a preview stream may restart on the new
+        replica."""
         if not self._started or self._stopping:
             raise ServerClosedError("fleet is not running")
         params = dict(
@@ -386,7 +391,7 @@ class FleetRouter:
             negative_prompt=negative_prompt,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, seed=seed, ttl_s=ttl_s,
-            slo_class=slo_class, on_progress=on_progress,
+            slo_class=slo_class, tenant=tenant, on_progress=on_progress,
         )
         ttl = self._default_ttl if ttl_s is None else float(ttl_s)
         fr = _FleetRequest(params=params, future=Future(),

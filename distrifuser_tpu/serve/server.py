@@ -69,6 +69,7 @@ from .errors import (
     RetryableError,
     ServeError,
     ServerClosedError,
+    TenantQuotaError,
     WatchdogTimeoutError,
     is_oom,
 )
@@ -115,6 +116,18 @@ class InferenceServer:
         self.fault_plan = fault_plan
         self.replica_name = replica_name
         self.queue = RequestQueue(self.config.max_queue_depth)
+        # Per-tenant fair queuing (serve/tenancy.py): a non-empty tenant
+        # table in ServeConfig.gateway turns the queue tenant-aware —
+        # token-bucket quotas at put(), weighted DRR feeding peek_best().
+        # None when unconfigured: the queue stays pure EDF and the
+        # tenant-off request path runs zero tenancy code (the
+        # tracer/controller convention).
+        self.tenancy = None
+        if self.config.gateway.tenants:
+            from .tenancy import TenancyPolicy
+
+            self.tenancy = TenancyPolicy(self.config.gateway, clock=clock)
+            self.queue.policy = self.tenancy
         # self.prompt_cache is created below (it needs the registry); the
         # factory wrapper reads the attribute lazily at build time, which
         # always happens after __init__ completes (warmup/start/dispatch)
@@ -182,7 +195,30 @@ class InferenceServer:
         self.registry.gauge(
             "serve_retry_budget_remaining",
             lambda: float(self.resilience.budget.remaining))
+        # per-tenant metrics plane (tenancy on only): admission counters
+        # keyed admitted/rejected_quota/completed, a rolling queue-wait
+        # window per tenant (the fairness number the gateway bench
+        # gates), and a live token/deficit gauge pair read from the
+        # policy snapshot.  Tenant tables are static config, so the
+        # label sets are bounded by construction.
+        self._tenant_counters: Dict[str, Counter] = {}
+        self._tenant_wait = {}
+        if self.tenancy is not None:
+            for tname in self.tenancy.tenant_names:
+                self._tenant_counters[tname] = self.registry.counter(
+                    "serve_tenant_requests", labels={"tenant": tname})
+                self._tenant_wait[tname] = self.registry.rolling(
+                    "serve_tenant_queue_wait_s",
+                    window=obs.slo_window, labels={"tenant": tname},
+                    clock=clock, max_age_s=obs.slo_max_age_s)
+                self.registry.gauge(
+                    "serve_tenant_tokens",
+                    (lambda t=tname: float(
+                        (self.queue.tenancy_snapshot() or {})
+                        .get(t, {}).get("tokens", 0.0))),
+                    labels={"tenant": tname})
         self.metrics_endpoint = None
+        self.gateway_endpoint = None
         self.batcher = MicroBatcher(
             self.queue,
             BucketTable(self.config.buckets),
@@ -270,6 +306,17 @@ class InferenceServer:
             self.registry.gauge(
                 "serve_slot_capacity",
                 lambda: float(self.config.step_batching.slots))
+            if self.tenancy is not None:
+                # per-tenant slot occupancy: the live fairness picture
+                # (rides the blessed snapshot-read policy, like every
+                # other slot gauge)
+                for tname in self.tenancy.tenant_names:
+                    self.registry.gauge(
+                        "serve_tenant_slot_occupied",
+                        (lambda t=tname: float(
+                            self.stepbatch.occupied_by_tenant()
+                            .get(t, 0))),
+                        labels={"tenant": tname})
         # Staged pipelining (serve/staging.py): three stage workers overlap
         # text-encode, denoise, and VAE-decode across micro-batches.  The
         # scheduler thread submits and drains outcome events; futures
@@ -320,6 +367,9 @@ class InferenceServer:
         if (self.config.observability.metrics_port is not None
                 and self.metrics_endpoint is None):
             self.start_metrics_endpoint()
+        if (self.config.gateway.port is not None
+                and self.gateway_endpoint is None):
+            self.start_gateway()
         self._stop.clear()
         t = sync.Thread(
             target=self._loop, name="distrifuser-serve", daemon=True
@@ -355,6 +405,12 @@ class InferenceServer:
         in flight on the mesh completes normally (its wall-time is
         bounded by the watchdog), so `stop()` returns within roughly
         ``max(timeout, one batch)`` with no future left unresolved."""
+        if self.gateway_endpoint is not None:
+            # first: stop accepting HTTP and resolve every open SSE
+            # stream (closed-mark + wake), so no client socket outlives
+            # the scheduler it was streaming from
+            self.gateway_endpoint.stop()
+            self.gateway_endpoint = None
         self.request_stop()
         if self.staging is not None:
             # drain the stage queues deterministically: every staged batch
@@ -473,13 +529,16 @@ class InferenceServer:
         seed: int = 0,
         ttl_s: Optional[float] = None,
         slo_class: str = "default",
+        tenant: str = "default",
         on_progress: Optional[Callable[..., Any]] = None,
     ) -> Future:
         """Admit one request; returns a Future of `ServeResult`.
 
         Raises `QueueFullError` (backpressure — retry against another
-        replica or later) or `ServerClosedError` immediately; deadline,
-        bucket, circuit-breaker, and execution failures fail the *future*
+        replica or later), `TenantQuotaError` (the submitting tenant's
+        token bucket is empty — per-tenant 429, tenancy on only) or
+        `ServerClosedError` immediately; deadline, bucket,
+        circuit-breaker, and execution failures fail the *future*
         instead, since they are decided at scheduling time.  Every error
         is a `ServeError`: `RetryableError` means the same request may
         succeed later/elsewhere, `FatalError` means it cannot.
@@ -487,6 +546,11 @@ class InferenceServer:
         ``slo_class`` tags the request for the per-class rolling-latency
         windows (`slo_snapshot`) — the signal the SLO controller steers
         on; it does NOT affect scheduling today.
+
+        ``tenant`` is the fairness identity (serve/tenancy.py): with a
+        tenant table configured it must name a known tenant (or the
+        implicit default), and the request is held to that tenant's
+        quota and DRR share.  Ignored when tenancy is off.
 
         ``on_progress(step, total_steps, preview)`` — progressive
         previews (step-level continuous batching only): fires on the
@@ -518,6 +582,7 @@ class InferenceServer:
             guidance_scale=guidance_scale,
             seed=seed,
             slo_class=str(slo_class),
+            tenant=str(tenant),
             deadline=self.clock() + ttl,
             enqueue_ts=self.clock(),
             on_progress=on_progress,
@@ -531,6 +596,16 @@ class InferenceServer:
             self.counters.inc("rejected_queue_full")
             self._trace_finish(req, "queue_full")
             raise
+        except TenantQuotaError:
+            self.counters.inc("rejected_tenant_quota")
+            tc = self._tenant_counters.get(req.tenant)
+            if tc is not None:
+                tc.inc("rejected_quota")
+            self._trace_finish(req, "tenant_quota")
+            raise
+        tc = self._tenant_counters.get(req.tenant)
+        if tc is not None:
+            tc.inc("admitted")
         return req.future
 
     # -- tracing hooks (all no-ops when config.observability.trace is off) --
@@ -956,7 +1031,10 @@ class InferenceServer:
         occupied = sb.occupied()
         if not occupied:
             return False
-        cand = self.queue.peek_best(self._step_slack_score(now))
+        # policy-blind peek: rescue must see the globally tightest
+        # request even while the DRR cursor camps on another tenant's
+        # backlog — fairness shapes shares, not deadline rescues
+        cand = self.queue.peek_urgent(self._step_slack_score(now))
         if cand is None:
             return False
         slack_now = sb.request_slack(cand, now)
@@ -1170,6 +1248,7 @@ class InferenceServer:
         self.hist_execute.observe(exec_s)
         self.hist_e2e.observe(e2e)
         self.slo_window(req.slo_class).observe(e2e)
+        self._tenant_observe(req, queue_wait)
         self.counters.inc("completed")
         self.counters.inc("requests_compile_hit" if state.compile_hit
                           else "requests_compile_miss")
@@ -1641,6 +1720,7 @@ class InferenceServer:
             self.hist_execute.observe(exec_s)
             self.hist_e2e.observe(e2e)
             self.slo_window(req.slo_class).observe(e2e)
+            self._tenant_observe(req, queue_wait)
             self.counters.inc("completed")
             if req.expired(t1):
                 # deadline lapsed while IN FLIGHT: deadlines gate
@@ -1681,6 +1761,17 @@ class InferenceServer:
             ))
 
     # -- observability -----------------------------------------------------
+
+    def _tenant_observe(self, req: Request, queue_wait: float) -> None:
+        """Per-tenant completion accounting (no-op when tenancy is off):
+        the rolling queue-wait window the gateway bench gates, plus the
+        completed count."""
+        tc = self._tenant_counters.get(req.tenant)
+        if tc is not None:
+            tc.inc("completed")
+        w = self._tenant_wait.get(req.tenant)
+        if w is not None:
+            w.observe(queue_wait)
 
     def slo_window(self, slo_class: str):
         """The rolling e2e-latency window for one SLO class (created on
@@ -1757,6 +1848,27 @@ class InferenceServer:
             host=self.config.observability.metrics_host,
         ).start()
         return self.metrics_endpoint
+
+    def start_gateway(self, port: Optional[int] = None):
+        """Serve the generation plane over stdlib HTTP/SSE
+        (serve/gateway.py): ``POST /v1/generate``, SSE progress at
+        ``GET /v1/requests/<id>/events``, result polling, and cancel.
+        Auto-started by `start()` when ``config.gateway.port`` is set;
+        ``port=0`` binds ephemerally (read
+        ``server.gateway_endpoint.port``).  Stopped by `stop()` before
+        the scheduler drains, so every open stream resolves."""
+        from .gateway import Gateway
+
+        if self.gateway_endpoint is not None:
+            return self.gateway_endpoint
+        cfg = self.config.gateway
+        if port is None:
+            port = cfg.port or 0
+        self.gateway_endpoint = Gateway(
+            self, config=cfg, registry=self.registry,
+            tracer=self.tracer, clock=self.clock,
+        ).start(port=int(port))
+        return self.gateway_endpoint
 
     def dump_observability(self, directory: str) -> Dict[str, str]:
         """Write the whole observability surface as files into
@@ -1873,6 +1985,9 @@ class InferenceServer:
             # counters (None on whole-batch servers)
             "step_batching": (self.stepbatch.snapshot()
                               if self.stepbatch is not None else None),
+            # per-tenant fair-queue accounting: token/deficit state plus
+            # admit/reject/dequeue counts (None when tenancy is off)
+            "tenancy": self.queue.tenancy_snapshot(),
             # the tracing + SLO plane (docs/OBSERVABILITY.md): trace ring
             # stats (None when tracing is off) and the rolling-window SLO
             # signals the closed-loop controller reads

@@ -89,6 +89,12 @@ class SlotState:
     def remaining(self) -> int:
         return max(0, self.steps_total - self.steps_done)
 
+    @property
+    def tenant(self) -> str:
+        """Fairness identity of the resident request — per-tenant
+        occupancy gauges (serve/tenancy.py) group slots by this."""
+        return self.request.tenant
+
 
 class StepBatcher:
     """Slot-pool bookkeeping + EDF/preemption policy (no I/O here: the
@@ -253,6 +259,15 @@ class StepBatcher:
         return (sum(s.remaining for s in self.occupied())
                 + sum(s.remaining for s in self._parked))
 
+    def occupied_by_tenant(self) -> Dict[str, int]:
+        """Occupied-slot count per tenant (parked excluded — a parked
+        request holds no device residency).  The per-tenant occupancy
+        gauges read this through the snapshot-read policy."""
+        counts: Dict[str, int] = {}
+        for s in self.occupied():
+            counts[s.tenant] = counts.get(s.tenant, 0) + 1
+        return counts
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON state for ``metrics_snapshot()["step_batching"]`` and the
         ``slo_snapshot()["step"]`` occupancy block the controller reads."""
@@ -260,6 +275,7 @@ class StepBatcher:
         return {
             "slots": len(self._slots),
             "occupied": len(occ),
+            "occupied_by_tenant": self.occupied_by_tenant(),
             "parked": len(self._parked),
             "remaining_steps_total": self.remaining_steps_total(),
             "per_step_s": self.per_step_s(),

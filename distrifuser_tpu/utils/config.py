@@ -993,6 +993,121 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class TenantConfig:
+    """One tenant's share of the serve plane (serve/tenancy.py).
+
+    * ``weight`` — relative long-run share of scheduler service under
+      contention: the deficit-round-robin queue credits each tenant
+      ``drr_quantum * weight`` denoise steps per round, so a weight-3
+      tenant sustains 3x a weight-1 tenant's step throughput when both
+      are backlogged.  Idle share is never reserved — a lone tenant gets
+      the whole scheduler regardless of weight.
+    * ``rate_rps`` / ``burst`` — token-bucket admission quota: sustained
+      requests/second and the bucket capacity (how large an instant
+      burst admits before the rate limit bites).  ``rate_rps=0`` means
+      unlimited (no bucket); ``burst=0`` with a positive rate defaults
+      the capacity to ``max(1, rate_rps)``.
+    """
+
+    name: str
+    weight: float = 1.0
+    rate_rps: float = 0.0
+    burst: float = 0.0
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.name, str) or not self.name:
+            raise ValueError(
+                f"tenant name must be a non-empty string, got {self.name!r}"
+            )
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, got {self.weight}"
+            )
+        if self.rate_rps < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: rate_rps must be >= 0, got "
+                f"{self.rate_rps}"
+            )
+        if self.burst < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: burst must be >= 0, got {self.burst}"
+            )
+        if self.rate_rps > 0 and self.burst == 0:
+            self.burst = max(1.0, float(self.rate_rps))
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    """HTTP/SSE gateway + multi-tenancy block (serve/gateway.py,
+    serve/tenancy.py; docs/SERVING.md "Gateway & multi-tenancy").
+
+    * ``port`` — gateway listen port (0 = ephemeral); None means no
+      gateway is auto-started (the tenancy knobs still apply to
+      in-process submits).
+    * ``tenants`` — the tenant table.  Empty (default) disables tenant
+      accounting entirely: the queue stays the PR-15 pure-EDF queue.
+      Non-empty activates per-tenant token buckets + weighted DRR; a
+      tenant named ``default_tenant`` is implicitly added (weight 1,
+      unlimited rate) if absent, so untagged requests keep working.
+    * ``drr_quantum`` — denoise-step credit added to a backlogged
+      tenant's deficit per round-robin pass (scaled by its weight).
+      Larger quanta batch a tenant's turns together (fewer executor
+      key switches); smaller quanta interleave tenants more finely.
+    * ``max_events`` — per-request SSE buffer depth; a slow consumer's
+      preview frames drop OLDEST beyond this (counted, never blocking
+      the scheduler thread).  Terminal events are never dropped.
+    * ``max_threads`` — bound on concurrent gateway handler threads
+      (excess connections wait in the listen backlog).
+    * ``max_requests`` — retention bound on the gateway's connection
+      table; oldest FINISHED entries are evicted beyond it (pending
+      entries are never evicted).
+    """
+
+    port: Optional[int] = None
+    host: str = "127.0.0.1"
+    tenants: Sequence["TenantConfig"] = ()
+    default_tenant: str = "default"
+    drr_quantum: float = 8.0
+    max_events: int = 64
+    max_threads: int = 8
+    max_requests: int = 1024
+
+    def __post_init__(self) -> None:
+        if self.port is not None and int(self.port) < 0:
+            raise ValueError(f"gateway port must be >= 0, got {self.port}")
+        seen = set()
+        for t in self.tenants:
+            if not isinstance(t, TenantConfig):
+                raise ValueError(
+                    f"tenants entries must be TenantConfig, got "
+                    f"{type(t).__name__}"
+                )
+            if t.name in seen:
+                raise ValueError(f"duplicate tenant name {t.name!r}")
+            seen.add(t.name)
+        self.tenants = tuple(self.tenants)
+        if not self.default_tenant:
+            raise ValueError("default_tenant must be non-empty")
+        if self.drr_quantum <= 0:
+            raise ValueError(
+                f"drr_quantum must be > 0, got {self.drr_quantum}"
+            )
+        if self.max_events < 2:
+            raise ValueError(
+                f"max_events must be >= 2 (room for one preview plus the "
+                f"terminal event), got {self.max_events}"
+            )
+        if self.max_threads < 1:
+            raise ValueError(
+                f"max_threads must be >= 1, got {self.max_threads}"
+            )
+        if self.max_requests < 1:
+            raise ValueError(
+                f"max_requests must be >= 1, got {self.max_requests}"
+            )
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Configuration block for ``distrifuser_tpu.serve`` (the long-lived
     inference service).  Kept here, beside DistriConfig, so one module owns
@@ -1142,6 +1257,12 @@ class ServeConfig:
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig
     )
+    # HTTP/SSE gateway + per-tenant fair queuing (serve/gateway.py,
+    # serve/tenancy.py): the wire front end over submit(), and the
+    # tenant table that turns the request queue into token-bucket +
+    # weighted-DRR fair queuing — see GatewayConfig above and
+    # docs/SERVING.md "Gateway & multi-tenancy".
+    gateway: GatewayConfig = dataclasses.field(default_factory=GatewayConfig)
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -1262,4 +1383,9 @@ class ServeConfig:
             raise ValueError(
                 "observability must be an ObservabilityConfig, got "
                 f"{type(self.observability).__name__}"
+            )
+        if not isinstance(self.gateway, GatewayConfig):
+            raise ValueError(
+                "gateway must be a GatewayConfig, got "
+                f"{type(self.gateway).__name__}"
             )

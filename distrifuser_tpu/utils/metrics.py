@@ -1035,9 +1035,12 @@ class MetricsHTTPEndpoint:
       is not "ok"/"degraded" — liveness stays cheap and JSON).
 
     ``port=0`` binds an ephemeral port (read ``.port`` after `start`).
-    The server runs ThreadingHTTPServer on a daemon thread: scrapes never
-    touch the scheduler thread, and all three callbacks must therefore be
-    any-thread-safe (the serve snapshots are, by construction)."""
+    The server plumbing (SO_REUSEADDR-safe rebind on replica restart,
+    bounded handler threads, deterministic shutdown) lives in
+    `serve/httpbase.HTTPServerHost`, shared with the generation gateway;
+    scrapes never touch the scheduler thread, and all three callbacks
+    must therefore be any-thread-safe (the serve snapshots are, by
+    construction)."""
 
     def __init__(self, *, prom: Callable[[], str],
                  json_snapshot: Optional[Callable[[], Dict]] = None,
@@ -1048,12 +1051,15 @@ class MetricsHTTPEndpoint:
         self._health = health
         self.host = host
         self.port = int(port)
-        self._httpd = None
-        self._thread = None
+        self._host = None
 
     def start(self) -> "MetricsHTTPEndpoint":
         import http.server
         import json as json_mod
+
+        # lazy: utils.metrics is imported by the serve package, so a
+        # module-level import of serve.httpbase would be circular
+        from ..serve.httpbase import HTTPServerHost
 
         endpoint = self
 
@@ -1094,26 +1100,17 @@ class MetricsHTTPEndpoint:
                     except Exception:
                         pass
 
-        class Server(http.server.ThreadingHTTPServer):
-            daemon_threads = True
-
-        self._httpd = Server((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = sync.Thread(
-            target=self._httpd.serve_forever,
-            name="distrifuser-metrics-http", daemon=True,
-        )
-        self._thread.start()
+        self._host = HTTPServerHost(
+            Handler, host=self.host, port=self.port,
+            thread_name="distrifuser-metrics-http",
+        ).start()
+        self.port = self._host.port
         return self
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        if self._host is not None:
+            self._host.stop()
+            self._host = None
 
     @property
     def url(self) -> str:

@@ -40,6 +40,14 @@ continuous queue-wait p99 ratio (tier1.yml runs it at 1.4x);
 ``--gate_ttfp_mult`` gates TYPICAL (p50) join-relative
 time-to-first-preview at ``mult x preview_interval x calibrated
 per-step service`` (p99 is reported alongside, not gated).
+
+``--gateway`` drives a 2-tenant burst-vs-steady load through the REAL
+HTTP/SSE gateway (distrigate, serve/gateway.py): every request POSTs
+/v1/generate and consumes its SSE stream to the final event, and the
+summary line carries per-tenant queue-wait p50/p99, SSE
+time-to-first-preview, and the max/min per-tenant goodput fairness
+ratio; ``--gate_fairness``, ``--gate_tenant_p99_ratio``, and
+``--gate_ttfp_mult`` gate it.
 """
 
 from __future__ import annotations
@@ -282,6 +290,308 @@ def run_load(server: InferenceServer, args) -> dict:
     }
 
 
+def run_gateway_bench(args, bench_block) -> int:
+    """``--gateway``: 2-tenant burst-vs-steady load through the REAL
+    HTTP/SSE gateway (distrigate) on the key-aware step fakes.
+
+    Phase A runs the steady tenant alone (solo baseline); phase B adds a
+    deeper-backlog burst tenant at a fraction of the steady weight.
+    Every request goes over the wire: POST /v1/generate, then its SSE
+    stream is consumed to `final`, recording wall time-to-first-preview
+    and the server-side lifecycle metrics off the final event.
+
+    The gates probe DRR's operator-facing guarantee — ISOLATION of the
+    protected tenant from the flood — because in a work-conserving
+    scheduler the burst tenant legitimately soaks whatever the steady
+    tenant leaves idle, so any two-sided goodput ratio is load-shape
+    noise, not a scheduler property.  ``--gate_fairness`` bounds the
+    ratio of the steady tenant's SOLO goodput to its CONTENDED goodput
+    (how much throughput the flood stole; without fair queuing steady
+    waits out whole 8-deep bursts and this blows up severalfold), with
+    the burst tenant's own progress covered by the zero-completion
+    check; ``--gate_tenant_p99_ratio`` bounds the steady tenant's
+    contended queue-wait p99 against the contended ideal — its solo
+    baseline plus one request-service, the non-preemptible residual a
+    newcomer can always be forced to wait out; ``--gate_ttfp_mult``
+    bounds join-relative time-to-first-preview (first_preview_s minus
+    queue_wait_s) against the calibrated per-step budget.  The artifact
+    also records each tenant's weight-normalized goodput share for
+    eyeballing how much work-conservation slack burst picked up."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from distrifuser_tpu.serve import GatewayConfig, TenantConfig
+
+    slots = args.slots or args.max_batch_size
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=0.001,
+        buckets=((64, 64),),
+        warmup_buckets=(),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        cache_capacity=args.cache_capacity,
+        step_batching=StepBatchConfig(
+            enabled=True, slots=slots,
+            preview_interval=args.preview_interval),
+        # steady carries the interactive weight: DRR guarantees it 6/7
+        # of the slot pool whenever it has work queued — enough to cover
+        # its offered load, so the flood cannot displace it — and burst
+        # gets its 1/7 plus whatever steady leaves on the table
+        # thread pool sized above the worst-case concurrent stream count
+        # (2x4 steady + 2x8 burst SSE streams plus in-flight POSTs) so
+        # HTTP transport never throttles the load the scheduler sees
+        gateway=GatewayConfig(port=0, max_threads=32, tenants=(
+            TenantConfig(name="steady", weight=6.0),
+            TenantConfig(name="burst", weight=1.0))),
+    )
+    factory, mesh_plan = _make_dry_factory(args, continuous=True)
+    server = InferenceServer(factory, config, model_id="dry-run",
+                             scheduler=args.scheduler,
+                             mesh_plan=mesh_plan)
+
+    # steady submits with an interactive deadline (1.75x its own
+    # service time): inside the deadline-rescue window — tight enough
+    # that _step_preempt predicts a miss whenever every slot holds
+    # burst work with steps remaining, loose enough that its own slack
+    # is still positive at the first scheduling round (a doomed
+    # newcomer is never rescued) and that it completes comfortably once
+    # admitted (in-flight lateness never errors).  burst keeps the
+    # loose default — it is always the preemptee.
+    ttls = {"steady": 1.75 * args.steps * args.fake_step_s,
+            "burst": args.ttl_s}
+
+    def submit_one(base, tenant):
+        t_post = time.monotonic()
+        body = _json.dumps({
+            "prompt": PROMPTS[int(t_post * 1e6) % len(PROMPTS)],
+            "steps": args.steps, "height": 64, "width": 64,
+            "tenant": tenant, "deadline": ttls[tenant],
+        }).encode()
+        req = urllib.request.Request(
+            base + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read()), t_post
+
+    def consume_one(base, sub, t_post, tenant, records, lock):
+        rec = {"tenant": tenant, "ok": False, "t_post": t_post,
+               "ttfp_wall_s": None}
+        ev_name = None
+        try:
+            with urllib.request.urlopen(base + sub["events"],
+                                        timeout=60) as r:
+                for line in r:
+                    line = line.decode().rstrip("\n")
+                    if line.startswith("event: "):
+                        ev_name = line[7:]
+                    elif line.startswith("data: "):
+                        if (ev_name == "preview"
+                                and rec["ttfp_wall_s"] is None):
+                            rec["ttfp_wall_s"] = time.monotonic() - t_post
+                        elif ev_name == "final":
+                            m = _json.loads(line[6:])["metrics"]
+                            rec.update(ok=True, done_at=time.monotonic(),
+                                       **{k: m[k] for k in (
+                                           "queue_wait_s", "e2e_s",
+                                           "previews",
+                                           "first_preview_s")})
+                        elif ev_name in ("error", "cancelled"):
+                            break
+        except OSError:
+            pass
+        with lock:
+            records.append(rec)
+
+    def run_phase(base, worker_plan, duration):
+        """worker_plan: [(tenant, nworkers, burst_size)].  burst_size 1
+        is the latency-bound interactive shape (submit one, stream it,
+        repeat); burst_size K submits K back-to-back and only then
+        drains their streams — a standing backlog the scheduler sees
+        all at once."""
+        records, lock = [], threading.Lock()
+        stop_at = time.monotonic() + duration
+
+        def loop(tenant, burst_size):
+            while time.monotonic() < stop_at:
+                subs = []
+                for _ in range(burst_size):
+                    try:
+                        subs.append(submit_one(base, tenant))
+                    except urllib.error.HTTPError:
+                        with lock:
+                            records.append({"tenant": tenant,
+                                            "ok": False,
+                                            "rejected": True})
+                for sub, t_post in subs:
+                    consume_one(base, sub, t_post, tenant, records,
+                                lock)
+
+        threads = [
+            threading.Thread(target=loop, args=(t, b), daemon=True)
+            for t, n, b in worker_plan for _ in range(n)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return records, time.monotonic() - t0
+
+    def tenant_stats(records, tenant, window_s):
+        done = [r for r in records
+                if r.get("ok") and r["tenant"] == tenant]
+        waits = _percentiles([r["queue_wait_s"] for r in done])
+        ttfp_join = _percentiles([
+            r["first_preview_s"] - r["queue_wait_s"] for r in done
+            if r.get("first_preview_s") is not None])
+        return {
+            "completed": len(done),
+            "rejected": sum(1 for r in records
+                            if r.get("rejected")
+                            and r["tenant"] == tenant),
+            "goodput_rps": len(done) / window_s if window_s else 0.0,
+            "queue_wait_s": waits,
+            "ttfp_join_s": ttfp_join,
+            "ttfp_wall_s": _percentiles([
+                r["ttfp_wall_s"] for r in done
+                if r["ttfp_wall_s"] is not None]),
+        }
+
+    with server:
+        base = server.gateway_endpoint.url
+        # steady is the interactive shape: submit one, stream it,
+        # repeat — it never holds more than one queued request per
+        # worker, so DRR's weight guarantee admits it at the next
+        # slot-free event even under a flood
+        solo_recs, solo_window = run_phase(
+            base, [("steady", 2, 1)], args.duration)
+        # identical steady shape under an 8-deep burst flood: weights
+        # order admissions (not preemption of residents), so steady's
+        # wait is bounded by one slot-drain; without fair queuing it
+        # would wait out whole 8-deep bursts and both the isolation
+        # ratio and the queue p99 blow up
+        contended_recs, cont_window = run_phase(
+            base, [("steady", 2, 1), ("burst", 2, 8)], args.duration)
+        sbm = server.metrics_snapshot()["step_batching"]
+        tenancy = server.metrics_snapshot()["tenancy"]
+
+    solo = tenant_stats(solo_recs, "steady", solo_window)
+    steady = tenant_stats(contended_recs, "steady", cont_window)
+    burst = tenant_stats(contended_recs, "burst", cont_window)
+    # isolation, DRR's operator-facing claim: the flood must not steal
+    # the protected tenant's throughput.  solo/contended ≈ 1 means the
+    # weight guarantee held; a FIFO queue would let steady wait out
+    # whole bursts and push this severalfold.  Values < 1 (contended
+    # beat solo — timing noise) pass trivially, as they should.
+    weights = {t.name: t.weight for t in config.gateway.tenants}
+    fairness = (solo["goodput_rps"] / steady["goodput_rps"]
+                if steady["goodput_rps"] > 0 else float("inf"))
+    weighted_shares = {
+        "steady": steady["goodput_rps"] / weights["steady"],
+        "burst": burst["goodput_rps"] / weights["burst"]}
+    per_step_cal = sbm["round_s_mean"] or sbm["per_step_s"]
+    ttfp_budget_s = (args.preview_interval * per_step_cal
+                     * (args.gate_ttfp_mult or 1.0))
+    all_ttfp = _percentiles([
+        r["first_preview_s"] - r["queue_wait_s"] for r in contended_recs
+        if r.get("ok") and r.get("first_preview_s") is not None])
+
+    artifact = {
+        "bench": {**bench_block, "gateway": True, "slots": slots,
+                  "preview_interval": args.preview_interval,
+                  "gate_fairness": args.gate_fairness,
+                  "gate_tenant_p99_ratio": args.gate_tenant_p99_ratio,
+                  "gate_ttfp_mult": args.gate_ttfp_mult},
+        "solo": {"steady": solo},
+        "contended": {"steady": steady, "burst": burst},
+        "tenant_weights": weights,
+        "weighted_goodput_shares": weighted_shares,
+        "fairness_ratio": fairness,
+        "tenancy": tenancy,
+        "step_batching": sbm,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    emit_bench_line({
+        "metric": "gateway_fairness_ratio",
+        "value": round(fairness, 3),
+        "unit": "x",
+        "solo_steady_goodput_rps": round(solo["goodput_rps"], 3),
+        "steady_goodput_rps": round(steady["goodput_rps"], 3),
+        "burst_goodput_rps": round(burst["goodput_rps"], 3),
+        "steady_queue_p50_s": (round(steady["queue_wait_s"]["p50"], 4)
+                               if steady["queue_wait_s"] else None),
+        "steady_queue_p99_s": (round(steady["queue_wait_s"]["p99"], 4)
+                               if steady["queue_wait_s"] else None),
+        "burst_queue_p50_s": (round(burst["queue_wait_s"]["p50"], 4)
+                              if burst["queue_wait_s"] else None),
+        "burst_queue_p99_s": (round(burst["queue_wait_s"]["p99"], 4)
+                              if burst["queue_wait_s"] else None),
+        "solo_steady_queue_p99_s": (
+            round(solo["queue_wait_s"]["p99"], 4)
+            if solo["queue_wait_s"] else None),
+        "sse_ttfp_join_p50_s": (round(all_ttfp["p50"], 4)
+                                if all_ttfp else None),
+        "sse_ttfp_wall_p50_s": (
+            round(steady["ttfp_wall_s"]["p50"], 4)
+            if steady["ttfp_wall_s"] else None),
+        "per_step_s": round(per_step_cal, 5),
+        "completed": steady["completed"] + burst["completed"],
+    })
+
+    rc = 0
+    if not steady["completed"] or not burst["completed"]:
+        print("GATE FAILED: a tenant completed zero requests under "
+              "contention", file=sys.stderr)
+        return 1
+    if args.gate_fairness > 0 and fairness > args.gate_fairness:
+        print(f"GATE FAILED: burst flood stole steady-tenant goodput — "
+              f"solo/contended ratio {fairness:.3f}x > "
+              f"{args.gate_fairness}x (solo "
+              f"{solo['goodput_rps']:.3f} rps vs contended "
+              f"{steady['goodput_rps']:.3f} rps)", file=sys.stderr)
+        rc = 1
+    if args.gate_tenant_p99_ratio > 0:
+        solo_p99 = solo["queue_wait_s"]["p99"] if solo["queue_wait_s"] \
+            else 0.0
+        # the contended IDEAL is solo p99 plus one request-service: a
+        # newcomer can always be forced to wait out one non-preemptible
+        # residual (deadline rescue parks each victim at most once, one
+        # per round), so that residual is baseline, not degradation —
+        # the ratio then bounds what the SCHEDULER adds on top
+        one_service_s = args.steps * args.fake_step_s
+        budget = (args.gate_tenant_p99_ratio
+                  * (solo_p99 + one_service_s))
+        contended_p99 = (steady["queue_wait_s"]["p99"]
+                         if steady["queue_wait_s"] else 0.0)
+        if contended_p99 > budget:
+            print(f"GATE FAILED: steady tenant contended queue p99 "
+                  f"{contended_p99:.4f}s > {args.gate_tenant_p99_ratio}"
+                  f" x (solo p99 {solo_p99:.4f}s + one service "
+                  f"{one_service_s:.4f}s) = {budget:.4f}s",
+                  file=sys.stderr)
+            rc = 1
+    if args.gate_ttfp_mult > 0:
+        if not all_ttfp:
+            print("GATE FAILED: no previews observed over SSE",
+                  file=sys.stderr)
+            rc = 1
+        elif all_ttfp["p50"] > ttfp_budget_s:
+            print(f"GATE FAILED: join-relative time-to-first-preview "
+                  f"p50 {all_ttfp['p50']:.4f}s > {args.gate_ttfp_mult} "
+                  f"x {args.preview_interval} steps x "
+                  f"{per_step_cal:.5f}s = {ttfp_budget_s:.4f}s",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--mode", choices=["closed", "open"], default="closed")
@@ -352,6 +662,24 @@ def main(argv=None) -> int:
                          "preview_interval x calibrated per-step service "
                          "(p99 is reported, not gated — the budget is a "
                          "run mean; 0 disables)")
+    ap.add_argument("--gateway", action="store_true",
+                    help="distrigate: drive a 2-tenant burst-vs-steady "
+                         "load through the real HTTP/SSE gateway (step "
+                         "fakes, --duration per phase) and report "
+                         "per-tenant queue-wait p50/p99, SSE "
+                         "time-to-first-preview, and the steady "
+                         "tenant's solo/contended goodput isolation "
+                         "ratio")
+    ap.add_argument("--gate_fairness", type=float, default=0.0,
+                    help="gateway: fail (exit 1) if the burst flood "
+                         "steals steady-tenant goodput — solo goodput / "
+                         "contended goodput above this ratio "
+                         "(0 disables)")
+    ap.add_argument("--gate_tenant_p99_ratio", type=float, default=0.0,
+                    help="gateway: fail (exit 1) if the steady tenant's "
+                         "contended queue-wait p99 exceeds ratio x "
+                         "(solo p99 + one request-service, the "
+                         "non-preemptible residual) (0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default=None,
                     help="write the full JSON artifact here")
@@ -429,6 +757,9 @@ def main(argv=None) -> int:
         "resolution_mix": ([[512, 512, 1.0]] if args.stages
                            else [list(r) for r in RESOLUTION_MIX]),
     }
+
+    if args.gateway:
+        return run_gateway_bench(args, bench_block)
 
     if args.stages:
         # same load twice — monolithic baseline, then the staged pipeline —

@@ -259,6 +259,8 @@ def test_scenario_suite_covers_the_issue_catalog():
         # ISSUE 15: step-level continuous batching
         "stepbatch_join_while_stepping", "stepbatch_preempt_cancel_race",
         "stepbatch_stop_midpreview",
+        # ISSUE 16: distrigate HTTP/SSE gateway
+        "gateway_stop_midstream", "gateway_cancel_final_race",
     }
 
 
