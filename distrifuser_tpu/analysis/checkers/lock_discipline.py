@@ -137,7 +137,8 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
         # the inner future (the router re-dispatches only AFTER the
         # prior outcome is terminal); ordering rides Future resolution
         "_FleetRequest": guard(
-            "_lock", ["attempts", "tried", "last_replica", "last_error"],
+            "_lock", ["attempts", "tried", "last_replica", "last_error",
+                      "salvaged_steps"],
             via="single-owner failover hand-off (Future resolution "
                 "happens-before the next dispatch)"),
     },
@@ -181,9 +182,21 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
         "SlotState": guard(
             "_lock",
             ["work", "steps_done", "slot", "parked", "preempts",
-             "previews", "first_preview_s"],
+             "previews", "first_preview_s", "migrations",
+             "steps_salvaged"],
             via="scheduler-thread single owner (mutated only inside "
                 "_step_round paths)"),
+    },
+    "distrifuser_tpu/serve/migration.py": {
+        # the decoded snapshot is a frozen dataclass: immutable after
+        # construction, shared READ-ONLY across the export/import
+        # hand-off (dying scheduler thread -> fleet failover -> adopting
+        # replica's submit path).  Nothing to lock — the entry records
+        # the claim and keeps the registry-drift cross-check honest.
+        "CarrySnapshot": guard(
+            "_lock", ["meta", "leaves"],
+            via="frozen dataclass — immutable after construction; "
+                "crosses threads by value through Future resolution"),
     },
     "distrifuser_tpu/serve/gateway.py": {
         # connection table + drain flag: mutated by HTTP handler threads

@@ -34,6 +34,15 @@ end of every one:
 * ``stepbatch_stop_midpreview`` — stop() against the slot pool while
   previews are streaming: every future resolves, the scheduler drains
   occupied AND parked carries deterministically.
+* ``stepbatch_kill_during_carry_export`` — a replica killed mid-denoise
+  under step batching: every resident carry exports exactly once
+  (``CarryExportedError`` with a decodable snapshot), queued work fails
+  typed, and the exported carry resumes to completion on a SECOND
+  replica, bit-identical.
+* ``stepbatch_migrate_vs_cancel`` — a client cancel racing stop()'s
+  carry export of the same request: the future settles exactly once
+  (cancelled, exported, or completed) under every interleaving, and no
+  carry leaks in the pool.
 * ``gateway_stop_midstream`` — gateway stop() while SSE consumers are
   mid-stream and requests are mid-denoise: every open stream resolves
   (readers terminate), every admitted future settles, nothing wedges.
@@ -382,6 +391,111 @@ def stepbatch_stop_midpreview(ctx: ScenarioContext) -> None:
     assert not sb.occupied() and not sb.parked, "carries leaked at stop"
 
 
+def stepbatch_kill_during_carry_export(ctx: ScenarioContext) -> None:
+    """a replica killed mid-denoise: resident carries export exactly
+    once, and the exported carry resumes bit-identically elsewhere."""
+    import numpy as np
+
+    from ...serve.errors import CarryExportedError, ServeError
+    from ...serve.faults import FaultPlan, FaultRule
+    from ...serve.migration import decode_snapshot
+    from ...serve.replica import REPLICA_STOPPED, Replica
+    from ...serve.testing import StepFakeExecutorFactory, fake_image
+
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="r0", p=1.0, after_calls=2,
+                                max_fires=1)], seed=0)
+    rep = Replica("r0",
+                  StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+                  _step_config(), clock=ctx.clock, fault_plan=plan)
+    rep.start()
+    futs = {}
+
+    def client(i: int) -> None:
+        try:
+            futs[i] = rep.submit(f"prompt-{i}", height=64, width=64,
+                                 seed=i, num_inference_steps=4)
+        except ServeError:
+            pass  # admission raced the kill: a typed reject is correct
+
+    clients = [ctx.spawn(f"client{i}", client, i) for i in range(3)]
+    for t in clients:
+        t.join()
+    exported = {}
+    for i, f in futs.items():
+        # killed dispatches fail TYPED — CarryExportedError for resident
+        # carries, ServerClosedError for queued work — never hang (the
+        # injected kill itself must not leak to a request future)
+        r = ctx.result(f, tolerate=(ServeError,))
+        assert isinstance(r, Exception), (
+            "a 4-step request cannot outrun the round-2 kill")
+        if isinstance(r, CarryExportedError) and r.snapshot is not None:
+            snap = decode_snapshot(r.snapshot)  # corrupt would raise
+            assert 0 < snap.step < snap.steps_total, snap.step
+            assert snap.step == r.steps_done, (snap.step, r.steps_done)
+            exported[i] = r.snapshot
+    assert exported, "a kill after 2 cohort rounds must export a carry"
+    ctx.wait_until(lambda: rep.state == REPLICA_STOPPED, "kill lands")
+    rep.stop(timeout=60.0)
+    server = rep.server
+    if server is not None and server.stepbatch is not None:
+        sb = server.stepbatch
+        assert not sb.occupied() and not sb.parked, "carries leaked"
+    # the exported carry must RESUME on a fresh replica, bit-identical
+    # to the request's own deterministic image — the migration story
+    i, data = sorted(exported.items())[0]
+    rep2 = Replica("r1",
+                   StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+                   _step_config(), clock=ctx.clock)
+    rep2.start()
+    out = ctx.result(rep2.submit(f"prompt-{i}", height=64, width=64,
+                                 seed=i, num_inference_steps=4,
+                                 carry_snapshot=data))
+    assert out.migrations == 1 and out.steps_salvaged > 0, (
+        out.migrations, out.steps_salvaged)
+    key = rep2.server._exec_key_for(64, 64, 4, cfg=True)
+    assert np.array_equal(out.output, fake_image(f"prompt-{i}", i, key)), (
+        f"migrated request {i} resumed to a different image")
+    rep2.stop(timeout=60.0)
+
+
+def stepbatch_migrate_vs_cancel(ctx: ScenarioContext) -> None:
+    """a client cancel racing stop()'s carry export of the same
+    request: the future settles exactly once — cancelled, exported
+    (CarryExportedError), or completed — never hangs, no carry leaks."""
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory
+
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.01),
+        _step_config(), clock=ctx.clock)
+    server.start(warmup=False)
+    fut = server.submit("contested", height=64, width=64, seed=0,
+                        num_inference_steps=6)
+    ctx.wait_until(lambda: server.stepbatch.occupied(), "carry resident")
+    canceller = ctx.spawn("canceller", fut.cancel)
+    stopper = ctx.spawn("stopper", lambda: server.stop(timeout=60.0))
+    canceller.join()
+    stopper.join()
+    server.stop(timeout=60.0)
+    # the contested future must SETTLE exactly once under every
+    # interleaving: cancel winning leaves it cancelled (the export's
+    # set_exception loses silently), export winning resolves it with
+    # CarryExportedError carrying the snapshot, and a full-speed run
+    # may simply complete — what is never legal is an unresolved future
+    ctx.wait_until(fut.done, "contested future settles")
+    if not fut.cancelled():
+        ctx.result(fut, tolerate=(ServeError,))
+    # stop() may return on its bounded scheduler join (stop_join_timeouts
+    # is a real, explored path) while the drain is still removing the
+    # cancelled carry — the invariant is EVENTUAL emptiness, not
+    # emptiness at the instant stop() returns
+    sb = server.stepbatch
+    ctx.wait_until(lambda: not sb.occupied() and not sb.parked,
+                   "pool drains (no carry leaked)")
+
+
 def gateway_stop_midstream(ctx: ScenarioContext) -> None:
     """gateway stop() while SSE consumers are mid-stream: every open
     stream resolves (no reader left waiting), every admitted future
@@ -501,6 +615,8 @@ SCENARIOS: Dict[str, object] = {
     "stepbatch_join_while_stepping": stepbatch_join_while_stepping,
     "stepbatch_preempt_cancel_race": stepbatch_preempt_cancel_race,
     "stepbatch_stop_midpreview": stepbatch_stop_midpreview,
+    "stepbatch_kill_during_carry_export": stepbatch_kill_during_carry_export,
+    "stepbatch_migrate_vs_cancel": stepbatch_migrate_vs_cancel,
     "gateway_stop_midstream": gateway_stop_midstream,
     "gateway_cancel_final_race": gateway_cancel_final_race,
 }

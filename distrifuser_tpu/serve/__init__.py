@@ -30,6 +30,13 @@ star: heavy traffic, mesh never idle):
   (starting → warming → serving → draining → stopped) behind a
   health-scored, failover-capable front router; a 1-replica fleet is
   behaviorally the bare `InferenceServer`;
+* carry migration (serve/migration.py, behind
+  ``StepBatchConfig.export_carries``): a dying/draining step-batching
+  replica serializes every mid-denoise carry into a versioned,
+  checksummed snapshot (`CarryExportedError.snapshot`) and the fleet's
+  failover re-dispatches it so a COMPATIBLE replica resumes at the same
+  step, bit-identical — a corrupted or incompatible snapshot rejects
+  typed (`MigrationRejectedError`) and retries from step 0;
 * `Gateway` + `TenancyPolicy` — distrigate, the streaming HTTP/SSE
   front end (serve/gateway.py, behind ``ServeConfig.gateway``):
   stdlib-only ``POST /v1/generate`` + SSE progress/preview streams +
@@ -68,10 +75,12 @@ from .controller import (
 from .errors import (
     AdmissionRejectedError,
     BuildFailedError,
+    CarryExportedError,
     CircuitOpenError,
     DeadlineExceededError,
     ExecuteFailedError,
     FatalError,
+    MigrationRejectedError,
     NoBucketError,
     NoHealthyReplicaError,
     QueueFullError,
@@ -84,6 +93,13 @@ from .errors import (
 )
 from .faults import FaultPlan, FaultRule, install_fault_plan
 from .fleet import FleetRouter, build_fleet, routing_weight
+from .migration import (
+    CarrySnapshot,
+    check_identity,
+    check_key_compatible,
+    decode_snapshot,
+    encode_snapshot,
+)
 from .gateway import Gateway, decode_image, encode_image
 from .httpbase import HTTPServerHost
 from .promptcache import PromptCache
@@ -131,6 +147,8 @@ __all__ = [
     "BatchKey",
     "BucketTable",
     "BuildFailedError",
+    "CarryExportedError",
+    "CarrySnapshot",
     "CircuitBreaker",
     "CircuitOpenError",
     "ControllerConfig",
@@ -152,6 +170,7 @@ __all__ = [
     "InferenceServer",
     "MetricsRegistry",
     "MicroBatcher",
+    "MigrationRejectedError",
     "NoBucketError",
     "NoHealthyReplicaError",
     "ObservabilityConfig",
@@ -193,8 +212,12 @@ __all__ = [
     "WatchdogTimeoutError",
     "apply_tier",
     "build_fleet",
+    "check_identity",
+    "check_key_compatible",
     "decode_image",
+    "decode_snapshot",
     "encode_image",
+    "encode_snapshot",
     "install_fault_plan",
     "pipeline_executor_factory",
     "routing_weight",

@@ -120,6 +120,16 @@ class ExecuteFailedError(RetryableError):
     ``__cause__``."""
 
 
+class MigrationRejectedError(RetryableError):
+    """A carry snapshot (serve/migration.py) failed validation at import:
+    truncated or checksum-corrupt envelope, format-version skew, ExecKey
+    or executor-family incompatibility, or identity mismatch against the
+    re-dispatched request.  Retryable because the REQUEST is fine — only
+    the salvage attempt failed: the fleet strips the snapshot and falls
+    back to the pre-migration from-step-0 retry path, never resuming
+    from bytes it cannot prove intact."""
+
+
 class ResourceExhaustedError(ExecuteFailedError):
     """OOM-shaped failure (jax RESOURCE_EXHAUSTED or injected): the
     trigger for the graceful-degradation ladder."""
@@ -154,6 +164,24 @@ class DeadlineExceededError(FatalError):
 
 class ServerClosedError(FatalError):
     """Submitted to (or still queued in) a server that has been stopped."""
+
+
+class CarryExportedError(ServerClosedError):
+    """Terminal FOR THIS REPLICA: the stopping/draining server exported
+    the request's mid-denoise carry instead of finishing it.  ``snapshot``
+    carries the encoded bytes (serve/migration.py) when export succeeded,
+    None when only the progress accounting survived; ``steps_done`` is
+    how many denoise steps the carry had completed.  A `ServerClosedError`
+    subclass on purpose: the fleet router already treats that class as
+    NOT request-fatal, so the existing failover path fires — it just
+    re-dispatches the snapshot (resume at ``steps_done``) instead of the
+    request from step 0."""
+
+    def __init__(self, message: str, *, snapshot: "bytes | None" = None,
+                 steps_done: int = 0):
+        super().__init__(message)
+        self.snapshot = snapshot
+        self.steps_done = int(steps_done)
 
 
 class NoBucketError(FatalError):

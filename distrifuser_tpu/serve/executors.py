@@ -395,6 +395,83 @@ class PipelineExecutor:
         `step_run` re-uploads the host leaves through its jitted call,
         byte-exactly."""
 
+    def step_export(self, work: Dict[str, Any]):
+        """Carry migration (serve/migration.py): flatten the request's
+        denoise carry to HOST numpy leaves for serialization.  The same
+        device->host round-trip `step_park` pins as bit-exact, so an
+        importing replica resumes the identical bytes.  Returns
+        ``(extra_meta, leaves)``: the executor-owned header fields
+        (family + step index) and the flat leaf list; the work itself is
+        left intact (the caller still releases it via `step_abort`)."""
+        import jax
+        import numpy as np
+
+        host = jax.device_get(work["carry"])
+        leaves = [np.asarray(leaf)
+                  for leaf in jax.tree_util.tree_leaves(host)]
+        extra = {"family": type(self.pipeline).__name__,
+                 "step": int(work["i"])}
+        return extra, leaves
+
+    def step_import(self, meta: Dict[str, Any], leaves, prompt: str,
+                    negative_prompt: str, seed: int,
+                    guidance_scale: float) -> Dict[str, Any]:
+        """Adopt an exported carry: rebuild the request's work via the
+        deterministic `step_begin` machinery (re-encoded embeddings and
+        a template carry give the treedef — encode is a pure function of
+        the prompt, so the embeddings are bit-identical to the
+        exporter's), validate every snapshot leaf against the template's
+        shape/dtype, then graft the snapshot leaves in and resume at the
+        exported step index.  Structure drift rejects TYPED
+        (`MigrationRejectedError`) — resuming a mismatched carry would
+        be silent corruption, and the fleet's fallback is a clean
+        from-step-0 retry."""
+        import jax
+
+        from .errors import MigrationRejectedError
+
+        family = type(self.pipeline).__name__
+        if meta.get("family") != family:
+            raise MigrationRejectedError(
+                f"carry snapshot family {meta.get('family')!r} cannot "
+                f"import into a {family} executor"
+            )
+        step = int(meta["step"])
+        if not (0 <= step <= self.steps):
+            raise MigrationRejectedError(
+                f"carry snapshot step {step} out of range for a "
+                f"{self.steps}-step executor"
+            )
+        work = self.step_begin(prompt, negative_prompt, seed,
+                               guidance_scale)
+        template = work["carry"]
+        tmpl_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(leaves) != len(tmpl_leaves):
+            self.step_abort(work)
+            raise MigrationRejectedError(
+                f"carry snapshot has {len(leaves)} leaves; this "
+                f"executor's carry has {len(tmpl_leaves)}"
+            )
+        for i, (got, want) in enumerate(zip(leaves, tmpl_leaves)):
+            got_shape = tuple(got.shape)
+            want_shape = tuple(want.shape)
+            got_dtype = str(got.dtype)
+            want_dtype = str(want.dtype)
+            if got_shape != want_shape or got_dtype != want_dtype:
+                self.step_abort(work)
+                raise MigrationRejectedError(
+                    f"carry snapshot leaf {i} is {got_shape}/{got_dtype}"
+                    f"; this executor's carry wants "
+                    f"{want_shape}/{want_dtype}"
+                )
+        # graft the exported HOST leaves into the template's structure:
+        # the next step_run re-uploads them through its jitted call,
+        # byte-exactly — the park/resume protocol, across replicas
+        work["carry"] = jax.tree_util.tree_unflatten(treedef, list(leaves))
+        work["i"] = step
+        _release_buffers(tmpl_leaves)
+        return work
+
     def step_preview(self, work: Dict[str, Any],
                      max_size: int = 64):
         """Cheap intermediate preview: the request's CURRENT latent,

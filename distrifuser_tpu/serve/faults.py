@@ -22,10 +22,17 @@ Injection sites (the convention — sites are plain strings):
   fused-loop program (reads the process-global plan, see
   `install_fault_plan`, because the runner has no serve-layer plumbing);
 * ``"replica"`` — `serve.replica.Replica` at the top of every monolithic
-  executor dispatch.  The site's key stringifies to the REPLICA NAME, so
-  ``key_substr`` targets a named replica; combined with ``after_calls``
-  a rule kills / hangs / degrades that replica deterministically
-  mid-load (fleet failover is what the site exists to exercise).
+  executor dispatch AND every step-granular cohort step (``step_run``).
+  The site's key stringifies to the REPLICA NAME, so ``key_substr``
+  targets a named replica; combined with ``after_calls`` a rule kills /
+  hangs / degrades that replica deterministically mid-load — under step
+  batching, after an exact number of denoise steps (fleet failover and
+  carry migration are what the site exists to exercise);
+* ``"migrate.export"`` / ``"migrate.import"`` — the carry-migration wire
+  (serve/migration.py): MUTATION sites consulted through
+  `FaultPlan.mutate` on the encoded snapshot bytes as they leave the
+  dying replica / arrive at the adopting one.  Only the
+  ``snapshot_truncate`` / ``snapshot_corrupt`` kinds apply here.
 
 Fault kinds:
 
@@ -44,7 +51,13 @@ Fault kinds:
   SYNCHRONOUSLY signals the server's shutdown (queued futures fail with
   `ServerClosedError`; the blocking scheduler join runs in the
   background), and re-raises so the in-flight batch fails terminally —
-  the fleet router then fails the whole replica's load over.
+  the fleet router then fails the whole replica's load over;
+* ``snapshot_truncate`` — `FaultPlan.mutate` cuts the snapshot bytes in
+  half, modelling a connection dropped mid-transfer;
+* ``snapshot_corrupt`` — `FaultPlan.mutate` flips one byte at a
+  deterministic offset, modelling silent wire/storage corruption.  Both
+  mutation kinds must be caught by the importer's checksum/envelope
+  validation (`MigrationRejectedError`) — never by a wrong image.
 
 Only the ``execute`` sites run under the watchdog.  A ``hang`` injected
 at a build/compile site blocks its caller for the full ``hang_s`` —
@@ -65,7 +78,14 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from ..utils import sync
 
-FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang", "kill")
+FAULT_KINDS = ("compile_error", "execute_error", "oom", "hang", "kill",
+               "snapshot_truncate", "snapshot_corrupt")
+
+# Data-mutation kinds: they never raise at a ``check`` site — they
+# corrupt bytes passing through a ``mutate`` site (the carry-migration
+# wire, serve/migration.py), and the RECEIVER's typed validation is what
+# the chaos run interrogates.
+MUTATE_KINDS = ("snapshot_truncate", "snapshot_corrupt")
 
 
 class InjectedFault(Exception):
@@ -189,12 +209,17 @@ class FaultPlan:
                 return False
         return True
 
-    def _pick(self, site: str, key, batch_size: Optional[int]) -> Optional[FaultRule]:
+    def _pick(self, site: str, key, batch_size: Optional[int],
+              mutate: bool = False) -> Optional[FaultRule]:
         with self._lock:
             call_idx = self._site_calls.get(site, 0)
             self._site_calls[site] = call_idx + 1
             for i, rule in enumerate(self.rules):
                 if rule.site != site:
+                    continue
+                if (rule.kind in MUTATE_KINDS) != mutate:
+                    # raise-kinds fire from check(), mutate-kinds from
+                    # mutate() — a rule can never cross the two APIs
                     continue
                 if call_idx < rule.after_calls:
                     # index-gated like at_calls: the rule's RNG stream
@@ -225,6 +250,24 @@ class FaultPlan:
             time.sleep(rule.hang_s)
             return
         _raise_fault(rule, site)
+
+    def mutate(self, site: str, data: bytes, key=None) -> bytes:
+        """Consult the plan at a MUTATION ``site``: returns ``data``
+        unchanged (no rule fired) or a deterministically corrupted copy
+        (``snapshot_truncate`` halves it; ``snapshot_corrupt`` flips one
+        mid-payload byte).  Never raises — the corruption's *detection*
+        belongs to the receiver's validation, which is the code path
+        under test."""
+        rule = self._pick(site, key, None, mutate=True)
+        if rule is None or not data:
+            return data
+        if rule.kind == "snapshot_truncate":
+            return data[: len(data) // 2]
+        # snapshot_corrupt: one flipped byte, deterministic position
+        pos = len(data) // 2
+        corrupted = bytearray(data)
+        corrupted[pos] ^= 0xFF
+        return bytes(corrupted)
 
     # -- observability ------------------------------------------------------
 

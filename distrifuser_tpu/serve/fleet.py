@@ -63,9 +63,11 @@ from ..utils import sync
 from ..utils.config import FleetConfig, ServeConfig
 from ..utils.metrics import MetricsRegistry
 from .errors import (
+    CarryExportedError,
     DeadlineExceededError,
     FatalError,
     LifecycleError,
+    MigrationRejectedError,
     NoHealthyReplicaError,
     RetryableError,
     ServerClosedError,
@@ -99,6 +101,11 @@ class _FleetRequest:
     tried: set = dataclasses.field(default_factory=set)
     last_replica: Optional[str] = None
     last_error: Optional[BaseException] = None
+    # carry migration (serve/migration.py): how many completed denoise
+    # steps the snapshot currently riding ``params["carry_snapshot"]``
+    # salvages — 0 when no snapshot rides.  Zeroed when a rejection
+    # strips the snapshot (those steps re-execute from 0).
+    salvaged_steps: int = 0
 
 
 class _ReplicaSlot:
@@ -307,18 +314,28 @@ class FleetRouter:
                     f"fleet is stopped; cannot {what} replicas")
 
     def drain_replica(self, name: str, release: bool = False,
-                      timeout: Optional[float] = None) -> None:
+                      timeout: Optional[float] = None,
+                      drain_deadline_s: Optional[float] = None) -> None:
         """Operator drain (scale-down): stop routing here, let in-flight
         work finish; with ``release`` also stop the server once quiescent.
         Unlike an auto-drain this is never probed back — `resume_replica`
-        is the explicit inverse."""
+        is the explicit inverse.
+
+        ``drain_deadline_s`` bounds the drain (serve/replica.py): after
+        that many seconds the server stops anyway and EXPORTS every
+        remaining mid-denoise carry — the failed futures come back here
+        as `CarryExportedError` and the failover path re-dispatches each
+        at its exported step on another replica (carry migration), so
+        scale-down completes within the deadline without re-running
+        anyone's completed steps."""
         self._check_not_stopping("drain")
         slot = self._slots[name]
         with self._lock:
             slot.manual = True
         self.counters.inc("manual_drains")
         self._trace("drain", replica=name, kind="manual")
-        slot.replica.drain(release=release, timeout=timeout)
+        slot.replica.drain(release=release, timeout=timeout,
+                           drain_deadline_s=drain_deadline_s)
 
     def resume_replica(self, name: str) -> None:
         self._check_not_stopping("resume")
@@ -493,7 +510,7 @@ class FleetRouter:
                 self.counters.inc("probes")
                 self._trace("probe", replica=rep.name)
             try:
-                inner = rep.submit(probe=is_probe, **params)
+                inner = self._submit_to(rep, params, is_probe, fr)
             except (RetryableError, ServerClosedError) as exc:
                 last_exc = exc
                 if is_probe:
@@ -510,6 +527,33 @@ class FleetRouter:
                 self._on_replica_done(fr, slot, f, p))
             return True, None
         return False, last_exc
+
+    def _submit_to(self, rep: Replica, params: Dict[str, Any],
+                   is_probe: bool, fr: _FleetRequest) -> Future:
+        """One dispatch edge.  A SYNCHRONOUS `MigrationRejectedError`
+        (the replica's submit refused the riding carry snapshot —
+        corrupt envelope, identity drift) strips the snapshot and
+        retries THIS replica once from step 0: a bad snapshot says
+        nothing about replica health and must not cascade across the
+        fleet as a string of per-replica failures."""
+        try:
+            return rep.submit(probe=is_probe, **params)
+        except MigrationRejectedError:
+            if not params.get("carry_snapshot"):
+                raise
+            self._note_migration_rejected(fr)
+            params.pop("carry_snapshot", None)
+            fr.params.pop("carry_snapshot", None)
+            return rep.submit(probe=is_probe, **params)
+
+    def _note_migration_rejected(self, fr: _FleetRequest) -> None:
+        """Accounting for one stripped snapshot: the steps it would have
+        salvaged are now re-executed from 0."""
+        self.counters.inc("migrations_rejected")
+        self._trace("migrate_rejected", steps_lost=fr.salvaged_steps)
+        if fr.salvaged_steps:
+            self.counters.inc("fleet_steps_reexecuted", fr.salvaged_steps)
+            fr.salvaged_steps = 0
 
     # -- outcome handling (runs on replica scheduler/decode threads) --------
 
@@ -548,7 +592,16 @@ class FleetRouter:
                 if rep.state == REPLICA_DRAINING:
                     rep.resume()
             self.counters.inc("completed")
-            self._resolve(fr.future, result=inner.result())
+            result = inner.result()
+            salvaged = getattr(result, "steps_salvaged", 0)
+            if salvaged:
+                # a migrated request finished: the adopting replica
+                # resumed at the exported step — these completed steps
+                # were NOT re-executed anywhere
+                self.counters.inc("steps_salvaged", salvaged)
+                self._trace("migrate_complete", replica=rep.name,
+                            steps=salvaged)
+            self._resolve(fr.future, result=result)
             return
         # the replica's outcome is TERMINAL (its own retry loop is done):
         # only now may the request move to a different replica
@@ -561,12 +614,16 @@ class FleetRouter:
         # bookkeeping entirely
         request_fatal = (isinstance(exc, FatalError)
                          and not isinstance(exc, ServerClosedError))
+        # a rejected carry snapshot is the SNAPSHOT's failure (corrupt
+        # bytes, version skew, key drift) — the replica that refused it
+        # is healthy, so it must not accrue toward the drain threshold
+        migration_rejected = isinstance(exc, MigrationRejectedError)
         self.counters.inc("replica_failures")
         with self._lock:
             slot.failed += 1
-            if not request_fatal:
+            if not request_fatal and not migration_rejected:
                 slot.consecutive_failures += 1
-            trip = (not request_fatal
+            trip = (not request_fatal and not migration_rejected
                     and slot.consecutive_failures
                     >= self.config.drain_failure_threshold)
         if was_probe:
@@ -595,6 +652,31 @@ class FleetRouter:
         self._failover(fr, exc)
 
     def _failover(self, fr: _FleetRequest, exc: BaseException) -> None:
+        if isinstance(exc, MigrationRejectedError):
+            # the importing replica refused the riding snapshot: strip
+            # it and fall back to the pre-migration from-step-0 retry —
+            # never resume from bytes a replica cannot prove intact
+            if fr.params.pop("carry_snapshot", None) is not None:
+                self._note_migration_rejected(fr)
+        elif isinstance(exc, CarryExportedError):
+            if exc.snapshot is not None:
+                # the dying replica exported this request's mid-denoise
+                # carry: re-dispatch WITH the snapshot so the adopting
+                # replica resumes at the exported step, not step 0
+                fr.params["carry_snapshot"] = exc.snapshot
+                fr.salvaged_steps = exc.steps_done
+                self.counters.inc("migrations")
+                self._trace("migrate", frm=fr.last_replica,
+                            step=exc.steps_done)
+            elif exc.steps_done > 0:
+                # progress died with the replica (export off or failed):
+                # the steps beyond any OLDER still-riding snapshot will
+                # re-execute — account for them
+                resumable = (fr.salvaged_steps
+                             if fr.params.get("carry_snapshot") else 0)
+                lost = max(0, exc.steps_done - resumable)
+                if lost:
+                    self.counters.inc("fleet_steps_reexecuted", lost)
         fr.attempts += 1
         if fr.attempts > self.config.max_failovers:
             self.counters.inc("failover_exhausted")

@@ -1,0 +1,261 @@
+"""Carry migration: the wire format that moves a mid-denoise request.
+
+PR 15 made a preempted slot's denoise carry park to host as an exact
+byte round-trip and resume bit-identically — but that primitive stopped
+at the replica boundary, so a replica kill or drain re-executed every
+in-flight request from step 0 under the fleet retry budget.  STADI
+(arXiv 2509.04719) treats a request's remaining steps as a divisible,
+movable unit across heterogeneous workers; this module gives the fleet
+that unit: a **versioned, checksummed, self-describing serialization**
+of a parked `SlotState`'s execution state that any COMPATIBLE replica
+can import and resume at the same step, bit-identical to an unmigrated
+run.
+
+Why bit-identity holds: the carry bytes are exact (host numpy leaves,
+the same `jax.device_get` round-trip preemption already pins), the
+prompt embeddings are deterministically re-encoded on the importing
+replica (the step path's `step_begin` machinery — same tokenizer, same
+programs), and the per-step programs an imported carry replays are
+selected by the SAME `ExecKey` the exporter ran (compatibility is
+checked field-for-field, so a snapshot can never resume under a
+different compiled program family).
+
+Envelope layout (everything before the digest is covered by it)::
+
+    MAGIC(4) | u32 header_len | header json | leaf bytes... | sha256(32)
+
+The JSON header is the self-description: format version, the full
+`ExecKey` field dict, the executor family, the step index and total,
+the request identity (request_id / seed / prompt crc), and one
+shape/dtype/nbytes descriptor per carry leaf.  Leaves follow as raw
+C-contiguous bytes in descriptor order.
+
+Every validation failure — truncation, bad magic, version skew,
+checksum mismatch, malformed header, leaf-descriptor drift, ExecKey or
+identity incompatibility — raises `MigrationRejectedError` (typed,
+retryable): the fleet strips the snapshot and falls back to the
+pre-migration from-step-0 retry, never silent corruption.
+
+Thread model: pure functions over immutable inputs plus the frozen
+`CarrySnapshot` decoded form — no shared mutable state; safe from any
+thread (the exporter runs on the dying replica's scheduler thread, the
+importer on the adopting replica's submit caller).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .cache import ExecKey
+from .errors import MigrationRejectedError
+
+MAGIC = b"DFCM"  # DistriFuser Carry Migration
+FORMAT_VERSION = 1
+
+_HEADER_LEN = struct.Struct(">I")
+_DIGEST_BYTES = 32  # sha256
+
+
+def prompt_crc(prompt: str) -> int:
+    """Identity fingerprint of a prompt for the header — the snapshot
+    must not resume under a different prompt's re-encoded embeddings,
+    but the full text already travels in the re-dispatch params, so the
+    header carries only the check value."""
+    return zlib.crc32(prompt.encode("utf-8"))
+
+
+@dataclasses.dataclass(frozen=True)
+class CarrySnapshot:
+    """Decoded (validated) form of one carry snapshot.
+
+    ``meta`` is the parsed JSON header; ``leaves`` are the carry's host
+    numpy arrays in flatten order.  Frozen — a decoded snapshot is
+    import input, never mutated (the importing executor builds a FRESH
+    work dict around the leaves)."""
+
+    meta: Dict[str, Any]
+    leaves: Tuple[np.ndarray, ...]
+
+    @property
+    def step(self) -> int:
+        return int(self.meta["step"])
+
+    @property
+    def steps_total(self) -> int:
+        return int(self.meta["steps_total"])
+
+    @property
+    def family(self) -> str:
+        return str(self.meta["family"])
+
+    @property
+    def exec_key(self) -> Dict[str, Any]:
+        return dict(self.meta["exec_key"])
+
+
+def encode_snapshot(*, ekey: ExecKey, family: str, step: int,
+                    steps_total: int, request_id: str, prompt: str,
+                    seed: int, leaves: List[np.ndarray],
+                    extra: Optional[Dict[str, Any]] = None) -> bytes:
+    """Serialize one parked carry to the self-describing envelope."""
+    host = [np.ascontiguousarray(np.asarray(leaf)) for leaf in leaves]
+    meta: Dict[str, Any] = {
+        "format": FORMAT_VERSION,
+        "family": family,
+        "exec_key": dataclasses.asdict(ekey),
+        "step": int(step),
+        "steps_total": int(steps_total),
+        "request_id": request_id,
+        "seed": int(seed),
+        "prompt_crc": prompt_crc(prompt),
+        "leaves": [
+            {"shape": list(leaf.shape), "dtype": leaf.dtype.name,
+             "nbytes": int(leaf.nbytes)}
+            for leaf in host
+        ],
+    }
+    if extra:
+        meta.update(extra)
+    header = json.dumps(meta, sort_keys=True).encode("utf-8")
+    body = bytearray()
+    body += MAGIC
+    body += _HEADER_LEN.pack(len(header))
+    body += header
+    for leaf in host:
+        body += leaf.tobytes()
+    body += hashlib.sha256(bytes(body)).digest()
+    return bytes(body)
+
+
+def decode_snapshot(data: bytes) -> CarrySnapshot:
+    """Validate and decode an envelope; every failure is typed.
+
+    Order matters: the checksum is verified FIRST (over everything
+    before the digest), so a flipped bit anywhere — header or payload —
+    rejects as corruption before any field is trusted; only then are
+    magic, version, header shape, and leaf descriptors interpreted."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise MigrationRejectedError(
+            f"carry snapshot must be bytes, got {type(data).__name__}"
+        )
+    data = bytes(data)
+    floor = len(MAGIC) + _HEADER_LEN.size + _DIGEST_BYTES
+    if len(data) < floor:
+        raise MigrationRejectedError(
+            f"carry snapshot truncated: {len(data)} bytes < the "
+            f"{floor}-byte envelope floor"
+        )
+    payload, digest = data[:-_DIGEST_BYTES], data[-_DIGEST_BYTES:]
+    if hashlib.sha256(payload).digest() != digest:
+        raise MigrationRejectedError(
+            "carry snapshot checksum mismatch: payload corrupt or "
+            "truncated in flight"
+        )
+    if payload[:len(MAGIC)] != MAGIC:
+        raise MigrationRejectedError(
+            f"carry snapshot bad magic {payload[:len(MAGIC)]!r} "
+            f"(want {MAGIC!r})"
+        )
+    (header_len,) = _HEADER_LEN.unpack_from(payload, len(MAGIC))
+    header_off = len(MAGIC) + _HEADER_LEN.size
+    if header_off + header_len > len(payload):
+        raise MigrationRejectedError(
+            "carry snapshot truncated: header extends past the payload"
+        )
+    try:
+        meta = json.loads(payload[header_off:header_off + header_len])
+    except ValueError as exc:
+        raise MigrationRejectedError(
+            f"carry snapshot header is not valid JSON: {exc}"
+        ) from exc
+    version = meta.get("format")
+    if version != FORMAT_VERSION:
+        raise MigrationRejectedError(
+            f"carry snapshot format version {version!r} is not the "
+            f"supported {FORMAT_VERSION} — refusing cross-version import"
+        )
+    for field in ("family", "exec_key", "step", "steps_total", "seed",
+                  "prompt_crc", "leaves"):
+        if field not in meta:
+            raise MigrationRejectedError(
+                f"carry snapshot header missing field {field!r}"
+            )
+    leaves: List[np.ndarray] = []
+    off = header_off + header_len
+    for i, desc in enumerate(meta["leaves"]):
+        try:
+            shape = tuple(int(d) for d in desc["shape"])
+            dtype = np.dtype(desc["dtype"])
+            nbytes = int(desc["nbytes"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise MigrationRejectedError(
+                f"carry snapshot leaf {i} descriptor malformed: {exc}"
+            ) from exc
+        expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes != expect:
+            raise MigrationRejectedError(
+                f"carry snapshot leaf {i} descriptor inconsistent: "
+                f"{nbytes} bytes for shape {shape} {dtype.name} "
+                f"(want {expect})"
+            )
+        if off + nbytes > len(payload):
+            raise MigrationRejectedError(
+                f"carry snapshot truncated inside leaf {i}"
+            )
+        leaves.append(np.frombuffer(
+            payload, dtype=dtype, count=expect // dtype.itemsize,
+            offset=off).reshape(shape).copy())
+        off += nbytes
+    if off != len(payload):
+        raise MigrationRejectedError(
+            f"carry snapshot has {len(payload) - off} trailing bytes "
+            "after the last described leaf"
+        )
+    return CarrySnapshot(meta=meta, leaves=tuple(leaves))
+
+
+def check_identity(snap: CarrySnapshot, *, prompt: str,
+                   seed: int) -> None:
+    """The snapshot must belong to the request being re-dispatched —
+    resuming someone else's latent under this request's identity would
+    be silent cross-request corruption."""
+    if int(snap.meta["seed"]) != int(seed):
+        raise MigrationRejectedError(
+            f"carry snapshot seed {snap.meta['seed']} does not match "
+            f"the re-dispatched request's seed {seed}"
+        )
+    if int(snap.meta["prompt_crc"]) != prompt_crc(prompt):
+        raise MigrationRejectedError(
+            "carry snapshot prompt fingerprint does not match the "
+            "re-dispatched request's prompt"
+        )
+
+
+def check_key_compatible(snap: CarrySnapshot, ekey: ExecKey) -> None:
+    """Field-for-field ExecKey equality — the strict rule.
+
+    Every key field is compile identity (bucket, steps, cfg, mesh plan,
+    cadence, compression, quantization, exec mode...), and bit-identity
+    of the resumed run is only guaranteed when the importer replays the
+    EXACT per-step program family the exporter ran, so any drift — even
+    a ladder/tier rung difference between replicas — rejects typed and
+    falls back to from-step-0 rather than resuming under different
+    numerics."""
+    want = snap.exec_key
+    have = dataclasses.asdict(ekey)
+    if want != have:
+        diff = sorted(
+            k for k in set(want) | set(have) if want.get(k) != have.get(k)
+        )
+        raise MigrationRejectedError(
+            "carry snapshot ExecKey incompatible with the importing "
+            f"replica's key (differs in {', '.join(diff)}): exporter "
+            f"{want}, importer {have}"
+        )

@@ -100,6 +100,12 @@ class Request:
     # cheap downsampled-latent image — keep it fast; a slow callback
     # stalls the whole step loop.  Set at construction, never mutated.
     on_progress: Any = None
+    # carry migration (serve/migration.py): the DECODED snapshot
+    # (`CarrySnapshot`) this re-dispatched request resumes from —
+    # validated synchronously at submit, imported at step admission.
+    # None for every fresh (non-migrated) request.  Set at construction,
+    # never mutated.
+    carry_snapshot: Any = None
 
     def expired(self, now: float) -> bool:
         return now >= self.deadline
@@ -142,6 +148,12 @@ class ServeResult:
     previews: int = 0
     first_preview_s: Optional[float] = None
     preempts: int = 0
+    # carry migration (serve/migration.py): how many times this request
+    # resumed from an imported carry snapshot (0 = never migrated), and
+    # how many already-completed denoise steps those imports salvaged —
+    # steps the fleet did NOT re-execute after a replica kill/drain.
+    migrations: int = 0
+    steps_salvaged: int = 0
 
 
 class RequestQueue:

@@ -41,11 +41,14 @@ Health scoring (the routing signal, docs/SERVING.md "Fleet"):
 Non-serving replicas score 0.0.
 
 Fault injection: the ``"replica"`` site (serve/faults.py) is consulted at
-the top of every monolithic executor dispatch, keyed by the REPLICA NAME
-(``key_substr`` targets one replica).  The ``kill`` kind models the
-replica process dying: the handle transitions to STOPPED, its server
-shuts down in the background (queued work fails with `ServerClosedError`
-for the router to re-dispatch), and the in-flight batch fails terminally.
+the top of every monolithic executor dispatch AND every step-granular
+cohort step, keyed by the REPLICA NAME (``key_substr`` targets one
+replica).  The ``kill`` kind models the replica process dying: the handle
+transitions to STOPPED, its server shuts down in the background (queued
+work fails with `ServerClosedError` for the router to re-dispatch), and
+the in-flight batch fails terminally — except mid-denoise carries under
+step batching, which the dying scheduler EXPORTS (serve/migration.py) so
+the fleet migrates them instead of re-running from step 0.
 """
 
 from __future__ import annotations
@@ -97,10 +100,12 @@ class _ReplicaSiteKey:
 
 class _FaultGuardedExecutor:
     """Executor wrapper consulting the ``"replica"`` fault site before
-    every monolithic dispatch.  Everything else (``batch_size``,
-    ``attach_prompt_cache``, stage programs) delegates — note the staged
-    path calls stage methods directly, so replica faults fire on the
-    monolithic ``__call__`` only."""
+    every monolithic dispatch AND every step-granular cohort step, so a
+    ``kill`` rule can fell a replica mid-denoise (the carry-migration
+    chaos path).  Everything else (``batch_size``,
+    ``attach_prompt_cache``, stage programs, the remaining step hooks)
+    delegates — note the staged path calls stage methods directly, so
+    replica faults fire on ``__call__``/``step_run`` only."""
 
     def __init__(self, inner: Any, replica: "Replica"):
         self._inner = inner
@@ -112,6 +117,10 @@ class _FaultGuardedExecutor:
     def __call__(self, *args, **kwargs):
         self._replica._check_replica_fault()
         return self._inner(*args, **kwargs)
+
+    def step_run(self, works):
+        self._replica._check_replica_fault()
+        return self._inner.step_run(works)
 
 
 class Replica:
@@ -286,15 +295,31 @@ class Replica:
         return self
 
     def drain(self, release: bool = False,
-              timeout: Optional[float] = None) -> None:
+              timeout: Optional[float] = None,
+              drain_deadline_s: Optional[float] = None) -> None:
         """Stop admitting; queued + in-flight work FINISHES (the server
         keeps running).  With ``release`` additionally wait (wall-clock,
         up to ``timeout`` seconds) for quiescence and then stop — the
         scale-down path.  Without it the replica stays DRAINING and can
-        `resume()` (the fleet's half-open probe)."""
+        `resume()` (the fleet's half-open probe).
+
+        ``drain_deadline_s`` BOUNDS the drain: wait that many wall-clock
+        seconds for quiescence, then stop the server anyway.  Under step
+        batching the forced stop EXPORTS every remaining mid-denoise
+        carry (serve/migration.py — the futures fail with
+        `CarryExportedError` carrying the snapshot) so the fleet
+        re-dispatches each one at its current step on another replica: a
+        slow request delays scale-down by at most the deadline and loses
+        none of its completed steps."""
         with self._lock:
             if self._state == REPLICA_SERVING:
                 self._transition(REPLICA_DRAINING)
+        if drain_deadline_s is not None:
+            deadline = time.monotonic() + float(drain_deadline_s)
+            while self.pending() > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.stop(timeout=30.0 if timeout is None else float(timeout))
+            return
         if release:
             deadline = time.monotonic() + (30.0 if timeout is None
                                            else float(timeout))

@@ -57,12 +57,14 @@ from .cache import ExecKey, ExecutorCache
 from .errors import (
     AdmissionRejectedError,
     BuildFailedError,
+    CarryExportedError,
     CircuitOpenError,
     DeadlineExceededError,
     DegradationInapplicableError,
     ExecuteFailedError,
     ExecutorContractError,
     FatalError,
+    MigrationRejectedError,
     NoBucketError,
     QueueFullError,
     ResourceExhaustedError,
@@ -74,6 +76,12 @@ from .errors import (
     is_oom,
 )
 from .faults import FaultPlan
+from .migration import (
+    check_identity,
+    check_key_compatible,
+    decode_snapshot,
+    encode_snapshot,
+)
 from .queue import Request, RequestQueue, ServeResult
 from .resilience import (
     RUNG_SPLIT,
@@ -531,6 +539,7 @@ class InferenceServer:
         slo_class: str = "default",
         tenant: str = "default",
         on_progress: Optional[Callable[..., Any]] = None,
+        carry_snapshot: Optional[bytes] = None,
     ) -> Future:
         """Admit one request; returns a Future of `ServeResult`.
 
@@ -556,9 +565,35 @@ class InferenceServer:
         previews (step-level continuous batching only): fires on the
         scheduler thread every ``step_batching.preview_interval`` steps
         with a cheap downsampled-latent image.  Keep it fast; ignored on
-        whole-batch servers."""
+        whole-batch servers.
+
+        ``carry_snapshot`` — carry migration (serve/migration.py): the
+        encoded bytes a dying replica exported for this same request
+        (`CarryExportedError.snapshot`).  Decoded and identity-checked
+        HERE, synchronously — `MigrationRejectedError` (retryable) means
+        the caller must strip the snapshot and resubmit from step 0;
+        ExecKey compatibility is checked later at step admission, where
+        the executing key is known.  Step-batching servers only."""
         if not self._started or self._stop.is_set():
             raise ServerClosedError("server is not running")
+        snap = None
+        if carry_snapshot is not None:
+            if self.stepbatch is None:
+                raise MigrationRejectedError(
+                    "carry import needs step-level continuous batching "
+                    "(ServeConfig.step_batching.enabled) on the "
+                    "importing replica"
+                )
+            data = carry_snapshot
+            if self.fault_plan is not None:
+                # chaos site: corruption in flight between replicas
+                data = self.fault_plan.mutate("migrate.import", data)
+            try:
+                snap = decode_snapshot(data)
+                check_identity(snap, prompt=prompt, seed=seed)
+            except MigrationRejectedError:
+                self.counters.inc("migrations_rejected")
+                raise
         if self.controller is not None and not self.controller.admit(
                 str(slo_class)):
             # the controller's extreme rung: even the cheapest tier cannot
@@ -586,6 +621,7 @@ class InferenceServer:
             deadline=self.clock() + ttl,
             enqueue_ts=self.clock(),
             on_progress=on_progress,
+            carry_snapshot=snap,
         )
         if self.tracer is not None:
             self._trace_submit(req, steps)
@@ -677,6 +713,8 @@ class InferenceServer:
             pass  # cancelled/raced future: the caller gave up on it
 
     _OUTCOMES = {
+        "CarryExportedError": "carry_exported",
+        "MigrationRejectedError": "migration_rejected",
         "ServerClosedError": "server_closed",
         "DeadlineExceededError": "deadline_exceeded",
         "CircuitOpenError": "shed_circuit_open",
@@ -964,9 +1002,32 @@ class InferenceServer:
                 "patch-parallel pipeline or a step-capable fake"),
                 invalidate=False)
             return False
+        snap = req.carry_snapshot
         try:
-            work = executor.step_begin(req.prompt, req.negative_prompt,
-                                       req.seed, req.guidance_scale)
+            if snap is not None:
+                # carry migration import: the snapshot's envelope and
+                # request identity were validated at submit; HERE the
+                # executing key is known, so compatibility is the last
+                # gate before grafting the leaves into a fresh work dict
+                check_key_compatible(snap, ekey)
+                if not hasattr(executor, "step_import"):
+                    raise MigrationRejectedError(
+                        f"executor for {ekey.short()} has no step_import "
+                        "— cannot adopt a migrated carry")
+                work = executor.step_import(
+                    snap.meta, list(snap.leaves), req.prompt,
+                    req.negative_prompt, req.seed, req.guidance_scale)
+            else:
+                work = executor.step_begin(req.prompt, req.negative_prompt,
+                                           req.seed, req.guidance_scale)
+        except MigrationRejectedError as exc:
+            # a bad snapshot is the SNAPSHOT's failure, not this
+            # replica's: fail typed without feeding the breaker/ladder —
+            # the fleet strips the snapshot and retries from step 0
+            self.cache.unpin(executor)
+            self.counters.inc("migrations_rejected")
+            self._fail_batch([req], exc)
+            return False
         except Exception as exc:  # noqa: BLE001 — typed below
             self.cache.unpin(executor)
             wexc = exc if isinstance(exc, ServeError) else (
@@ -980,16 +1041,22 @@ class InferenceServer:
             return False
         from .stepbatch import SlotState
 
+        salvaged = snap.step if snap is not None else 0
         state = SlotState(
             request=req, work=work, base_key=base_key, ekey=ekey,
             executor=executor, compile_hit=hit, steps_total=ekey.steps,
             tier_idx=tier_idx, admit_ts=self.clock(),
+            steps_done=salvaged, steps_salvaged=salvaged,
+            migrations=1 if snap is not None else 0,
         )
         slot = sb.admit(state)
         self._inflight_c.inc("requests", 1)
         req.bucket = (bh, bw)
         req.dequeue_ts = state.admit_ts
         self.counters.inc("step_joins")
+        if snap is not None:
+            self.counters.inc("carries_imported")
+            self.counters.inc("steps_salvaged", salvaged)
         if tier_idx is not None:
             self.controller.count_dispatch(tier_idx, 1)
         if self.tracer is not None and req.trace is not None:
@@ -1000,6 +1067,11 @@ class InferenceServer:
             self.tracer.event("join", track=rt.track, trace=rt.trace_id,
                               args={"slot": slot, "key": ekey.short(),
                                     "steps": state.steps_total})
+            if snap is not None:
+                self.tracer.event("migrate_in", track=rt.track,
+                                  trace=rt.trace_id,
+                                  args={"step": salvaged,
+                                        "of": state.steps_total})
         return True
 
     def _step_admit_failure(self, req: Request, base_key: ExecKey,
@@ -1132,6 +1204,14 @@ class InferenceServer:
                 fresh_abandon = (isinstance(exc, WatchdogTimeoutError)
                                  and abandoned is not None
                                  and abandoned is not prev_abandoned)
+                if self._stop.is_set() and not fresh_abandon:
+                    # raced a stop/kill mid-round: leave every remaining
+                    # member RESIDENT instead of failing it — the loop's
+                    # finally-drain exports each carry for migration (the
+                    # dispatch failed before any member's step advanced,
+                    # so the carries are valid at their current step),
+                    # and a dying server must not feed its own breaker
+                    break
                 if isinstance(exc, WatchdogTimeoutError):
                     self.counters.inc("watchdog_timeouts")
                     texc = exc
@@ -1290,18 +1370,90 @@ class InferenceServer:
             previews=state.previews,
             first_preview_s=state.first_preview_s,
             preempts=state.preempts,
+            migrations=state.migrations,
+            steps_salvaged=state.steps_salvaged,
         )
         self._step_release(state, abort=False)
         self._resolve(req.future, result=result)
 
+    def _step_export(self, state) -> Optional[bytes]:
+        """Serialize one resident carry for migration, or None when no
+        snapshot can ride out: export disabled, the executor lacks the
+        hook, the carry is at step 0 (nothing to salvage) or already
+        finished (retire, don't migrate), or the export itself failed —
+        the drain path then falls back to progress-only accounting."""
+        if not self.config.step_batching.export_carries:
+            return None
+        if not (0 < state.steps_done < state.steps_total):
+            return None
+        executor = state.executor
+        if not hasattr(executor, "step_export"):
+            return None
+        try:
+            extra, leaves = executor.step_export(state.work)
+            extra = dict(extra)
+            family = str(extra.pop("family", ""))
+            # the executor's own step index is authoritative — it and
+            # steps_done advance together, but the carry is what resumes
+            step = int(extra.pop("step", state.steps_done))
+            data = encode_snapshot(
+                ekey=state.ekey, family=family, step=step,
+                steps_total=state.steps_total,
+                request_id=str(state.request.request_id),
+                prompt=state.request.prompt, seed=state.request.seed,
+                leaves=list(leaves), extra=extra or None,
+            )
+        except Exception:  # noqa: BLE001 — export is best-effort
+            self.counters.inc("carry_export_failed")
+            return None
+        if self.fault_plan is not None:
+            # chaos site: truncation/corruption during the export write
+            data = self.fault_plan.mutate("migrate.export", data,
+                                          key=state.ekey)
+        return data
+
     def _step_drain(self) -> None:
         """Deterministic stop: every resident carry (occupied + parked)
-        resolves its future with `ServerClosedError` and releases its
-        buffers — no step-mode future is ever left unresolved."""
+        resolves its future and releases its buffers — no step-mode
+        future is ever left unresolved.  With ``export_carries`` on, a
+        mid-denoise carry first serializes (serve/migration.py) and
+        rides out on `CarryExportedError.snapshot` so the fleet's
+        failover resumes it on another replica instead of re-running
+        from step 0; a carry that cannot export still reports its
+        ``steps_done`` so the fleet can count the steps it is about to
+        re-execute."""
         sb = self.stepbatch
         for state in list(sb.occupied()) + list(sb.parked):
             self.counters.inc("rejected_server_closed")
-            self._step_fail_state(state, ServerClosedError("server stopped"))
+            data = self._step_export(state)
+            if data is not None:
+                self.counters.inc("carries_exported")
+                if (self.tracer is not None
+                        and state.request.trace is not None):
+                    rt = state.request.trace
+                    self.tracer.event(
+                        "migrate_out", track=rt.track, trace=rt.trace_id,
+                        args={"step": state.steps_done,
+                              "of": state.steps_total,
+                              "bytes": len(data)})
+                exc: ServerClosedError = CarryExportedError(
+                    f"server stopped at step {state.steps_done}/"
+                    f"{state.steps_total}; carry exported for migration",
+                    snapshot=data, steps_done=state.steps_done)
+            elif (self.config.step_batching.export_carries
+                    and state.steps_done > 0):
+                # export was ON but this carry could not serialize:
+                # progress-only accounting still rides out so the fleet
+                # can count the steps it is about to re-execute.  With
+                # export OFF the operator opted out of migration — the
+                # documented contract is the plain ServerClosedError path
+                exc = CarryExportedError(
+                    f"server stopped at step {state.steps_done}/"
+                    f"{state.steps_total}; carry not exportable",
+                    snapshot=None, steps_done=state.steps_done)
+            else:
+                exc = ServerClosedError("server stopped")
+            self._step_fail_state(state, exc)
 
     # -- the resilient execute path ---------------------------------------
 

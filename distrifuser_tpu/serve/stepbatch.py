@@ -84,6 +84,13 @@ class SlotState:
     preempts: int = 0
     previews: int = 0
     first_preview_s: Optional[float] = None
+    # carry migration (serve/migration.py): set at admission when this
+    # state resumed from an imported snapshot — how many imports the
+    # request has survived and how many completed steps they salvaged
+    # (steps_done starts at the salvaged step, never 0).  Surfaced on
+    # `ServeResult.migrations` / ``steps_salvaged``.
+    migrations: int = 0
+    steps_salvaged: int = 0
 
     @property
     def remaining(self) -> int:

@@ -201,6 +201,43 @@ class StepFakeExecutor(FakeExecutor):
         return fake_preview(work["prompt"], work["seed"], self.key,
                             work["i"])
 
+    # -- carry migration (serve/migration.py) ------------------------------
+    #
+    # The fake's "carry" is its step index plus the (prompt, seed)
+    # identity, exported as one int32 leaf so the envelope's leaf
+    # machinery (shape/dtype descriptors, checksum over raw bytes) is
+    # exercised end to end even on fakes.
+
+    def step_export(self, work: dict):
+        extra = {"family": type(self).__name__, "step": int(work["i"])}
+        return extra, [np.asarray([work["i"]], dtype=np.int32)]
+
+    def step_import(self, meta: dict, leaves, prompt: str,
+                    negative_prompt: str, seed: int,
+                    guidance_scale: float) -> dict:
+        from .errors import MigrationRejectedError
+
+        family = type(self).__name__
+        if meta.get("family") != family:
+            raise MigrationRejectedError(
+                f"carry snapshot family {meta.get('family')!r} cannot "
+                f"import into a {family} executor"
+            )
+        step = int(meta["step"])
+        if not (0 <= step <= self.key.steps):
+            raise MigrationRejectedError(
+                f"carry snapshot step {step} out of range for a "
+                f"{self.key.steps}-step executor"
+            )
+        if (len(leaves) != 1 or tuple(leaves[0].shape) != (1,)
+                or leaves[0].dtype != np.int32
+                or int(leaves[0][0]) != step):
+            raise MigrationRejectedError(
+                "carry snapshot leaves do not match the fake step "
+                "executor's carry structure"
+            )
+        return {"prompt": prompt, "seed": int(seed), "i": step}
+
 
 class StepFakeExecutorFactory(FakeExecutorFactory):
     """FakeExecutorFactory building step-granular fakes."""
@@ -236,6 +273,7 @@ class ExecutionLedger:
 
         self._lock = sync.Lock()
         self._counts: dict = {}
+        self._steps: dict = {}
 
     def record(self, prompt: str, seed: int, replica: str = "") -> None:
         with self._lock:
@@ -255,6 +293,43 @@ class ExecutionLedger:
         """{(prompt, seed): [replica, ...]} of completed executions."""
         with self._lock:
             return {k: list(v) for k, v in self._counts.items()}
+
+    # -- step-granular records (carry migration) ---------------------------
+    #
+    # The migration invariant is STEP-scoped: a salvaged step is never
+    # re-executed, so across the whole fleet every (request, step index)
+    # pair runs exactly once.  `StepLedgerFakeExecutor` records each
+    # completed denoise step here; ``max_step_count() <= 1`` proves zero
+    # double-executed steps the same way ``max_count()`` proves it for
+    # whole requests.
+
+    def record_step(self, prompt: str, seed: int, step: int,
+                    replica: str = "") -> None:
+        with self._lock:
+            per_req = self._steps.setdefault((prompt, int(seed)), {})
+            per_req.setdefault(int(step), []).append(replica)
+
+    def step_counts(self, prompt: str, seed: int) -> dict:
+        """{step_index: [replica, ...]} of one request's executed steps."""
+        with self._lock:
+            return {i: list(v) for i, v in
+                    self._steps.get((prompt, int(seed)), {}).items()}
+
+    def max_step_count(self) -> int:
+        """Max executions of any single (request, step) pair — the
+        exactly-once gate asserts this == 1 (0 with no steps)."""
+        with self._lock:
+            return max(
+                (len(v) for per in self._steps.values()
+                 for v in per.values()),
+                default=0,
+            )
+
+    def steps_snapshot(self) -> dict:
+        """{(prompt, seed): {step_index: [replica, ...]}}."""
+        with self._lock:
+            return {k: {i: list(v) for i, v in per.items()}
+                    for k, per in self._steps.items()}
 
 
 class LedgerFakeExecutor(FakeExecutor):
@@ -294,6 +369,59 @@ class LedgerFakeExecutorFactory(FakeExecutorFactory):
         return LedgerFakeExecutor(key, self.ledger, replica=self.replica,
                                   batch_size=self.batch_size,
                                   step_time_s=self.step_time_s)
+
+
+class StepLedgerFakeExecutor(StepFakeExecutor):
+    """`StepFakeExecutor` recording every COMPLETED denoise step (and
+    every completed request) in a shared `ExecutionLedger` — the
+    step-granular evidence behind the carry-migration exactly-once gate:
+    replica A records steps 0..k-1, the kill fires before step k
+    records, and the importing replica B records k..N-1, so
+    ``max_step_count() == 1`` proves salvaged steps never re-ran."""
+
+    def __init__(self, key: ExecKey, ledger: ExecutionLedger,
+                 replica: str = "", batch_size: int = 8,
+                 step_time_s: float = 0.0):
+        super().__init__(key, batch_size=batch_size,
+                         step_time_s=step_time_s)
+        self.ledger = ledger
+        self.replica = replica
+
+    def step_run(self, works: List[dict]) -> None:
+        pending = [(w["prompt"], w["seed"], w["i"]) for w in works]
+        super().step_run(works)
+        # record AFTER the step completed — a step killed mid-dispatch
+        # never records, exactly like work that died before output
+        for prompt, seed, step in pending:
+            self.ledger.record_step(prompt, seed, step, self.replica)
+
+    def step_finish(self, work: dict):
+        image = super().step_finish(work)
+        self.ledger.record(work["prompt"], work["seed"], self.replica)
+        return image
+
+
+class StepLedgerFakeExecutorFactory(FakeExecutorFactory):
+    """Per-replica factory building `StepLedgerFakeExecutor`s against
+    one shared ledger; ``replica`` tags which replica executed what."""
+
+    def __init__(self, ledger: ExecutionLedger, replica: str = "",
+                 batch_size: int = 8, build_delay_s: float = 0.0,
+                 step_time_s: float = 0.0):
+        super().__init__(batch_size=batch_size, build_delay_s=build_delay_s,
+                         step_time_s=step_time_s)
+        self.ledger = ledger
+        self.replica = replica
+
+    def _new_executor(self, key: ExecKey) -> StepLedgerFakeExecutor:
+        return StepLedgerFakeExecutor(
+            key, self.ledger, replica=self.replica,
+            batch_size=self.batch_size, step_time_s=self.step_time_s)
+
+    def step_calls(self) -> List[int]:
+        """Every cohort step's size, across all executors."""
+        return [n for ex in self.executors
+                for n in getattr(ex, "step_calls", ())]
 
 
 class StageTracker:

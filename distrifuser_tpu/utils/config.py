@@ -610,6 +610,13 @@ class StepBatchConfig:
       * ``step_service_prior_s`` — per-step service-time estimate used
         for EDF slack until measured steps calibrate it (the controller's
         calibrated estimate takes over when the controller is on).
+      * ``export_carries`` — on server stop/drain, serialize each
+        resident request's denoise carry (serve/migration.py) and fail
+        its future with `CarryExportedError` carrying the snapshot, so
+        the fleet router can migrate the request to a healthy replica
+        and resume at the SAME step instead of re-running from step 0.
+        Off, stop falls back to the plain `ServerClosedError` path
+        (every completed step is wasted and re-executed on retry).
     """
 
     enabled: bool = False
@@ -620,6 +627,7 @@ class StepBatchConfig:
     allow_preemption: bool = True
     preempt_margin_s: float = 0.0
     step_service_prior_s: float = 0.01
+    export_carries: bool = True
 
     def __post_init__(self) -> None:
         if self.slots < 1:

@@ -29,10 +29,29 @@ Emits ONE ``"schema": 1`` JSON line (scripts/common.py); ``--out``
 writes the full artifact, ``--trace_out`` the fault run's Perfetto
 trace (failovers/drains/restarts land on the "fleet" track).
 
+**``--migrate``** switches to the carry-migration variant: a 3-replica
+STEP-BATCHING fleet (serve/stepbatch.py) of step-granular fakes, one
+replica killed mid-denoise after ``--kill_after_steps`` cohort-step
+dispatches.  The dying replica exports every resident carry
+(serve/migration.py) and the router's failover re-dispatches the
+snapshots, so the survivors RESUME the victim's work at the step it
+reached instead of re-running it.  The shared ledger records every
+completed denoise step, and the gates are step-scoped:
+
+  * ``--min_availability`` — completed / submitted (acceptance: 0.99);
+  * zero double-executed steps — ``max_step_count() == 1`` across the
+    whole fleet (a salvaged step never re-runs; always on);
+  * ``--min_salvage`` — fleet ``steps_salvaged`` >= this fraction of
+    the victim's pre-kill completed steps on migrated requests
+    (acceptance: 0.8 — migration must actually carry the work over).
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/fleet_bench.py \
         [--requests 120] [--rate 40] [--min_availability 0.99] \
         [--p99_gate 2.0] [--out FILE] [--trace_out FILE]
+    JAX_PLATFORMS=cpu python scripts/fleet_bench.py --migrate \
+        [--steps 8] [--kill_after_steps 24] [--min_salvage 0.8] \
+        [--out FILE]
 """
 
 from __future__ import annotations
@@ -175,13 +194,225 @@ def run_load(args, *, kill: bool, trace: bool = False) -> dict:
     }
 
 
+def run_migrate(args) -> dict:
+    """One open-loop run over a fresh 3-replica STEP-BATCHING fleet with
+    a mid-denoise kill; returns the measurement with step-granular
+    salvage accounting."""
+    from distrifuser_tpu.serve import (
+        FaultPlan,
+        FaultRule,
+        FleetConfig,
+        FleetRouter,
+        Replica,
+        ResilienceConfig,
+        RetryableError,
+        ServeConfig,
+        StepBatchConfig,
+    )
+    from distrifuser_tpu.serve.testing import (
+        ExecutionLedger,
+        StepLedgerFakeExecutorFactory,
+    )
+    from distrifuser_tpu.utils.metrics import MetricsRegistry
+
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=args.batch_window_s,
+        buckets=((512, 512),),
+        warmup_buckets=(),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        resilience=ResilienceConfig(
+            max_retries=1, backoff_base_s=0.005, backoff_max_s=0.05,
+            seed=args.seed,
+        ),
+        step_batching=StepBatchConfig(
+            enabled=True, slots=args.max_batch_size,
+            step_service_prior_s=args.fake_step_s,
+        ),
+    )
+    # the "replica" site counts every cohort-step dispatch fleet-wide;
+    # the rule arms after --kill_after_steps of them and fires ONCE on
+    # the victim's next step — a deterministic mid-denoise kill
+    plan = FaultPlan([
+        FaultRule(site="replica", kind="kill", key_substr=args.victim,
+                  p=1.0, max_fires=1, after_calls=args.kill_after_steps),
+    ], seed=args.seed)
+    registry = MetricsRegistry()
+    ledger = ExecutionLedger()
+    replicas = [
+        Replica(
+            name,
+            StepLedgerFakeExecutorFactory(
+                ledger, replica=name, batch_size=args.max_batch_size,
+                step_time_s=args.fake_step_s,
+            ),
+            config,
+            capacity_weight=1.0,
+            model_id="fleet-bench",
+            fault_plan=plan,
+            registry=registry,
+        )
+        for name in ("r0", args.victim, "r2")
+    ]
+    fleet = FleetRouter(
+        replicas,
+        FleetConfig(tick_s=0.02, probe_cooldown_s=1.0),
+        registry=registry,
+    )
+    n = args.requests
+    interval = 1.0 / args.rate
+    futures = []
+    rejected = 0
+    t0 = time.monotonic()
+    with fleet:
+        for i in range(n):
+            try:
+                futures.append(fleet.submit(
+                    PROMPTS[i % len(PROMPTS)] + f" #{i}",
+                    height=512, width=512, seed=i, ttl_s=args.ttl_s,
+                    num_inference_steps=args.steps,
+                ))
+            except RetryableError:
+                rejected += 1
+            time.sleep(interval)
+        lat = []
+        failed = 0
+        migrated_results = 0
+        for f in futures:
+            try:
+                r = f.result(timeout=args.ttl_s + 30)
+                lat.append(r.e2e_s)
+                if getattr(r, "migrations", 0):
+                    migrated_results += 1
+            except Exception:  # noqa: BLE001 — counted, gated below
+                failed += 1
+        wall = time.monotonic() - t0
+        snap = fleet.metrics_snapshot()
+        health = fleet.health()
+    lat.sort()
+    p99 = lat[max(0, int(0.99 * (len(lat) - 1)))] if lat else float("inf")
+    # step-granular salvage accounting: for each request that FINISHED
+    # on a survivor after executing steps on the victim, the victim's
+    # recorded steps are the pre-kill progress migration should carry
+    # over (the killed step itself never records — see
+    # StepLedgerFakeExecutor)
+    completions = ledger.snapshot()
+    pre_kill_steps = 0
+    migrated_requests = 0
+    for req_key, per_step in ledger.steps_snapshot().items():
+        victim_steps = sum(1 for replicas_ in per_step.values()
+                           if args.victim in replicas_)
+        finishers = completions.get(req_key, [])
+        if victim_steps and finishers and finishers[-1] != args.victim:
+            pre_kill_steps += victim_steps
+            migrated_requests += 1
+    counters = snap["fleet"]["requests"]
+    return {
+        "offered": n,
+        "rejected": rejected,
+        "completed": len(lat),
+        "failed": failed,
+        "availability": len(lat) / n if n else 0.0,
+        "p99_e2e_s": p99,
+        "wall_s": wall,
+        "max_step_executions": ledger.max_step_count(),
+        "executed_twice": sum(
+            1 for execs in completions.values() if len(execs) > 1),
+        "pre_kill_steps": pre_kill_steps,
+        "migrated_requests": migrated_requests,
+        "migrated_results": migrated_results,
+        "steps_salvaged": counters.get("steps_salvaged", 0),
+        "faults_fired": plan.fired(),
+        "fleet_counters": counters,
+        "health_status": health["status"],
+    }
+
+
+def main_migrate(args) -> int:
+    run = run_migrate(args)
+    salvage_ratio = (run["steps_salvaged"] / run["pre_kill_steps"]
+                     if run["pre_kill_steps"] else 0.0)
+    artifact = {
+        "bench": {
+            "mode": "migrate",
+            "requests": args.requests,
+            "rate_rps": args.rate,
+            "steps": args.steps,
+            "fake_step_s": args.fake_step_s,
+            "victim": args.victim,
+            "kill_after_steps": args.kill_after_steps,
+            "min_availability": args.min_availability,
+            "min_salvage": args.min_salvage,
+            "seed": args.seed,
+        },
+        "migrate": run,
+        "salvage_ratio": salvage_ratio,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    emit_bench_line({
+        "metric": "fleet_carry_migration_salvage",
+        "value": round(salvage_ratio, 4),
+        "unit": "fraction",
+        "availability": round(run["availability"], 4),
+        "p99_e2e_s": round(run["p99_e2e_s"], 4),
+        "steps_salvaged": run["steps_salvaged"],
+        "pre_kill_steps": run["pre_kill_steps"],
+        "migrated_requests": run["migrated_requests"],
+        "max_step_executions": run["max_step_executions"],
+        "migrations": run["fleet_counters"].get("migrations", 0),
+        "migrations_rejected": run["fleet_counters"].get(
+            "migrations_rejected", 0),
+        "faults_fired": run["faults_fired"],
+    })
+    fail = []
+    if run["faults_fired"].get("replica/kill", 0) != 1:
+        fail.append(
+            f"kill fired {run['faults_fired'].get('replica/kill', 0)} "
+            "times (want exactly 1) — the run did not test replica loss")
+    if run["fleet_counters"].get("migrations", 0) < 1:
+        fail.append("no carry migrated — the kill landed with nothing "
+                    "mid-denoise; lower --kill_after_steps or raise load")
+    if run["max_step_executions"] > 1:
+        fail.append(
+            f"a (request, step) pair executed "
+            f"{run['max_step_executions']} times — salvaged steps "
+            "re-ran; the exactly-once STEP invariant is broken")
+    if run["executed_twice"]:
+        fail.append(
+            f"{run['executed_twice']} request(s) completed twice — the "
+            "failover invariant is broken")
+    if (args.min_availability > 0
+            and run["availability"] < args.min_availability):
+        fail.append(
+            f"availability {run['availability']:.4f} < gate "
+            f"{args.min_availability}")
+    if args.min_salvage > 0 and run["pre_kill_steps"] > 0 \
+            and salvage_ratio < args.min_salvage:
+        fail.append(
+            f"salvage ratio {salvage_ratio:.3f} < gate "
+            f"{args.min_salvage} — migration re-ran pre-kill work")
+    if fail:
+        print("GATE FAILED: " + "; ".join(fail), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=120,
                     help="open-loop submissions per run")
-    ap.add_argument("--rate", type=float, default=40.0,
-                    help="open-loop arrival rate (rps)")
-    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate (rps; default 40, or "
+                         "150 with --migrate — migration needs every "
+                         "replica busy when the kill lands)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="denoise steps per request (default 4, or 8 "
+                         "with --migrate)")
     ap.add_argument("--fake_step_s", type=float, default=0.01,
                     help="simulated per-step latency of the fakes")
     ap.add_argument("--max_batch_size", type=int, default=4)
@@ -196,6 +427,18 @@ def main(argv=None) -> int:
     ap.add_argument("--restart_at", type=float, default=0.6,
                     help="fraction of the load after which the victim "
                          "is restarted (the recovery edge)")
+    ap.add_argument("--migrate", action="store_true",
+                    help="carry-migration variant: step-batching fleet, "
+                         "mid-denoise kill, exported carries resume on "
+                         "the survivors (gates: availability, zero "
+                         "double-executed STEPS, salvage ratio)")
+    ap.add_argument("--kill_after_steps", type=int, default=40,
+                    help="with --migrate: fleet-wide cohort-step "
+                         "dispatches before the kill rule arms")
+    ap.add_argument("--min_salvage", type=float, default=0.8,
+                    help="with --migrate: steps_salvaged must be >= this "
+                         "fraction of the victim's pre-kill completed "
+                         "steps (0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--min_availability", type=float, default=0.99,
                     help="loss-and-recovery availability gate "
@@ -210,6 +453,17 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # per-mode defaults: the failover run wants headroom (the p99 gate
+    # compares against an uncongested baseline); the migrate run wants
+    # PRESSURE, so every replica holds mid-denoise carries at kill time
+    if args.rate is None:
+        args.rate = 150.0 if args.migrate else 40.0
+    if args.steps is None:
+        args.steps = 8 if args.migrate else 4
+
+    if args.migrate:
+        return main_migrate(args)
 
     baseline = run_load(args, kill=False)
     fault = run_load(args, kill=True, trace=bool(args.trace_out))

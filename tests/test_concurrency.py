@@ -261,6 +261,8 @@ def test_scenario_suite_covers_the_issue_catalog():
         "stepbatch_stop_midpreview",
         # ISSUE 16: distrigate HTTP/SSE gateway
         "gateway_stop_midstream", "gateway_cancel_final_race",
+        # ISSUE 18: cross-replica carry migration
+        "stepbatch_kill_during_carry_export", "stepbatch_migrate_vs_cancel",
     }
 
 

@@ -1,0 +1,574 @@
+"""Cross-replica carry migration (distrifuser_tpu/serve/migration.py):
+the versioned/checksummed snapshot envelope and its typed rejections,
+bit-identity of exported-and-imported carries on the fakes (all three
+families) and the real tiny SD config, `Replica.drain(drain_deadline_s)`
+export semantics, the fleet's exactly-once STEP invariant under a
+mid-denoise kill, and the from-step-0 fallback when a snapshot arrives
+corrupt."""
+
+import dataclasses
+import hashlib
+import json
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from distrifuser_tpu.serve import (
+    CarryExportedError,
+    ExecKey,
+    FaultPlan,
+    FaultRule,
+    FleetConfig,
+    FleetRouter,
+    InferenceServer,
+    MigrationRejectedError,
+    REPLICA_STOPPED,
+    Replica,
+    ServeConfig,
+    ServerClosedError,
+    StepBatchConfig,
+)
+from distrifuser_tpu.serve.migration import (
+    FORMAT_VERSION,
+    MAGIC,
+    check_identity,
+    check_key_compatible,
+    decode_snapshot,
+    encode_snapshot,
+)
+from distrifuser_tpu.serve.testing import (
+    ExecutionLedger,
+    StepFakeExecutorFactory,
+    StepLedgerFakeExecutorFactory,
+    fake_image,
+)
+from distrifuser_tpu.utils.metrics import MetricsRegistry
+
+
+def key_for(model="m", h=64, w=64, steps=4, exec_mode="step", **kw):
+    return ExecKey(model_id=model, scheduler="ddim", height=h, width=w,
+                   steps=steps, cfg=True, mesh_plan="dp1.cfg1.sp1",
+                   exec_mode=exec_mode, **kw)
+
+
+def step_config(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("slots", 4)
+    return StepBatchConfig(**kw)
+
+
+def serve_config(**kw):
+    kw.setdefault("max_queue_depth", 32)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_window_s", 0.001)
+    kw.setdefault("buckets", ((64, 64),))
+    kw.setdefault("warmup_buckets", ())
+    kw.setdefault("default_steps", 4)
+    kw.setdefault("default_ttl_s", 60.0)
+    kw.setdefault("step_batching", step_config())
+    return ServeConfig(**kw)
+
+
+def mk_envelope(*, step=2, steps_total=6, prompt="a cat", seed=7,
+                leaves=None, extra=None, ekey=None):
+    if leaves is None:
+        leaves = [np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.asarray([step], dtype=np.int32)]
+    return encode_snapshot(
+        ekey=ekey or key_for(steps=steps_total), family="StepFakeExecutor",
+        step=step, steps_total=steps_total, request_id="rq-1",
+        prompt=prompt, seed=seed, leaves=leaves, extra=extra)
+
+
+def tamper_header(data: bytes, fn) -> bytes:
+    """Rewrite the envelope's JSON header through ``fn(meta)`` and
+    re-sign, so only the targeted field is invalid — not the checksum."""
+    payload = data[:-32]
+    (hlen,) = struct.unpack_from(">I", payload, len(MAGIC))
+    off = len(MAGIC) + 4
+    meta = json.loads(payload[off:off + hlen])
+    meta = fn(meta) or meta
+    header = json.dumps(meta, sort_keys=True).encode("utf-8")
+    body = MAGIC + struct.pack(">I", len(header)) + header \
+        + payload[off + hlen:]
+    return body + hashlib.sha256(body).digest()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        time.sleep(0.002)
+
+
+# --------------------------------------------------------------------------
+# envelope: round-trip + every rejection class
+# --------------------------------------------------------------------------
+
+
+def test_snapshot_round_trip_preserves_leaves_and_meta():
+    leaves = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              np.asarray([3], dtype=np.int32),
+              np.asarray([[True, False]], dtype=np.bool_)]
+    data = mk_envelope(step=3, steps_total=8, leaves=leaves,
+                       extra={"note": "x"})
+    snap = decode_snapshot(data)
+    assert snap.step == 3 and snap.steps_total == 8
+    assert snap.family == "StepFakeExecutor"
+    assert snap.meta["format"] == FORMAT_VERSION
+    assert snap.meta["note"] == "x"
+    assert snap.exec_key == dataclasses.asdict(key_for(steps=8))
+    assert len(snap.leaves) == 3
+    for got, want in zip(snap.leaves, leaves):
+        assert got.dtype == want.dtype and got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+    # encoding is deterministic: the same carry always wires identically
+    assert mk_envelope(step=3, steps_total=8, leaves=leaves,
+                       extra={"note": "x"}) == data
+
+
+def test_rejects_truncation():
+    data = mk_envelope()
+    with pytest.raises(MigrationRejectedError, match="truncated"):
+        decode_snapshot(data[:20])  # below the envelope floor
+    with pytest.raises(MigrationRejectedError, match="checksum"):
+        decode_snapshot(data[:-10])  # digest no longer matches
+
+
+def test_rejects_checksum_corruption_anywhere():
+    data = mk_envelope()
+    for pos in (2, len(MAGIC) + 6, len(data) // 2):  # magic/header/leaf
+        corrupt = bytearray(data)
+        corrupt[pos] ^= 0xFF
+        with pytest.raises(MigrationRejectedError, match="checksum"):
+            decode_snapshot(bytes(corrupt))
+
+
+def test_rejects_bad_magic_and_non_bytes():
+    data = mk_envelope()
+    body = b"NOPE" + data[len(MAGIC):-32]
+    body += hashlib.sha256(body).digest()  # valid digest, wrong magic
+    with pytest.raises(MigrationRejectedError, match="magic"):
+        decode_snapshot(body)
+    with pytest.raises(MigrationRejectedError, match="bytes"):
+        decode_snapshot({"not": "bytes"})
+
+
+def test_rejects_version_skew():
+    data = tamper_header(mk_envelope(), lambda m: {**m, "format": 99})
+    with pytest.raises(MigrationRejectedError, match="version 99"):
+        decode_snapshot(data)
+
+
+def test_rejects_malformed_or_incomplete_header():
+    data = mk_envelope()
+    payload = data[:-32]
+    (hlen,) = struct.unpack_from(">I", payload, len(MAGIC))
+    off = len(MAGIC) + 4
+    body = payload[:off] + b"{" * hlen + payload[off + hlen:]
+    body += hashlib.sha256(body).digest()
+    with pytest.raises(MigrationRejectedError, match="JSON"):
+        decode_snapshot(body)
+
+    def drop_seed(meta):
+        del meta["seed"]
+        return meta
+
+    with pytest.raises(MigrationRejectedError, match="missing field"):
+        decode_snapshot(tamper_header(data, drop_seed))
+
+
+def test_rejects_leaf_descriptor_drift():
+    data = mk_envelope()
+
+    def break_nbytes(meta):
+        meta["leaves"][0]["nbytes"] += 4
+        return meta
+
+    with pytest.raises(MigrationRejectedError, match="inconsistent"):
+        decode_snapshot(tamper_header(data, break_nbytes))
+
+    def break_dtype(meta):
+        meta["leaves"][0]["dtype"] = "not-a-dtype"
+        return meta
+
+    with pytest.raises(MigrationRejectedError, match="malformed"):
+        decode_snapshot(tamper_header(data, break_dtype))
+
+    def grow_leaf(meta):
+        # descriptor self-consistent but larger than the payload holds
+        meta["leaves"][0]["shape"] = [30, 4]
+        meta["leaves"][0]["nbytes"] = 30 * 4 * 4
+        return meta
+
+    with pytest.raises(MigrationRejectedError, match="truncated inside"):
+        decode_snapshot(tamper_header(data, grow_leaf))
+
+
+def test_rejects_trailing_bytes():
+    data = mk_envelope()
+    body = data[:-32] + b"\x00\x00"
+    body += hashlib.sha256(body).digest()
+    with pytest.raises(MigrationRejectedError, match="trailing"):
+        decode_snapshot(body)
+
+
+def test_identity_checks_seed_and_prompt():
+    snap = decode_snapshot(mk_envelope(prompt="a cat", seed=7))
+    check_identity(snap, prompt="a cat", seed=7)
+    with pytest.raises(MigrationRejectedError, match="seed"):
+        check_identity(snap, prompt="a cat", seed=8)
+    with pytest.raises(MigrationRejectedError, match="prompt"):
+        check_identity(snap, prompt="a dog", seed=7)
+
+
+def test_exec_key_compatibility_is_field_for_field():
+    snap = decode_snapshot(mk_envelope(steps_total=6))
+    check_key_compatible(snap, key_for(steps=6))
+    with pytest.raises(MigrationRejectedError, match="steps"):
+        check_key_compatible(snap, key_for(steps=8))
+    # even a quality-rung difference between replicas rejects: resuming
+    # under a different compiled program family would drift numerics
+    with pytest.raises(MigrationRejectedError, match="comm_compress"):
+        check_key_compatible(
+            snap, key_for(steps=6, comm_compress="int8"))
+
+
+# --------------------------------------------------------------------------
+# fakes: export -> fresh server generation import, all three families
+# --------------------------------------------------------------------------
+
+
+def _run_solo(model, prompt, seed, steps):
+    fac = StepFakeExecutorFactory(batch_size=4)
+    with InferenceServer(fac, serve_config(), model_id=model) as server:
+        out = server.submit(prompt, height=64, width=64, seed=seed,
+                            num_inference_steps=steps).result(timeout=30)
+    return out.output
+
+
+@pytest.mark.parametrize("model", ["unet", "dit", "mmdit"])
+def test_exported_carry_resumes_bit_identically_on_fresh_server(model):
+    """Stop a step server mid-denoise; its carry rides out on
+    `CarryExportedError` and a FRESH server generation imports it and
+    finishes — byte-identical to an unmigrated solo run, with the
+    salvage visible on the result and both servers' counters."""
+    steps = 40
+    fac_a = StepFakeExecutorFactory(batch_size=4, step_time_s=0.005)
+    server_a = InferenceServer(fac_a, serve_config(), model_id=model)
+    server_a.start(warmup=False)
+    fut = server_a.submit("a cat", height=64, width=64, seed=7,
+                          num_inference_steps=steps)
+    wait_for(lambda: any(s.steps_done >= 2
+                         for s in server_a.stepbatch.occupied()),
+             msg="mid-denoise progress")
+    server_a.stop(timeout=30.0)
+    with pytest.raises(CarryExportedError) as ei:
+        fut.result(timeout=5)
+    exc = ei.value
+    assert exc.snapshot is not None and exc.steps_done >= 2
+    snap = decode_snapshot(exc.snapshot)
+    assert snap.step == exc.steps_done and 0 < snap.step < steps
+    assert snap.family == "StepFakeExecutor"
+    assert server_a.metrics_snapshot()["requests"]["carries_exported"] == 1
+
+    fac_b = StepFakeExecutorFactory(batch_size=4)
+    with InferenceServer(fac_b, serve_config(), model_id=model) as server_b:
+        out = server_b.submit("a cat", height=64, width=64, seed=7,
+                              num_inference_steps=steps,
+                              carry_snapshot=exc.snapshot).result(timeout=30)
+    assert out.migrations == 1 and out.steps_salvaged == snap.step
+    reqs = server_b.metrics_snapshot()["requests"]
+    assert reqs["carries_imported"] == 1
+    assert reqs["steps_salvaged"] == snap.step
+    np.testing.assert_array_equal(out.output,
+                                  _run_solo(model, "a cat", 7, steps))
+
+
+def test_import_identity_mismatch_rejects_at_submit():
+    data = mk_envelope(step=2, steps_total=4)
+    fac = StepFakeExecutorFactory(batch_size=4)
+    with InferenceServer(fac, serve_config()) as server:
+        with pytest.raises(MigrationRejectedError, match="seed"):
+            server.submit("a cat", height=64, width=64, seed=999,
+                          carry_snapshot=data)
+        # a flipped bit anywhere rejects as corruption, synchronously
+        corrupt = bytearray(data)
+        corrupt[len(corrupt) // 2] ^= 0xFF
+        with pytest.raises(MigrationRejectedError, match="checksum"):
+            server.submit("a cat", height=64, width=64, seed=7,
+                          carry_snapshot=bytes(corrupt))
+    reqs = server.metrics_snapshot()["requests"]
+    assert reqs["migrations_rejected"] == 2
+
+
+def test_import_exec_key_mismatch_fails_future_typed():
+    """Identity passes at submit; the ExecKey gate fires at step
+    admission where the executing key is known — a steps mismatch means
+    a different compiled program family, so the import fails typed
+    instead of resuming under different numerics."""
+    data = mk_envelope(step=2, steps_total=6, ekey=key_for(
+        model="model", steps=6))
+    fac = StepFakeExecutorFactory(batch_size=4)
+    with InferenceServer(fac, serve_config()) as server:
+        fut = server.submit("a cat", height=64, width=64, seed=7,
+                            num_inference_steps=8, carry_snapshot=data)
+        with pytest.raises(MigrationRejectedError, match="steps"):
+            fut.result(timeout=30)
+    reqs = server.metrics_snapshot()["requests"]
+    assert reqs["migrations_rejected"] == 1
+    assert reqs.get("carries_imported", 0) == 0
+
+
+def test_import_needs_step_batching():
+    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+
+    whole = InferenceServer(
+        FakeExecutorFactory(),
+        serve_config(step_batching=StepBatchConfig())).start(warmup=False)
+    try:
+        with pytest.raises(MigrationRejectedError, match="step-level"):
+            whole.submit("a cat", height=64, width=64, seed=7,
+                         carry_snapshot=mk_envelope())
+    finally:
+        whole.stop(timeout=10.0)
+
+
+def test_export_carries_off_is_plain_server_closed():
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.005)
+    cfg = serve_config(
+        step_batching=step_config(export_carries=False))
+    server = InferenceServer(fac, cfg).start(warmup=False)
+    fut = server.submit("p", height=64, width=64, seed=1,
+                        num_inference_steps=40)
+    wait_for(lambda: any(s.steps_done >= 1
+                         for s in server.stepbatch.occupied()),
+             msg="mid-denoise progress")
+    server.stop(timeout=30.0)
+    with pytest.raises(ServerClosedError) as ei:
+        fut.result(timeout=5)
+    assert not isinstance(ei.value, CarryExportedError)
+    reqs = server.metrics_snapshot()["requests"]
+    assert reqs.get("carries_exported", 0) == 0
+
+
+def test_export_failure_falls_back_to_progress_accounting():
+    """A carry whose export raises still reports its completed steps —
+    snapshot None, ``steps_done`` honest — and counts
+    ``carry_export_failed`` (the fleet then re-executes from 0 and
+    counts those steps as re-executed)."""
+
+    class BoomExportFactory(StepFakeExecutorFactory):
+        def _new_executor(self, key):
+            ex = super()._new_executor(key)
+            ex.step_export = lambda w: (_ for _ in ()).throw(
+                RuntimeError("injected export failure"))
+            return ex
+
+    fac = BoomExportFactory(batch_size=4, step_time_s=0.005)
+    server = InferenceServer(fac, serve_config()).start(warmup=False)
+    fut = server.submit("p", height=64, width=64, seed=1,
+                        num_inference_steps=40)
+    wait_for(lambda: any(s.steps_done >= 1
+                         for s in server.stepbatch.occupied()),
+             msg="mid-denoise progress")
+    server.stop(timeout=30.0)
+    with pytest.raises(CarryExportedError) as ei:
+        fut.result(timeout=5)
+    assert ei.value.snapshot is None and ei.value.steps_done >= 1
+    reqs = server.metrics_snapshot()["requests"]
+    assert reqs["carry_export_failed"] == 1
+    assert reqs.get("carries_exported", 0) == 0
+
+
+# --------------------------------------------------------------------------
+# replica drain deadline: export-and-migrate instead of waiting forever
+# --------------------------------------------------------------------------
+
+
+def test_drain_deadline_exports_and_bounds_scale_down():
+    """`drain(drain_deadline_s=...)` under load: the replica stops
+    within the deadline (plus shutdown slack, not the 0.6s the work
+    needs), every resident future fails with `CarryExportedError`
+    carrying a snapshot, and each snapshot resumes to the right image
+    on a fresh server."""
+    steps = 60
+    rep = Replica("r0", StepFakeExecutorFactory(batch_size=4,
+                                                step_time_s=0.01),
+                  serve_config()).start()
+    futs = [rep.submit(f"p{i}", height=64, width=64, seed=i,
+                       num_inference_steps=steps) for i in range(3)]
+    wait_for(lambda: (len(rep.server.stepbatch.occupied()) == 3
+                      and all(s.steps_done >= 2
+                              for s in rep.server.stepbatch.occupied())),
+             msg="all three resident and progressing")
+    server = rep.server
+    t0 = time.monotonic()
+    rep.drain(drain_deadline_s=0.25)
+    elapsed = time.monotonic() - t0
+    assert rep.state == REPLICA_STOPPED
+    assert elapsed < 2.0, f"drain took {elapsed:.2f}s against a 0.25s deadline"
+    exported = []
+    for f in futs:
+        with pytest.raises(CarryExportedError) as ei:
+            f.result(timeout=5)
+        assert ei.value.snapshot is not None
+        assert 0 < ei.value.steps_done < steps
+        exported.append(ei.value.snapshot)
+    assert server.metrics_snapshot()["requests"]["carries_exported"] == 3
+
+    fac_b = StepFakeExecutorFactory(batch_size=4)
+    with InferenceServer(fac_b, serve_config()) as server_b:
+        outs = [server_b.submit(f"p{i}", height=64, width=64, seed=i,
+                                num_inference_steps=steps,
+                                carry_snapshot=data).result(timeout=30)
+                for i, data in enumerate(exported)]
+    key = fac_b.built[0]
+    for i, out in enumerate(outs):
+        assert out.migrations == 1 and out.steps_salvaged >= 2
+        np.testing.assert_array_equal(out.output,
+                                      fake_image(f"p{i}", i, key))
+
+
+# --------------------------------------------------------------------------
+# fleet: kill mid-denoise -> migrate, exactly-once steps; corrupt -> from-0
+# --------------------------------------------------------------------------
+
+
+def _mk_step_fleet(victim_plan, *, steps_cfg=None, ledger=None):
+    registry = MetricsRegistry()
+    ledger = ledger if ledger is not None else ExecutionLedger()
+    cfg = steps_cfg or serve_config()
+    reps = [
+        Replica(name, StepLedgerFakeExecutorFactory(
+            ledger, replica=name, batch_size=4, step_time_s=0.005),
+            cfg, capacity_weight=w,
+            fault_plan=victim_plan if name == "victim" else None,
+            registry=registry)
+        for name, w in (("victim", 10.0), ("survivor", 1.0))
+    ]
+    fleet = FleetRouter(reps, FleetConfig(tick_s=0.02), registry=registry)
+    return fleet, ledger
+
+
+def test_fleet_kill_migrates_carry_with_exactly_once_steps():
+    """The tentpole e2e on fakes: a kill mid-denoise exports the carry,
+    the failover re-dispatches it at its exported step, and across both
+    replicas every (request, step) pair executed EXACTLY once — the
+    shared step ledger is the proof, `max_step_count() == 1`."""
+    plan = FaultPlan([FaultRule(site="replica", kind="kill",
+                                key_substr="victim", p=1.0, max_fires=1,
+                                after_calls=3)], seed=0)
+    fleet, ledger = _mk_step_fleet(plan)
+    with fleet:
+        out = fleet.submit("only", height=64, width=64, seed=7,
+                           num_inference_steps=6).result(timeout=30)
+        assert plan.fired() == {"replica/kill": 1}
+        assert fleet.replica("victim").state == REPLICA_STOPPED
+    assert out.replica == "survivor"
+    assert out.migrations == 1 and out.steps_salvaged == 3
+    key = fleet.replica("survivor").server._exec_key_for(64, 64, 6,
+                                                         cfg=True)
+    np.testing.assert_array_equal(out.output, fake_image("only", 7, key))
+    # step-scoped exactly-once: victim ran 0..2, survivor 3..5, nothing
+    # twice — the salvage was real, not a silent re-run
+    counts = ledger.step_counts("only", 7)
+    assert sorted(counts) == list(range(6))
+    assert [counts[i][0] for i in range(6)] == (
+        ["victim"] * 3 + ["survivor"] * 3)
+    assert ledger.max_step_count() == 1
+    snap = fleet.metrics_snapshot()["fleet"]["requests"]
+    assert snap["migrations"] == 1
+    assert snap["steps_salvaged"] == 3
+    assert snap.get("migrations_rejected", 0) == 0
+    assert snap.get("fleet_steps_reexecuted", 0) == 0
+
+
+def test_fleet_corrupt_snapshot_falls_back_from_step_zero():
+    """Chaos on the export wire (``snapshot_corrupt``): the importing
+    replica rejects the envelope typed, the fleet strips it and retries
+    from step 0 — the request still completes, and the re-executed
+    steps are counted as ``fleet_steps_reexecuted``, never silently
+    resumed from bytes it cannot prove intact."""
+    plan = FaultPlan([
+        FaultRule(site="replica", kind="kill", key_substr="victim",
+                  p=1.0, max_fires=1, after_calls=3),
+        FaultRule(site="migrate.export", kind="snapshot_corrupt", p=1.0,
+                  max_fires=1),
+    ], seed=0)
+    fleet, ledger = _mk_step_fleet(plan)
+    with fleet:
+        out = fleet.submit("only", height=64, width=64, seed=7,
+                           num_inference_steps=6).result(timeout=30)
+        assert plan.fired() == {"migrate.export/snapshot_corrupt": 1,
+                                "replica/kill": 1}
+    assert out.replica == "survivor"
+    assert out.migrations == 0 and out.steps_salvaged == 0  # from step 0
+    key = fleet.replica("survivor").server._exec_key_for(64, 64, 6,
+                                                         cfg=True)
+    np.testing.assert_array_equal(out.output, fake_image("only", 7, key))
+    # the salvage failed: steps 0..2 ran on BOTH replicas (honestly
+    # counted), and the fleet books exactly those as re-executed
+    counts = ledger.step_counts("only", 7)
+    assert [len(counts[i]) for i in range(6)] == [2, 2, 2, 1, 1, 1]
+    snap = fleet.metrics_snapshot()["fleet"]["requests"]
+    assert snap["migrations"] == 1          # the attempt was made
+    assert snap["migrations_rejected"] == 1  # ...and rejected typed
+    assert snap["fleet_steps_reexecuted"] == 3
+    assert snap.get("steps_salvaged", 0) == 0
+
+
+# --------------------------------------------------------------------------
+# real tiny SD config: snapshot round-trip is bit-identical
+# --------------------------------------------------------------------------
+
+
+def test_real_sd_carry_snapshot_round_trip(devices8):
+    """UNet/SD on the real tiny config: export a mid-denoise carry
+    through the FULL wire (encode -> decode -> step_import on a fresh
+    executor), finish the remaining steps, and the image is
+    byte-identical to an unmigrated monolithic run — plus the typed
+    rejections a real executor must enforce at import."""
+    from test_pipelines import build_sd_pipeline
+
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    steps = 3
+    pipe, _ = build_sd_pipeline(devices8, 1, batch_size=2)
+    pipe.set_stepwise(True)
+    ex = PipelineExecutor(pipe, steps=steps)
+    solo = np.asarray(ex(["a cat"], [""], 5.0, [7])[0])
+
+    work = ex.step_begin("a cat", "", 7, 5.0)
+    ex.step_run([work])  # one completed step: mid-denoise
+    extra, leaves = ex.step_export(work)
+    assert extra["family"] == type(pipe).__name__ and extra["step"] == 1
+    data = encode_snapshot(
+        ekey=key_for(steps=steps), family=extra["family"],
+        step=extra["step"], steps_total=steps, request_id="rq-real",
+        prompt="a cat", seed=7, leaves=leaves)
+    ex.step_abort(work)  # the exporting side releases its buffers
+
+    snap = decode_snapshot(data)
+    check_identity(snap, prompt="a cat", seed=7)
+    check_key_compatible(snap, key_for(steps=steps))
+    ex2 = PipelineExecutor(pipe, steps=steps)  # the adopting executor
+    w2 = ex2.step_import(snap.meta, list(snap.leaves), "a cat", "", 7, 5.0)
+    for _ in range(steps - snap.step):
+        ex2.step_run([w2])
+    assert ex2.step_done(w2)
+    img = np.asarray(ex2.step_finish(w2))
+    np.testing.assert_array_equal(solo, img)
+
+    # typed import rejections on the real executor
+    with pytest.raises(MigrationRejectedError, match="family"):
+        ex2.step_import({**snap.meta, "family": "Bogus"},
+                        list(snap.leaves), "a cat", "", 7, 5.0)
+    with pytest.raises(MigrationRejectedError, match="leaves"):
+        ex2.step_import(snap.meta, list(snap.leaves)[:-1],
+                        "a cat", "", 7, 5.0)
+    with pytest.raises(MigrationRejectedError, match="out of range"):
+        ex2.step_import({**snap.meta, "step": steps + 1},
+                        list(snap.leaves), "a cat", "", 7, 5.0)
