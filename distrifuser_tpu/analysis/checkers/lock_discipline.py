@@ -145,9 +145,16 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
     "distrifuser_tpu/serve/server.py": {
         # lifecycle cells mutated by concurrent stop()/start() callers
         # (stop is documented idempotent-from-any-thread); reads stay
-        # unlocked under the blessed snapshot-read policy
-        "InferenceServer": guard("_lifecycle_lock",
-                                 ["_started", "_thread"]),
+        # unlocked under the blessed snapshot-read policy.  The pack-
+        # fill accumulators feed the serve_stepbatch_pack_fill gauge:
+        # written only by _step_advance on the scheduler thread
+        # (init-time zeroing aside); the gauge reads ride the snapshot
+        # policy like every other serve metric
+        "InferenceServer": guard(
+            "_lifecycle_lock", ["_started", "_thread",
+                                "_pack_rows_total",
+                                "_pack_capacity_total"],
+            owner_methods=["_step_advance"]),
     },
     "distrifuser_tpu/serve/replica.py": {
         # the lifecycle state machine: every transition and handle swap
@@ -172,11 +179,16 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
         # blessed snapshot policy.  No lock exists to scan — distrisched
         # validates the single-owner claim dynamically (the three
         # stepbatch scenarios run at 85 seeds each in tier-1).
+        # pack_aligned is the fused-dispatch grouping counter: cohort()
+        # bumps it on the scheduler thread when pack_align reshapes a
+        # width-truncated selection (the executor-side pack state —
+        # step_pack_stats, the axes cache — is likewise touched only by
+        # step_run on the same thread).
         "StepBatcher": guard(
             "_lock",
             ["_slots", "_parked", "_ewma", "_round_s_total",
              "_rounds_timed", "joins", "leaves", "preempt_count",
-             "resumes", "rounds"],
+             "resumes", "rounds", "pack_aligned"],
             via="scheduler-thread single owner (InferenceServer._loop "
                 "step rounds; reads are snapshot-blessed)"),
         "SlotState": guard(

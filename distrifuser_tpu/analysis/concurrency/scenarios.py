@@ -43,6 +43,12 @@ end of every one:
   carry export of the same request: the future settles exactly once
   (cancelled, exported, or completed) under every interleaving, and no
   carry leaks in the pool.
+* ``stepbatch_preempt_vs_pack_race`` — a tight-deadline preemption and
+  a client cancel landing while the pool is packing same-signature
+  slots into fused dispatches: every future settles, every surviving
+  image is the request's own deterministic bytes, the pool drains, and
+  the pack accounting stays coherent (rows >= dispatches, never
+  negative fill).
 * ``gateway_stop_midstream`` — gateway stop() while SSE consumers are
   mid-stream and requests are mid-denoise: every open stream resolves
   (readers terminate), every admitted future settles, nothing wedges.
@@ -496,6 +502,77 @@ def stepbatch_migrate_vs_cancel(ctx: ScenarioContext) -> None:
                    "pool drains (no carry leaked)")
 
 
+def stepbatch_preempt_vs_pack_race(ctx: ScenarioContext) -> None:
+    """preemption and cancel landing while the pool packs
+    same-signature slots into fused dispatches (step_width truncation +
+    pack_align on): the park must extract the victim OUT of the shared
+    packed carry mid-round, the survivors keep packing, and every
+    surviving image is the request's own deterministic bytes.  The
+    pack accounting (stepbatch_dispatches / stepbatch_packed_rows /
+    pack_aligned) must stay coherent under every interleaving."""
+    import numpy as np
+
+    from ...serve.errors import ServeError
+    from ...serve.server import InferenceServer
+    from ...serve.testing import StepFakeExecutorFactory, fake_image
+
+    server = InferenceServer(
+        StepFakeExecutorFactory(batch_size=4, step_time_s=0.02),
+        _step_config(slots=3, step_width=2, step_service_prior_s=0.02),
+        clock=ctx.clock)
+    server.start(warmup=False)
+    futures = {}
+
+    def client(i: int, steps: int, ttl: float) -> None:
+        try:
+            futures[i] = server.submit(
+                f"prompt-{i}", height=64, width=64, seed=i,
+                num_inference_steps=steps, ttl_s=ttl)
+        except ServeError:
+            pass  # admission raced the stop: a typed reject is correct
+
+    # three packable residents (same signature: same step count) fill
+    # the slots; the width-2 cohort packs two of them per round
+    residents = [ctx.spawn(f"client{i}", client, i, 6, 300.0)
+                 for i in range(3)]
+    for t in residents:
+        t.join()
+    ctx.wait_until(lambda: len(server.stepbatch.occupied()) > 0,
+                   "a resident admitted")
+    # a tight-deadline arrival forces preemption of the slackest
+    # resident (parking a member of the active pack) while a client
+    # concurrently cancels another resident
+    tight = ctx.spawn("tight", client, 9, 4, 0.25)
+    canceller = ctx.spawn("canceller",
+                          lambda: 0 in futures and futures[0].cancel())
+    tight.join()
+    canceller.join()
+    results = {i: ctx.result(f, tolerate=(ServeError,))
+               for i, f in futures.items() if not f.cancelled()}
+    server.stop(timeout=60.0)
+    sb = server.stepbatch
+    ctx.wait_until(lambda: not sb.occupied() and not sb.parked,
+                   "pool drains (no carry leaked)")
+    # bit-identity under preempt-vs-pack: every completed request got
+    # ITS OWN image regardless of who it was packed with or parked over
+    for i, r in results.items():
+        if isinstance(r, Exception):
+            continue
+        steps = 4 if i == 9 else 6
+        key = server._exec_key_for(64, 64, steps, cfg=True)
+        assert np.array_equal(r.output, fake_image(f"prompt-{i}", i, key)), (
+            f"request {i} got someone else's image under preempt-vs-pack")
+    # pack accounting coherence: rows cover at least one request-step
+    # per dispatch and never exceed capacity
+    snap = server.metrics_snapshot()
+    reqs = snap["requests"]
+    nd = reqs.get("stepbatch_dispatches", 0)
+    nr = reqs.get("stepbatch_packed_rows", 0)
+    assert nr >= nd >= 0, (nd, nr)
+    assert nr == reqs.get("steps_executed", 0), (nr, reqs)
+    assert snap["step_batching"]["pack_aligned"] >= 0
+
+
 def gateway_stop_midstream(ctx: ScenarioContext) -> None:
     """gateway stop() while SSE consumers are mid-stream: every open
     stream resolves (no reader left waiting), every admitted future
@@ -617,6 +694,7 @@ SCENARIOS: Dict[str, object] = {
     "stepbatch_stop_midpreview": stepbatch_stop_midpreview,
     "stepbatch_kill_during_carry_export": stepbatch_kill_during_carry_export,
     "stepbatch_migrate_vs_cancel": stepbatch_migrate_vs_cancel,
+    "stepbatch_preempt_vs_pack_race": stepbatch_preempt_vs_pack_race,
     "gateway_stop_midstream": gateway_stop_midstream,
     "gateway_cancel_final_race": gateway_cancel_final_race,
 }

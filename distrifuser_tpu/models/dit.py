@@ -448,7 +448,9 @@ def dit_block(
     commit/exchange them.
     """
     table = bp["scale_shift_table"]  # [6, hidden]
-    mods = table[None] + c6[None]    # [1, 6, hidden] broadcast over batch
+    # c6 is [6, hidden] (one timestep) or [B, 6, hidden] (per-row timesteps,
+    # packed cohort dispatch) — either way mods broadcasts over batch
+    mods = table[None] + (c6[None] if c6.ndim == 2 else c6)
     s1, sc1, g1, s2, sc2, g2 = [mods[:, i][:, None, :] for i in range(6)]
 
     hn = _ln(x) * (1.0 + sc1) + s1
@@ -489,8 +491,12 @@ def final_layer(params, cfg: DiTConfig, x: jnp.ndarray, temb: jnp.ndarray) -> jn
     Modulation = learned 2-entry table + the timestep embedding (PixArt's
     T2IFinalLayer shape: table-plus-conditioning, no extra projection).
     """
-    mods = params["final_table"] + temb[None]        # [2, hidden]
-    shift, scale = mods[0][None, None], mods[1][None, None]
+    if temb.ndim == 1:
+        mods = params["final_table"] + temb[None]    # [2, hidden]
+        shift, scale = mods[0][None, None], mods[1][None, None]
+    else:  # per-row timesteps (packed cohort dispatch): temb [B, hidden]
+        mods = params["final_table"][None] + temb[:, None]  # [B, 2, hidden]
+        shift, scale = mods[:, 0][:, None], mods[:, 1][:, None]
     h = _ln(x) * (1.0 + scale) + shift
     return linear(params["final_out"], h)
 

@@ -188,11 +188,18 @@ class DiTDenoiseRunner:
         rows = lax.dynamic_slice(
             x_in, (0, offset, 0), (x_in.shape[0], chunk, x_in.shape[2])
         ).astype(compute_dtype)
-        if not cfg.cfg_split and cfg.do_classifier_free_guidance:
+        folded = not cfg.cfg_split and cfg.do_classifier_free_guidance
+        if folded:
             rows = jnp.concatenate([rows, rows], axis=0)
         pos_rows = lax.dynamic_slice(pos, (offset, 0), (chunk, pos.shape[1]))
         h = dit_mod.embed_tokens(params, dcfg, rows, pos_rows)
         c6 = c6_all[s]
+        temb = temb_all[s]
+        if jnp.ndim(s) and folded:
+            # per-row step indices (packed cohort dispatch): the [B, ...]
+            # conditioning tables fold branch-major exactly like the rows
+            c6 = jnp.concatenate([c6, c6], axis=0)
+            temb = jnp.concatenate([temb, temb], axis=0)
 
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
         ring = cfg.attn_impl == "ring"
@@ -412,7 +419,7 @@ class DiTDenoiseRunner:
             h, kv_new = lax.scan(
                 block_body, h, (params["blocks"], cap_kv, kv_state)
             )
-        eps_rows = dit_mod.final_layer(params, dcfg, h, temb_all[s])
+        eps_rows = dit_mod.final_layer(params, dcfg, h, temb)
         eps_full = all_gather_seq(eps_rows, self.seq_axes)
         return eps_full, kv_new
 
@@ -732,6 +739,66 @@ class DiTDenoiseRunner:
         decode input) — does not consume the carry."""
         return dit_mod.unpatchify(self.dcfg, carry[0],
                                   self.dcfg.in_channels)
+
+    # -- packed cohort rows (serve/executors.py step_run; parallel/rowpack) --
+
+    def stepwise_rows_supported(self) -> bool:
+        """Whether packed multi-row dispatch preserves bit-identity on this
+        config.  DP-split batches can't carry a replicated per-row step
+        vector; the PCPP partial-refresh rotation (`refresh_gather_seq`
+        step=s) and per-tensor compression scales couple rows."""
+        cfg = self.cfg
+        return (cfg.dp_degree == 1 and cfg.refresh_fraction >= 1
+                and cfg.comm_compress == "none")
+
+    def stepwise_carry_signature(self, carry, i: int, num_steps: int):
+        """Compiled-program key of step ``i`` — two carries whose next
+        steps share this tuple run the SAME jitted stepper and may pack
+        into one dispatch."""
+        cfg = self.cfg
+        n_sync = self._exec_phases(num_steps)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        sync = one_phase or i < n_sync
+        shallow = cfg.step_cache_enabled and is_shallow_at(
+            i, n_sync, cfg.step_cache_interval)
+        return ("dit", sync, shallow, num_steps)
+
+    def stepwise_carry_rows_axes(self, carry, num_steps: int):
+        """Per-leaf rowpack plan for this runner's carry layout, found by
+        comparing the carry's abstract shapes at batch widths w and 2w
+        (rowpack.axes_from_shapes) — no hand-maintained layout table."""
+        from . import rowpack
+
+        x = carry[0]
+        w = x.shape[0]
+
+        def shapes(k):
+            return jax.eval_shape(lambda: (
+                jnp.zeros((w * k,) + x.shape[1:], x.dtype),
+                self.scheduler.init_state((w * k,) + x.shape[1:]),
+                self._kv0_global(w * k),
+            ))
+
+        return rowpack.axes_from_shapes(shapes(1), shapes(2))
+
+    def stepwise_carry_step_rows(self, carry, i_rows, enc, cap_mask,
+                                 gs_rows, num_steps: int):
+        """Advance ``len(i_rows)`` packed rows in ONE dispatch of the same
+        jitted stepper the solo path uses: row r steps by its own index
+        ``i_rows[r]`` under its own scale ``gs_rows[r]``.  All rows must
+        share one (phase, shallow) signature — callers group by
+        `stepwise_carry_signature` first."""
+        x, sstate, kv = carry
+        sigs = {self.stepwise_carry_signature(carry, int(i), num_steps)
+                for i in i_rows}
+        if len(sigs) != 1:
+            raise ValueError(
+                f"packed rows span {len(sigs)} step signatures: {sigs}"
+            )
+        _, sync, shallow, _ = next(iter(sigs))
+        return self._ensure_stepper(num_steps, sync, shallow)(
+            self.params, jnp.asarray(list(i_rows)), x, kv, sstate, enc,
+            cap_mask, jnp.asarray(list(gs_rows), jnp.float32))
 
     def _fire_callback(self, i, t, x):
         """Host trampoline for the compiled-loop callback (io_callback)."""

@@ -51,15 +51,27 @@ def branch_select(cfg: DistriConfig, enc, added=None):
     return enc[0], my_added, 1
 
 
+def _per_row_gs(gs, ref):
+    """A [B]-shaped guidance vector (packed cohort rows, each request its
+    own scale) broadcasts over the per-sample trailing dims; the scalar
+    path is untouched — byte-identical programs for solo dispatch."""
+    gs = jnp.asarray(gs)
+    if gs.ndim == 0:
+        return gs
+    return gs.reshape(gs.shape + (1,) * (jnp.ndim(ref) - 1))
+
+
 def combine_guidance(cfg: DistriConfig, out, gs, batch):
     """Guided output from per-branch model output (full latent or chunk):
     ``u + gs * (c - u)`` with branches gathered over the cfg axis
-    (cfg_split), unfolded from the batch dim (folded), or passed through."""
+    (cfg_split), unfolded from the batch dim (folded), or passed through.
+    ``gs`` is a scalar, or [B] for packed cohort rows (one scale per
+    batch row)."""
     if cfg.cfg_split:
         both = all_gather(out, CFG_AXIS)  # [2, B, ...]
         u, c = both[0], both[1]
-        return u + gs * (c - u)
+        return u + _per_row_gs(gs, u) * (c - u)
     if cfg.do_classifier_free_guidance:
         u, c = out[:batch], out[batch:]
-        return u + gs * (c - u)
+        return u + _per_row_gs(gs, u) * (c - u)
     return out

@@ -184,7 +184,16 @@ class MMDiTDenoiseRunner:
             rows = jnp.concatenate([rows, rows], axis=0)
         pos_rows = lax.dynamic_slice(pos, (offset, 0), (chunk, pos.shape[1]))
         h = linear(params["proj_in"], rows) + pos_rows[None]
-        vec = vec_all[s]
+        if jnp.ndim(s) == 0:
+            vec = vec_all[s]  # [Bl, hidden] — one timestep for every row
+        else:
+            # per-row step indices (packed cohort dispatch): vec_all is
+            # [S, Bl, hidden]; pick row b's own step on the diagonal, with
+            # the step vector fold-doubled when the CFG branches ride the
+            # batch dim (branch-major, same layout as ``rows`` above)
+            sb = (jnp.concatenate([s, s])
+                  if vec_all.shape[1] == 2 * s.shape[0] else s)
+            vec = vec_all[sb, jnp.arange(vec_all.shape[1])]
 
         no_refresh = cfg.mode == "no_sync"  # keep warmup KV forever (§2.3)
 
@@ -677,6 +686,66 @@ class MMDiTDenoiseRunner:
         decode input) — does not consume the carry."""
         return dit_mod.unpatchify(self.mcfg, carry[0],
                                   self.mcfg.out_channels)
+
+    # -- packed cohort rows (serve/executors.py step_run; parallel/rowpack) --
+
+    def stepwise_rows_supported(self) -> bool:
+        """Whether packed multi-row dispatch preserves bit-identity on this
+        config.  DP-split batches can't carry a replicated per-row step
+        vector; the PCPP partial-refresh rotation (`refresh_gather_seq`
+        step=s) and per-tensor compression scales couple rows."""
+        cfg = self.cfg
+        return (cfg.dp_degree == 1 and cfg.refresh_fraction >= 1
+                and cfg.comm_compress == "none")
+
+    def stepwise_carry_signature(self, carry, i: int, num_steps: int):
+        """Compiled-program key of step ``i`` — two carries whose next
+        steps share this tuple run the SAME jitted stepper and may pack
+        into one dispatch."""
+        cfg = self.cfg
+        _, n_sync = self._exec_window(num_steps, 0, None)
+        one_phase = cfg.mode == "full_sync" or not cfg.is_sp
+        sync = one_phase or i < n_sync
+        shallow = cfg.step_cache_enabled and is_shallow_at(
+            i, n_sync, cfg.step_cache_interval)
+        return ("mmdit", sync, shallow, num_steps)
+
+    def stepwise_carry_rows_axes(self, carry, num_steps: int):
+        """Per-leaf rowpack plan for this runner's carry layout, found by
+        comparing the carry's abstract shapes at batch widths w and 2w
+        (rowpack.axes_from_shapes) — no hand-maintained layout table."""
+        from . import rowpack
+
+        x = carry[0]
+        w = x.shape[0]
+
+        def shapes(k):
+            return jax.eval_shape(lambda: (
+                jnp.zeros((w * k,) + x.shape[1:], x.dtype),
+                self.scheduler.init_state((w * k,) + x.shape[1:]),
+                self._kv0_global(w * k),
+            ))
+
+        return rowpack.axes_from_shapes(shapes(1), shapes(2))
+
+    def stepwise_carry_step_rows(self, carry, i_rows, enc, pooled,
+                                 gs_rows, num_steps: int):
+        """Advance ``len(i_rows)`` packed rows in ONE dispatch of the same
+        jitted stepper the solo path uses: row r steps by its own index
+        ``i_rows[r]`` under its own scale ``gs_rows[r]``.  All rows must
+        share one (phase, shallow) signature — callers group by
+        `stepwise_carry_signature` first."""
+        x, sstate, kv = carry
+        sigs = {self.stepwise_carry_signature(carry, int(i), num_steps)
+                for i in i_rows}
+        if len(sigs) != 1:
+            raise ValueError(
+                f"packed rows span {len(sigs)} step signatures: {sigs}"
+            )
+        _, sync, shallow, _ = next(iter(sigs))
+        return self._ensure_stepper(num_steps, sync, shallow)(
+            self.params, jnp.asarray(list(i_rows)), x, kv, sstate, enc,
+            pooled, jnp.asarray(list(gs_rows), jnp.float32))
 
     def _build_stale_scan(self, num_steps: int, n_start: int):
         """Fused stale steady-state ONLY (cfg.hybrid_loop; the MMDiT analog

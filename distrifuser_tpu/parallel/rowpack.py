@@ -1,0 +1,188 @@
+"""Batch-row packing for the explicit stepwise carries (fused cohort step).
+
+`PipelineExecutor.step_run` advances each resident request's explicit
+denoise carry (runner.stepwise_carry_init/...step) padded to the compiled
+batch width with copies of its single real row.  Batch rows are independent
+end to end (the PR-1 coalescing invariant), so N cohort members whose next
+step compiles to the SAME per-step program — same (phase, state, shallow)
+signature — can legally share ONE dispatch: member r's real row rides batch
+row r, the per-row inputs (step index, guidance scale, scheduler scalars)
+become [B] vectors, and every row's numerics are byte-identical to its solo
+run.  This module is the carry-layout half of that contract:
+
+* **axis discovery** (`axes_from_shapes`): given the carry's leaf shapes at
+  two batch widths (w and 2w), the batch axis of each leaf is the unique
+  axis whose dim doubled.  No per-family layout table — the displaced-patch
+  state, gather/ring KV, step-cache deep features, and scheduler state all
+  reveal their batch axis the same way.  Leaves that don't scale are either
+  per-run scalars (scheduler state: packed as a stacked [B] vector — the
+  schedulers accept per-row state, schedulers/scheduling.py `_per_row`) or
+  batch-less shared placeholders (the ulysses/usp KV stub) that pass
+  through untouched.  An ambiguous leaf (two axes doubled) raises — the
+  executor falls back to sequential dispatch, never guesses.
+
+* **fold-aware row indexing**: a batch-bearing axis holds ``f * width``
+  entries with the request row MINOR — CFG folding concatenates the batch
+  block per branch (``concat([x, x])``), and the stepwise shard_map layouts
+  stack per-device blocks on axis 0 — so row ``r`` of a width-``w`` carry
+  occupies positions ``{r, w + r, 2w + r, ...}``.  Pack/extract reshape the
+  axis to ``(f, width)`` and index the minor factor, which is exact for
+  every layout the runners emit.
+
+* **pack/extract** (`pack_rows` / `extract_row`): pack slices each member's
+  real row into consecutive packed rows (padding by repeating the last
+  member — the `_pad_batch` convention); extract slices one row back out
+  and tiles it across the width, reproducing the solo layout exactly
+  (a solo carry's rows are identical by construction, so ``extract(pack)``
+  is byte-equal to never having packed — the bit-identity contract pinned
+  in tests/test_stepbatch.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class AmbiguousPackAxisError(ValueError):
+    """A carry leaf's batch axis could not be identified uniquely —
+    packing would be a guess, so the caller must fall back to sequential
+    per-slot dispatch (correctness-first)."""
+
+
+class LeafAxes:
+    """Per-leaf packing plan: ``axis`` is the batch-bearing axis (None for
+    per-run scalars and batch-less shared leaves), ``ndim`` the leaf rank
+    at the SOLO width (distinguishes a scalar scheduler leaf, which packs
+    to a stacked [B] vector, from a shared placeholder)."""
+
+    __slots__ = ("axis", "ndim")
+
+    def __init__(self, axis, ndim):
+        self.axis = axis
+        self.ndim = ndim
+
+    def __repr__(self):  # debugging aid only
+        return f"LeafAxes(axis={self.axis}, ndim={self.ndim})"
+
+
+def _leaf_axes(small: Sequence[int], big: Sequence[int]) -> LeafAxes:
+    small, big = tuple(small), tuple(big)
+    if len(small) != len(big):
+        raise AmbiguousPackAxisError(
+            f"carry leaf rank changed with batch width: {small} vs {big}"
+        )
+    doubled = [a for a, (s, b) in enumerate(zip(small, big))
+               if s > 0 and b == 2 * s]
+    if not doubled:
+        return LeafAxes(None, len(small))
+    if len(doubled) > 1:
+        raise AmbiguousPackAxisError(
+            f"carry leaf {small} has multiple batch-scaled axes {doubled}"
+        )
+    return LeafAxes(doubled[0], len(small))
+
+
+def axes_from_shapes(small_tree: Any, big_tree: Any) -> List[LeafAxes]:
+    """Per-leaf packing plan from the carry's shapes at width w
+    (``small_tree``) and width 2w (``big_tree``) — trees of arrays or
+    ShapeDtypeStructs with identical structure.  Returns a flat list in
+    ``tree_leaves`` order (a parallel list, NOT a pytree: LeafAxes must
+    not be flattened into)."""
+    small_leaves = jax.tree_util.tree_leaves(small_tree)
+    big_leaves = jax.tree_util.tree_leaves(big_tree)
+    if len(small_leaves) != len(big_leaves):
+        raise AmbiguousPackAxisError(
+            "carry structure changed with batch width: "
+            f"{len(small_leaves)} vs {len(big_leaves)} leaves"
+        )
+    return [_leaf_axes(jnp.shape(s), jnp.shape(b))
+            for s, b in zip(small_leaves, big_leaves)]
+
+
+def _row_block(leaf, row: int, axis: int, width: int):
+    """Slice row ``row`` (keepdims) out of a fold-major/batch-minor axis:
+    reshape dim ``f * width`` to ``(f, width)``, index the minor factor."""
+    d = leaf.shape[axis]
+    if d % width:
+        raise AmbiguousPackAxisError(
+            f"batch axis dim {d} is not a multiple of width {width}"
+        )
+    f = d // width
+    shaped = leaf.reshape(leaf.shape[:axis] + (f, width)
+                          + leaf.shape[axis + 1:])
+    return lax.index_in_dim(shaped, row, axis=axis + 1, keepdims=True)
+
+
+def pack_rows(carries: Sequence[Any], rows: Sequence[int],
+              axes: List[LeafAxes], width: int) -> Any:
+    """One packed carry whose row ``r`` is ``carries[r]``'s row
+    ``rows[r]``, padded to ``width`` rows by repeating the last member.
+    Members may be solo OR previously-packed carries — the row index
+    always addresses the member's own layout."""
+    if not carries or len(carries) > width:
+        raise ValueError(
+            f"pack_rows wants 1..{width} members, got {len(carries)}"
+        )
+    flats = [jax.tree_util.tree_flatten(c) for c in carries]
+    treedef = flats[0][1]
+    for leaves, td in flats[1:]:
+        if td != treedef:
+            raise AmbiguousPackAxisError(
+                "pack group members carry different tree structures"
+            )
+    pad = width - len(carries)
+    out = []
+    for li, ax in enumerate(axes):
+        leaves = [f[0][li] for f in flats]
+        if ax.axis is None:
+            if ax.ndim == 0:
+                # per-run scheduler scalar -> stacked [width] vector (the
+                # schedulers take per-row state); an already-packed member
+                # contributes its own row
+                vals = [l[r] if jnp.ndim(l) > 0 else jnp.asarray(l)
+                        for l, r in zip(leaves, rows)]
+                vals = vals + [vals[-1]] * pad
+                out.append(jnp.stack(vals))
+            else:
+                # batch-less shared leaf (ulysses/usp KV placeholder):
+                # identical across members by construction.  COPY — the
+                # per-step programs donate carry leaves, and an aliased
+                # buffer would invalidate the source carry (still
+                # referenced by members outside this pack)
+                out.append(jnp.copy(leaves[0]))
+            continue
+        blocks = [_row_block(l, r, ax.axis, width)
+                  for l, r in zip(leaves, rows)]
+        blocks = blocks + [blocks[-1]] * pad
+        stacked = lax.concatenate(blocks, dimension=ax.axis + 1)
+        out.append(stacked.reshape(leaves[0].shape))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def extract_row(carry: Any, row: int, axes: List[LeafAxes],
+                width: int) -> Any:
+    """The solo-layout carry of packed row ``row``: every batch-bearing
+    axis gets that row tiled across the full width (a solo carry's rows
+    are identical by construction, so this reproduces the exact layout a
+    never-packed run carries), scalar-stacked leaves index back down to
+    their per-run scalar, shared leaves pass through."""
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    out = []
+    for leaf, ax in zip(leaves, axes):
+        if ax.axis is None:
+            if ax.ndim == 0 and jnp.ndim(leaf) > 0:
+                out.append(leaf[row])
+            else:
+                # copy shared leaves for the same donation-aliasing
+                # reason as pack_rows (scalars are cheap either way)
+                out.append(jnp.copy(leaf))
+            continue
+        block = _row_block(leaf, row, ax.axis, width)
+        reps = [1] * block.ndim
+        reps[ax.axis + 1] = width
+        out.append(jnp.tile(block, reps).reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -253,6 +253,10 @@ class DenoiseRunner:
             x_in = sched.scale_model_input(x, i)
             if not cfg.cfg_split and cfg.do_classifier_free_guidance:
                 x_in = jnp.concatenate([x_in, x_in], axis=0)
+                if jnp.ndim(t):
+                    # per-row step indices (packed cohort dispatch): the
+                    # timestep vector folds branch-major exactly like x_in
+                    t = jnp.concatenate([t, t], axis=0)
             if cfg.parallelism == "naive_patch" and cfg.split_scheme == "alternate":
                 pstate = {"step": i}
             out, new_pstate = self._unet_local(
@@ -791,6 +795,90 @@ class DenoiseRunner:
         """The carry's current latent [B, H/8, W/8, C] (preview + decode
         input) — does not consume the carry."""
         return carry[0]
+
+    # -- packed cohort rows (serve/executors.py step_run; parallel/rowpack) --
+
+    def stepwise_rows_supported(self) -> bool:
+        """Whether this config's per-step program accepts per-row step
+        indices (the packed cohort dispatch).  Gated off — falling back to
+        sequential per-slot dispatch — where a vector step index would
+        change the traced program's CONTROL FLOW or couple batch rows:
+        naive-alternate's row/col parity cond, the PCPP partial-refresh
+        rotation, lossy refresh compression (per-tensor scales couple
+        rows), and dp sharding (the replicated [B] index does not shard
+        with the dp-split batch)."""
+        cfg = self.cfg
+        return (cfg.dp_degree == 1
+                and cfg.refresh_fraction >= 1
+                and cfg.comm_compress == "none"
+                and not (cfg.parallelism == "naive_patch"
+                         and cfg.split_scheme == "alternate"))
+
+    def stepwise_carry_signature(self, carry, i: int, num_steps: int):
+        """Hashable compiled-program identity of advancing ``carry`` by
+        step ``i``: carries sharing a signature run the SAME per-step
+        program and may pack into one dispatch's batch rows."""
+        phase, shallow = self._stepwise_phase(i, 0, num_steps)
+        return ("unet", phase, carry[1] is not None, shallow, num_steps)
+
+    def stepwise_carry_rows_axes(self, carry, enc, added, num_steps: int):
+        """Per-leaf batch-axis plan (parallel/rowpack.py) for this
+        carry's structure, discovered by shape comparison at two widths:
+        latents/scheduler state analytically, the patch-state tree via
+        ``jax.eval_shape`` of the sync stepper (which CREATES the state
+        structure from the seed — no layout table to drift)."""
+        from . import rowpack
+
+        x, pstate, sstate = carry
+        w = x.shape[0]
+
+        def widen(leaf, axis, k):
+            shape = list(jnp.shape(leaf))
+            shape[axis] = shape[axis] * k
+            return jax.ShapeDtypeStruct(tuple(shape), jnp.result_type(leaf))
+
+        def carry_shapes(k):
+            xs = widen(x, 0, k)
+            ss = self.scheduler.init_state((w * k,) + x.shape[1:])
+            if pstate is None or not jax.tree_util.tree_leaves(pstate):
+                return (xs, pstate, ss)
+            seed = self._stepwise_state_seed()
+            stepper, _ = self._make_stepper(PHASE_SYNC, seed is not None)
+            enc_k = jax.tree.map(lambda l: widen(l, 1, k), enc)
+            added_k = (None if added is None
+                       else jax.tree.map(lambda l: widen(l, 1, k), added))
+            _, pshape, _ = jax.eval_shape(
+                stepper, self.params, jnp.asarray(0), xs, seed, ss, enc_k,
+                added_k, jnp.asarray(1.0, jnp.float32),
+            )
+            return (xs, pshape, ss)
+
+        return rowpack.axes_from_shapes(carry_shapes(1), carry_shapes(2))
+
+    def stepwise_carry_step_rows(self, carry, i_rows, enc, added, gs_rows,
+                                 num_steps: int):
+        """Advance a PACKED carry: row ``r`` moves by exactly step
+        ``i_rows[r]`` at guidance ``gs_rows[r]``.  All rows must share
+        one compiled signature (the executor groups by
+        `stepwise_carry_signature`); the dispatched program is the SAME
+        jitted `_stepwise_fn` the solo path uses — the step index and
+        guidance scale are traced inputs, so the [B]-shaped call is just
+        another cached trace of the same program and each row's numerics
+        are byte-identical to its solo dispatch (batch-row independence,
+        pinned in tests/test_stepbatch.py)."""
+        x, pstate, sstate = carry
+        sigs = {self._stepwise_phase(int(i), 0, num_steps)
+                for i in i_rows}
+        if len(sigs) != 1:
+            raise ValueError(
+                f"packed rows span {len(sigs)} step signatures: {sigs}"
+            )
+        (phase, shallow), = sigs
+        fn = self._stepwise_fn(num_steps, phase, pstate is not None,
+                               shallow)
+        return fn(self.params, jnp.asarray(list(i_rows)), x, pstate,
+                  sstate, enc, added,
+                  jnp.asarray(list(gs_rows), jnp.float32))
 
     # ------------------------------------------------------------------
     # observability

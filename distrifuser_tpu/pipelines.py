@@ -1042,22 +1042,64 @@ class _DistriPipelineBase(_GenerationMixin):
     def step_carry_init(self, latents, num_inference_steps):
         return self.runner.stepwise_carry_init(latents, num_inference_steps)
 
-    def step_carry_step(self, carry, i, enc, guidance_scale,
-                        num_inference_steps):
+    def _step_pin_enc(self, enc):
+        """The dtype pinning runner.generate applies before its stepwise
+        loop — identical inputs => identical per-step programs."""
         embeds, added = enc
-        # the dtype pinning runner.generate applies before its stepwise
-        # loop — identical inputs => identical per-step programs
         embeds = jnp.asarray(embeds, self.distri_config.dtype)
         if added is not None and "text_embeds" in added:
             added = dict(added)
             added["text_embeds"] = jnp.asarray(added["text_embeds"],
                                                self.distri_config.dtype)
+        return embeds, added
+
+    def step_carry_step(self, carry, i, enc, guidance_scale,
+                        num_inference_steps):
+        embeds, added = self._step_pin_enc(enc)
         return self.runner.stepwise_carry_step(
             carry, i, embeds, added,
             jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
 
     def step_carry_latent(self, carry):
         return self.runner.stepwise_carry_latent(carry)
+
+    # -- packed cohort hooks (serve/executors.py step_run) ----------------
+    def step_carry_pack_supported(self):
+        return self.runner.stepwise_rows_supported()
+
+    def step_carry_signature(self, carry, i, num_inference_steps):
+        return self.runner.stepwise_carry_signature(carry, i,
+                                                    num_inference_steps)
+
+    def step_carry_rows_axes(self, carry, enc, num_inference_steps):
+        embeds, added = self._step_pin_enc(enc)
+        return self.runner.stepwise_carry_rows_axes(carry, embeds, added,
+                                                    num_inference_steps)
+
+    def step_carry_pack_enc(self, encs, width):
+        return _pack_enc_rows([self._step_pin_enc(e) for e in encs], width)
+
+    def step_carry_step_rows(self, carry, i_rows, enc, gs_rows,
+                             num_inference_steps):
+        embeds, added = self._step_pin_enc(enc)
+        return self.runner.stepwise_carry_step_rows(
+            carry, i_rows, embeds, added, gs_rows, num_inference_steps)
+
+
+def _pack_enc_rows(encs, width):
+    """One packed encoding from each member's SOLO encoding: every enc
+    leaf carries the batch at axis 1 (branch-major [2, B, ...] CFG layout,
+    the stepwise enc_spec P(None, DP)), and a solo enc's rows are identical
+    by construction (`_pad_batch` repeats the one real prompt), so member
+    r's row 0 becomes packed row r, padded to ``width`` by repeating the
+    last member."""
+    def pack_leaves(*leaves):
+        blocks = [jax.lax.index_in_dim(l, 0, axis=1, keepdims=True)
+                  for l in leaves]
+        blocks = blocks + [blocks[-1]] * (width - len(blocks))
+        return jnp.concatenate(blocks, axis=1)
+
+    return jax.tree.map(pack_leaves, *encs)
 
 
 class DistriSDXLPipeline(_DistriPipelineBase):
@@ -1543,19 +1585,44 @@ class DistriPixArtPipeline(_GenerationMixin):
     def step_carry_init(self, latents, num_inference_steps):
         return self.runner.stepwise_carry_init(latents, num_inference_steps)
 
-    def step_carry_step(self, carry, i, enc, guidance_scale,
-                        num_inference_steps):
+    def _step_pin_enc(self, enc):
+        """The mask default + pinning generate() applies before its
+        stepwise loop — identical inputs => identical per-step programs."""
         emb, mask = enc
-        # the mask default + pinning generate() applies before its
-        # stepwise loop — identical inputs => identical per-step programs
         if mask is None:
             mask = jnp.ones(emb.shape[:3], jnp.float32)
+        return emb, jnp.asarray(mask, jnp.float32)
+
+    def step_carry_step(self, carry, i, enc, guidance_scale,
+                        num_inference_steps):
+        emb, mask = self._step_pin_enc(enc)
         return self.runner.stepwise_carry_step(
-            carry, i, emb, jnp.asarray(mask, jnp.float32),
+            carry, i, emb, mask,
             jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
 
     def step_carry_latent(self, carry):
         return self.runner.stepwise_carry_latent(carry)
+
+    # -- packed cohort hooks (serve/executors.py step_run) ----------------
+    def step_carry_pack_supported(self):
+        return self.runner.stepwise_rows_supported()
+
+    def step_carry_signature(self, carry, i, num_inference_steps):
+        return self.runner.stepwise_carry_signature(carry, i,
+                                                    num_inference_steps)
+
+    def step_carry_rows_axes(self, carry, enc, num_inference_steps):
+        return self.runner.stepwise_carry_rows_axes(carry,
+                                                    num_inference_steps)
+
+    def step_carry_pack_enc(self, encs, width):
+        return _pack_enc_rows([self._step_pin_enc(e) for e in encs], width)
+
+    def step_carry_step_rows(self, carry, i_rows, enc, gs_rows,
+                             num_inference_steps):
+        emb, mask = self._step_pin_enc(enc)
+        return self.runner.stepwise_carry_step_rows(
+            carry, i_rows, emb, mask, gs_rows, num_inference_steps)
 
 
 def _t5_tokenizer_or_fallback(path: str, vocab_size: int):
@@ -1895,14 +1962,39 @@ class DistriSD3Pipeline(_GenerationMixin):
     def step_carry_init(self, latents, num_inference_steps):
         return self.runner.stepwise_carry_init(latents, num_inference_steps)
 
+    def _step_pin_enc(self, enc):
+        """The pooled pinning _generate_stepwise applies — identical
+        inputs => identical per-step programs."""
+        emb, pooled = enc
+        return emb, jnp.asarray(pooled)
+
     def step_carry_step(self, carry, i, enc, guidance_scale,
                         num_inference_steps):
-        emb, pooled = enc
-        # the pooled pinning _generate_stepwise applies — identical
-        # inputs => identical per-step programs
+        emb, pooled = self._step_pin_enc(enc)
         return self.runner.stepwise_carry_step(
-            carry, i, emb, jnp.asarray(pooled),
+            carry, i, emb, pooled,
             jnp.asarray(guidance_scale, jnp.float32), num_inference_steps)
 
     def step_carry_latent(self, carry):
         return self.runner.stepwise_carry_latent(carry)
+
+    # -- packed cohort hooks (serve/executors.py step_run) ----------------
+    def step_carry_pack_supported(self):
+        return self.runner.stepwise_rows_supported()
+
+    def step_carry_signature(self, carry, i, num_inference_steps):
+        return self.runner.stepwise_carry_signature(carry, i,
+                                                    num_inference_steps)
+
+    def step_carry_rows_axes(self, carry, enc, num_inference_steps):
+        return self.runner.stepwise_carry_rows_axes(carry,
+                                                    num_inference_steps)
+
+    def step_carry_pack_enc(self, encs, width):
+        return _pack_enc_rows([self._step_pin_enc(e) for e in encs], width)
+
+    def step_carry_step_rows(self, carry, i_rows, enc, gs_rows,
+                             num_inference_steps):
+        emb, pooled = self._step_pin_enc(enc)
+        return self.runner.stepwise_carry_step_rows(
+            carry, i_rows, emb, pooled, gs_rows, num_inference_steps)

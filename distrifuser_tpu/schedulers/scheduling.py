@@ -17,6 +17,13 @@ prediction, "leading" timestep spacing, steps_offset=1.
 Multistep history (DPM-Solver 2M) is explicit carry state (`init_state`),
 exactly like the displaced-patch activation state — it threads through the
 scan.
+
+``step_index`` may be a scalar (the scan/stepwise path) or a ``[B]``
+vector (the packed cohort step, serve/executors.py `step_run`): every
+table lookup broadcasts per batch row through `_per_row`, which is a
+no-op on scalars — the scalar path traces the exact program it always
+did, and the vector path applies row ``j``'s coefficients to row ``j``
+only (elementwise, so bitwise identical per row to the scalar run).
 """
 
 from __future__ import annotations
@@ -26,6 +33,17 @@ from typing import Any, Dict
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def _per_row(coef, ref):
+    """Shape a per-row coefficient against a batch-major sample: a
+    scalar passes through untouched (the scalar path's program is
+    byte-for-byte what it was); a ``[B]`` vector reshapes to
+    ``[B, 1, ..., 1]`` so it broadcasts along ``ref``'s batch axis."""
+    coef = jnp.asarray(coef)
+    if coef.ndim == 0:
+        return coef
+    return coef.reshape(coef.shape + (1,) * (jnp.ndim(ref) - 1))
 
 
 def _make_alphas_cumprod(
@@ -101,7 +119,8 @@ class BaseScheduler:
         img2img entry (diffusers add_noise parity): x_t = sqrt(ac_t) x0 +
         sqrt(1 - ac_t) eps at t = timesteps()[step_index]."""
         t = self.timesteps()[step_index]
-        ac = jnp.asarray(self._alphas_cumprod, jnp.float32)[t]
+        ac = _per_row(jnp.asarray(self._alphas_cumprod, jnp.float32)[t],
+                      original)
         x0 = original.astype(jnp.float32)
         out = jnp.sqrt(ac) * x0 + jnp.sqrt(1.0 - ac) * noise.astype(jnp.float32)
         return out.astype(original.dtype)
@@ -128,8 +147,8 @@ class DDIMScheduler(BaseScheduler):
         return self
 
     def step(self, sample, model_output, step_index, state):
-        a_t = self._alpha_t[step_index]
-        a_prev = self._alpha_prev[step_index]
+        a_t = _per_row(self._alpha_t[step_index], sample)
+        a_prev = _per_row(self._alpha_prev[step_index], sample)
         x = sample.astype(jnp.float32)
         eps = self._to_epsilon(sample, model_output.astype(jnp.float32), a_t)
         x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
@@ -156,13 +175,13 @@ class EulerDiscreteScheduler(BaseScheduler):
         return self._init_noise_sigma
 
     def scale_model_input(self, sample, step_index):
-        sigma = self._sigmas[step_index]
+        sigma = _per_row(self._sigmas[step_index], sample)
         return (sample / jnp.sqrt(sigma**2 + 1.0)).astype(sample.dtype)
 
     def add_noise(self, original, noise, step_index):
         """Euler carries the sigma-space latent x = x0 + sigma * eps
         (diffusers EulerDiscreteScheduler.add_noise)."""
-        sigma = self._sigmas[step_index]
+        sigma = _per_row(self._sigmas[step_index], original)
         out = original.astype(jnp.float32) + sigma * noise.astype(jnp.float32)
         return out.astype(original.dtype)
 
@@ -171,8 +190,8 @@ class EulerDiscreteScheduler(BaseScheduler):
         # `sample` here is that scaled latent (init noise multiplied by
         # init_noise_sigma), `model_output` is epsilon (or v) at the descaled
         # input.
-        sigma = self._sigmas[step_index]
-        sigma_next = self._sigmas[step_index + 1]
+        sigma = _per_row(self._sigmas[step_index], sample)
+        sigma_next = _per_row(self._sigmas[step_index + 1], sample)
         x = sample.astype(jnp.float32)
         ac_t = 1.0 / (sigma**2 + 1.0)  # alpha_cumprod of this sigma
         eps = self._to_epsilon(x * jnp.sqrt(ac_t), model_output.astype(jnp.float32), ac_t)
@@ -213,12 +232,13 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         }
 
     def step(self, sample, model_output, step_index, state):
-        a_t = self._alpha[step_index]
-        s_t = self._sigma[step_index]
-        lam_t = self._lambda[step_index]
-        a_n = self._alpha[step_index + 1]
-        s_n = self._sigma[step_index + 1]
-        lam_n = self._lambda[step_index + 1]
+        lam_t_raw = self._lambda[step_index]
+        a_t = _per_row(self._alpha[step_index], sample)
+        s_t = _per_row(self._sigma[step_index], sample)
+        lam_t = _per_row(lam_t_raw, sample)
+        a_n = _per_row(self._alpha[step_index + 1], sample)
+        s_n = _per_row(self._sigma[step_index + 1], sample)
+        lam_n = _per_row(self._lambda[step_index + 1], sample)
 
         x = sample.astype(jnp.float32)
         eps = self._to_epsilon(sample, model_output.astype(jnp.float32), a_t**2)
@@ -229,13 +249,13 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         # the final step uses the first-order update (diffusers
         # lower_order_final=True: the 2M ratio h_prev/h degenerates as
         # sigma -> 0), both falling back to D = x0.
-        h_prev = lam_t - state["lambda_prev"]
+        h_prev = lam_t - _per_row(state["lambda_prev"], sample)
         r = h_prev / jnp.maximum(h, 1e-12)
         d_corr = (1.0 + 1.0 / (2.0 * jnp.maximum(r, 1e-12))) * x0 - (
             1.0 / (2.0 * jnp.maximum(r, 1e-12))
         ) * state["x0_prev"]
         use_corr = state["have_prev"] & (step_index < self.num_inference_steps - 1)
-        d = jnp.where(use_corr, d_corr, x0)
+        d = jnp.where(_per_row(use_corr, x0), d_corr, x0)
 
         # dpmsolver++ update: x_next = (s_n/s_t) x - a_n (e^{-h} - 1) D;
         # at the final step sigma_next == 0 and h == inf, so this reduces to
@@ -244,10 +264,14 @@ class DPMSolverMultistepScheduler(BaseScheduler):
         em1 = jnp.expm1(-h)
         x_next = ratio * x - a_n * em1 * d
 
+        # the carried scalars keep the shape they arrived with: scalar on
+        # the scan/stepwise path, [B] on the packed cohort path
         new_state = {
             "x0_prev": x0,
-            "lambda_prev": lam_t,
-            "have_prev": jnp.asarray(True),
+            "lambda_prev": lam_t_raw,
+            "have_prev": (jnp.asarray(True)
+                          if jnp.ndim(state["have_prev"]) == 0
+                          else jnp.ones_like(state["have_prev"])),
         }
         return x_next.astype(sample.dtype), new_state
 
@@ -292,15 +316,15 @@ class FlowMatchEulerScheduler(BaseScheduler):
     def add_noise(self, original, noise, step_index):
         """Flow interpolant x_t = (1 - sigma) x0 + sigma noise (the img2img
         entry; diffusers calls this scale_noise for flow-match schedulers)."""
-        s = self._sigmas[step_index]
+        s = _per_row(self._sigmas[step_index], original)
         out = (1.0 - s) * original.astype(jnp.float32) + s * noise.astype(
             jnp.float32
         )
         return out.astype(original.dtype)
 
     def step(self, sample, model_output, step_index, state):
-        s = self._sigmas[step_index]
-        s_next = self._sigmas[step_index + 1]
+        s = _per_row(self._sigmas[step_index], sample)
+        s_next = _per_row(self._sigmas[step_index + 1], sample)
         x = sample.astype(jnp.float32) + (s_next - s) * model_output.astype(
             jnp.float32
         )

@@ -278,12 +278,25 @@ class SLOController:
             if len(self._service) > self.config.service_window:
                 self._service_sum -= self._service.pop(0)
 
-    def observe_step(self, mean_cost: float, step_s: float) -> None:
+    def observe_step(self, mean_cost: float, step_s: float,
+                     requests: int = 1,
+                     dispatches: Optional[int] = None) -> None:
         """Record one cohort denoise step's wall seconds at the cohort's
         mean tier cost (scheduler thread; step-granular servers call this
         instead of per-batch observations — occupancy there is per-step,
-        not per-batch)."""
-        v = float(step_s) / max(float(mean_cost), 1e-9)
+        not per-batch).
+
+        ``requests``/``dispatches`` normalize for packed dispatch
+        (serve/executors.py step_run): a round that advances R requests
+        in D compiled calls records the per-REQUEST service ``step_s x
+        D/R``, so the step-granular occupancy model and EDF slack don't
+        over-predict by exactly the pack factor.  Omitted (or equal, the
+        sequential executors), the observation is the raw round time —
+        the pre-pack behavior."""
+        if dispatches is None:
+            dispatches = requests
+        v = (float(step_s) / max(float(mean_cost), 1e-9)
+             * (float(dispatches) / max(float(requests), 1.0)))
         with self._lock:
             self._step_service.append(v)
             self._step_service_sum += v

@@ -102,6 +102,15 @@ class PipelineExecutor:
         self.prompt_cache = None
         self._encode_cache_family = (type(pipeline).__name__,
                                      _tokenizer_hash(pipeline))
+        # packed cohort dispatch state (step_run): pipeline support flag
+        # (resolved lazily), rowpack axes plans cached per carry treedef
+        # (None sentinel = ambiguous -> that structure stays sequential),
+        # and the last step_run's pack-efficiency tallies for the server's
+        # stepbatch_* counters / fill gauge
+        self._pack_supported: Optional[bool] = None
+        self._pack_axes: Dict[Any, Any] = {}
+        self.step_pack_stats = {"dispatches": 0, "packed_rows": 0,
+                                "rows_capacity": 0}
 
     # -- observability (utils/trace.py; docs/OBSERVABILITY.md) -------------
 
@@ -328,8 +337,135 @@ class PipelineExecutor:
             "enc": enc,
             "gs": guidance_scale if cfg_on else 1.0,
             "i": 0,
+            # which batch row of the carry is this request's REAL row —
+            # 0 in the solo layout; a packed dispatch re-homes it
+            "row": 0,
             "encode_cached": self.prompt_cache is not None,
         }
+
+    # -- packed cohort dispatch (parallel/rowpack.py) ----------------------
+
+    def _step_pack_supported(self) -> bool:
+        if self._pack_supported is None:
+            fn = getattr(self.pipeline, "step_carry_pack_supported", None)
+            self._pack_supported = bool(fn()) if fn is not None else False
+        return self._pack_supported
+
+    def step_signature(self, work: Dict[str, Any]):
+        """Hashable pack-compatibility key of this work's NEXT step:
+        works sharing a signature run the same compiled per-step program
+        and may pack into one dispatch's batch rows.  ``None`` = this
+        work can only run sequentially (unsupported pipeline or config).
+        The executor's identity is part of the key — packing never spans
+        executors."""
+        if not self._step_pack_supported():
+            return None
+        sig = self.pipeline.step_carry_signature(work["carry"], work["i"],
+                                                 self.steps)
+        return (id(self), sig)
+
+    def _step_axes(self, work: Dict[str, Any]):
+        """The rowpack per-leaf plan for this work's carry structure
+        (cached per treedef — the UNet carry's patch state appears after
+        its first step, so one executor sees more than one structure).
+        ``None`` = ambiguous layout; that structure stays sequential."""
+        import jax
+
+        from ..parallel import rowpack
+
+        key = jax.tree_util.tree_structure(work["carry"])
+        if key not in self._pack_axes:
+            try:
+                self._pack_axes[key] = self.pipeline.step_carry_rows_axes(
+                    work["carry"], work["enc"], self.steps)
+            except rowpack.AmbiguousPackAxisError:
+                self._pack_axes[key] = None
+        return self._pack_axes[key]
+
+    def _step_ensure_solo(self, work: Dict[str, Any]) -> None:
+        """Normalize a work back to the SOLO carry layout: its real row
+        extracted from the shared packed carry and tiled across the
+        width — byte-identical to a never-packed carry (a solo carry's
+        rows are identical by construction).  Park/export/migration and
+        singleton dispatches all run through this, so the PR-17 snapshot
+        format and the solo per-step programs never see packed state."""
+        grp = work.pop("pack", None)
+        if grp is None:
+            return
+        from ..parallel import rowpack
+
+        work["carry"] = rowpack.extract_row(
+            work["carry"], work.get("row", 0), grp["axes"],
+            self.batch_size)
+        work["row"] = 0
+
+    def _step_solo_one(self, work: Dict[str, Any]) -> None:
+        """One sequential-legacy step: the pre-pack per-slot dispatch."""
+        self._step_ensure_solo(work)
+        work["carry"] = self.pipeline.step_carry_step(
+            work["carry"], work["i"], work["enc"], work["gs"], self.steps)
+        work["i"] += 1
+        stats = self.step_pack_stats
+        stats["dispatches"] += 1
+        stats["packed_rows"] += 1
+        stats["rows_capacity"] += self.batch_size
+
+    def _step_dispatch_packed(self, members: List[Dict[str, Any]]) -> None:
+        """Advance a same-signature group in ONE compiled dispatch:
+        member r's real row rides batch row r of a shared packed carry,
+        its step index and guidance scale ride [B] vectors.  Fast path:
+        when the whole group is the SAME pack as last round (same shared
+        carry, full membership, rows 0..n-1) the carry re-dispatches
+        as-is — zero repack work in the steady state.  Otherwise the
+        members' rows (solo or previously packed) repack into a fresh
+        shared carry.  Ambiguous layouts fall back to sequential."""
+        from ..parallel import rowpack
+
+        pipe = self.pipeline
+        bs = self.batch_size
+        n = len(members)
+        stats = self.step_pack_stats
+        grp0 = members[0].get("pack")
+        fast = (
+            grp0 is not None
+            and grp0.get("n") == n
+            and all(m.get("pack") is grp0 for m in members)
+            and all(m["carry"] is members[0]["carry"] for m in members)
+            and sorted(m.get("row", 0) for m in members) == list(range(n))
+        )
+        if fast:
+            members = sorted(members, key=lambda m: m["row"])
+            carry, enc, grp = members[0]["carry"], grp0["enc"], grp0
+        else:
+            axes = self._step_axes(members[0])
+            if axes is None:
+                for m in members:
+                    self._step_solo_one(m)
+                return
+            try:
+                carry = rowpack.pack_rows(
+                    [m["carry"] for m in members],
+                    [m.get("row", 0) for m in members], axes, bs)
+            except rowpack.AmbiguousPackAxisError:
+                for m in members:
+                    self._step_solo_one(m)
+                return
+            enc = pipe.step_carry_pack_enc([m["enc"] for m in members], bs)
+            grp = {"axes": axes, "n": n, "enc": enc}
+        i_rows = [m["i"] for m in members]
+        gs_rows = [float(m["gs"]) for m in members]
+        i_rows += [i_rows[-1]] * (bs - n)
+        gs_rows += [gs_rows[-1]] * (bs - n)
+        new_carry = pipe.step_carry_step_rows(carry, i_rows, enc, gs_rows,
+                                              self.steps)
+        for r, m in enumerate(members):
+            m["carry"] = new_carry
+            m["row"] = r
+            m["pack"] = grp
+            m["i"] += 1
+        stats["dispatches"] += 1
+        stats["packed_rows"] += n
+        stats["rows_capacity"] += bs
 
     def step_run(self, works: List[Dict[str, Any]]) -> None:
         """Advance each work by exactly ONE denoise step (its own step
@@ -337,45 +473,78 @@ class PipelineExecutor:
         until the cohort's step compute is done so the step batcher's
         calibrated per-step service time is honest.
 
-        Cohort members currently run as SEQUENTIAL per-slot dispatches,
-        each padded to the compiled width — correctness-first: identical
-        programs and inputs to a solo run, so bit-identity is by
-        construction.  The mesh-throughput form (pack same-step-index
-        members into one dispatch's batch rows — legal by the same
-        row-independence invariant) is ROADMAP item 2's named follow-up;
-        until then step mode trades per-step dispatch overhead for
-        request-shaped latency (docs/PERF.md)."""
+        Cohort members whose next step shares a compiled signature
+        (`step_signature`: same phase / patch-state stage / shallow flag)
+        advance in ONE padded dispatch — each member's real row rides its
+        own batch row, legal and bit-identical by the PR-1 batch-row
+        independence invariant (pinned in tests/test_stepbatch.py).
+        Groups form in cohort (EDF) order, at most ``batch_size`` rows
+        each; singleton groups, unsupported configs (`step_signature` ->
+        None), and ambiguous carry layouts run the solo per-slot
+        dispatch unchanged.  `step_pack_stats` tallies this call's
+        dispatches / real rows / row capacity for the server's
+        pack-efficiency counters."""
         import jax
 
-        pipe = self.pipeline
+        self.step_pack_stats = {"dispatches": 0, "packed_rows": 0,
+                                "rows_capacity": 0}
+        bs = self.batch_size
+        groups: List[List[Dict[str, Any]]] = []
+        solos: List[Dict[str, Any]] = []
+        open_group: Dict[Any, List[Dict[str, Any]]] = {}
         for w in works:
-            w["carry"] = pipe.step_carry_step(
-                w["carry"], w["i"], w["enc"], w["gs"], self.steps)
-            w["i"] += 1
+            sig = self.step_signature(w)
+            if sig is None:
+                solos.append(w)
+                continue
+            g = open_group.get(sig)
+            if g is None or len(g) >= bs:
+                g = []
+                open_group[sig] = g
+                groups.append(g)
+            g.append(w)
+        for w in solos:
+            self._step_solo_one(w)
+        for members in groups:
+            if len(members) == 1:
+                self._step_solo_one(members[0])
+            else:
+                self._step_dispatch_packed(members)
         jax.block_until_ready([w["carry"][0] for w in works])
 
     def step_done(self, work: Dict[str, Any]) -> bool:
         return work["i"] >= self.steps
 
     def step_finish(self, work: Dict[str, Any]):
-        """Decode the finished carry to the request's np image (row 0 —
-        the single real request in the padded width)."""
+        """Decode the finished carry to the request's np image — the
+        work's own packed row (row 0 in the solo layout)."""
         stages = self.prepare_stages()
         pipe = self.pipeline
         latent = pipe.step_carry_latent(work["carry"])
         images = stages.decode(latent)
-        _release_buffers(work.pop("carry"))
+        row = work.get("row", 0)
+        grp = work.pop("pack", None)
+        carry = work.pop("carry")
+        if grp is None:
+            _release_buffers(carry)
+        # a packed carry is SHARED with the group's other members:
+        # dropping this reference is the release — host GC reclaims the
+        # buffers once the last member finishes/repacks away
         enc = work.pop("enc", None)
         if not work.get("encode_cached"):
             # prompt-cache-owned embeddings stay resident for future hits
             _release_buffers(enc)
-        return list(images)[0]
+        return list(images)[row]
 
     def step_abort(self, work: Dict[str, Any]) -> None:
         """Release a work's device buffers without decoding (failed or
         stopped mid-denoise) — the step path's `_release_buffers`
-        donation, same convention as the staged pipeline."""
-        _release_buffers(work.pop("carry", None))
+        donation, same convention as the staged pipeline.  A packed
+        (shared) carry is only dereferenced, never deleted."""
+        grp = work.pop("pack", None)
+        carry = work.pop("carry", None)
+        if grp is None:
+            _release_buffers(carry)
         enc = work.pop("enc", None)
         if not work.get("encode_cached"):
             _release_buffers(enc)
@@ -383,11 +552,14 @@ class PipelineExecutor:
     def step_park(self, work: Dict[str, Any]) -> None:
         """Preemption: pull the carry to HOST memory so the parked
         request stops holding device residency (the slot it frees goes
-        to the preemptor).  device->host->device is an exact byte
+        to the preemptor).  A packed member first extracts back to its
+        solo layout (`_step_ensure_solo` — byte-identical to a
+        never-packed carry).  device->host->device is an exact byte
         round-trip, so the resumed denoise is bit-identical — pinned by
         tests/test_stepbatch.py."""
         import jax
 
+        self._step_ensure_solo(work)
         work["carry"] = jax.device_get(work["carry"])
 
     def step_resume(self, work: Dict[str, Any]) -> None:
@@ -402,10 +574,14 @@ class PipelineExecutor:
         importing replica resumes the identical bytes.  Returns
         ``(extra_meta, leaves)``: the executor-owned header fields
         (family + step index) and the flat leaf list; the work itself is
-        left intact (the caller still releases it via `step_abort`)."""
+        left intact (the caller still releases it via `step_abort`).  A
+        packed member exports its SOLO layout (`_step_ensure_solo`), so
+        the snapshot format is identical whether or not the round it
+        left in was packed."""
         import jax
         import numpy as np
 
+        self._step_ensure_solo(work)
         host = jax.device_get(work["carry"])
         leaves = [np.asarray(leaf)
                   for leaf in jax.tree_util.tree_leaves(host)]
@@ -482,7 +658,8 @@ class PipelineExecutor:
         import numpy as np
 
         pipe = self.pipeline
-        lat = np.asarray(pipe.step_carry_latent(work["carry"]))[0]
+        lat = np.asarray(
+            pipe.step_carry_latent(work["carry"]))[work.get("row", 0)]
         rgb = (lat[..., :3] if lat.shape[-1] >= 3
                else np.repeat(lat[..., :1], 3, axis=-1))
         lo, hi = float(rgb.min()), float(rgb.max())

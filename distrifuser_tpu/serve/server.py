@@ -302,7 +302,19 @@ class InferenceServer:
                 # EWMA otherwise
                 step_estimate=(self.controller.step_service_estimate
                                if self.controller is not None else None),
+                # pack-compatibility key source for width-truncated
+                # cohorts (StepBatchConfig.pack_align)
+                pack_signature=self._step_pack_signature,
             )
+            # pack-efficiency: real request rows per dispatched row
+            # capacity across the server lifetime (1.0 = every packed
+            # dispatch full; sequential dispatches drag it toward 1/width)
+            self._pack_rows_total = 0
+            self._pack_capacity_total = 0
+            self.registry.gauge(
+                "serve_stepbatch_pack_fill",
+                lambda: (self._pack_rows_total / self._pack_capacity_total
+                         if self._pack_capacity_total else 0.0))
             self.hist_first_preview = self.registry.histogram(
                 "serve_latency_seconds", labels={"phase": "first_preview"})
             self.registry.gauge(
@@ -1167,6 +1179,19 @@ class InferenceServer:
                     f"{type(exc).__name__}: {exc}"))
         return True
 
+    def _step_pack_signature(self, state):
+        """Pack-compatibility key of a slot's next step for the batcher's
+        width-aligned cohort (`StepBatcher.cohort`): the executor's
+        `step_signature`, None when the executor has no pack support
+        (fakes without the hook, sequential-only configs)."""
+        fn = getattr(state.executor, "step_signature", None)
+        if fn is None:
+            return None
+        try:
+            return fn(state.work)
+        except Exception:  # noqa: BLE001 — alignment is best-effort
+            return None
+
     def _step_advance(self, cohort) -> list:
         """Advance the cohort one denoise step, grouped by executor (a
         group shares one compiled program; its step is one watchdog-
@@ -1175,6 +1200,7 @@ class InferenceServer:
         states that actually stepped."""
         sb = self.stepbatch
         stepped = []
+        round_dispatches = 0
         groups: Dict[int, list] = {}
         for state in cohort:
             groups.setdefault(id(state.executor), []).append(state)
@@ -1247,6 +1273,29 @@ class InferenceServer:
                 m.steps_done += 1
                 stepped.append(m)
             self.counters.inc("steps_executed", len(members))
+            # pack-efficiency accounting (serve/executors.py step_run):
+            # how many compiled dispatches this group's step cost and how
+            # many real request rows they carried
+            stats = getattr(executor, "step_pack_stats", None)
+            if stats:
+                nd = int(stats.get("dispatches", 0))
+                nr = int(stats.get("packed_rows", 0))
+                round_dispatches += nd
+                self.counters.inc("stepbatch_dispatches", nd)
+                self.counters.inc("stepbatch_packed_rows", nr)
+                self._pack_rows_total += nr
+                self._pack_capacity_total += int(
+                    stats.get("rows_capacity", 0))
+                if (nd < len(members) and self.tracer is not None
+                        and members[0].request.trace is not None):
+                    rt = members[0].request.trace
+                    self.tracer.event(
+                        "packed-step", track=rt.track, trace=rt.trace_id,
+                        args={"members": len(members), "dispatches": nd,
+                              "rows": nr})
+            else:
+                # executors without pack accounting dispatch per member
+                round_dispatches += len(members)
         if stepped:
             # calibrate on the WHOLE round, not per executor group: the
             # EDF clock unit is "one more step for this slot", and a slot
@@ -1259,8 +1308,15 @@ class InferenceServer:
                 costs = [self.controller.tiers[
                     min(m.tier_idx or 0, len(self.controller.tiers) - 1)
                 ].cost for m in stepped]
+                # per-REQUEST service: a packed dispatch advances several
+                # requests for one program call, so the round time is
+                # normalized by the pack factor — without this the
+                # step-granular occupancy model over-predicts by exactly
+                # how well the executor packs
                 self.controller.observe_step(sum(costs) / len(costs),
-                                             round_dt)
+                                             round_dt,
+                                             requests=len(stepped),
+                                             dispatches=round_dispatches)
         return stepped
 
     def _step_previews(self, stepped) -> None:

@@ -116,7 +116,8 @@ class StepBatcher:
 
     def __init__(self, config: StepBatchConfig,
                  clock: Callable[[], float],
-                 step_estimate: Optional[Callable[[], Optional[float]]] = None):
+                 step_estimate: Optional[Callable[[], Optional[float]]] = None,
+                 pack_signature: Optional[Callable[[SlotState], Any]] = None):
         self.config = config
         self.clock = clock
         self._slots: List[Optional[SlotState]] = [None] * config.slots
@@ -125,12 +126,18 @@ class StepBatcher:
         self._round_s_total = 0.0
         self._rounds_timed = 0
         self._step_estimate = step_estimate
+        # pack-compatibility key of a state's next step (the executor's
+        # `step_signature`; None = sequential-only) — lets a width-
+        # truncated cohort prefer slots that share the tightest state's
+        # compiled dispatch (config.pack_align)
+        self._pack_signature = pack_signature
         # lifetime counters (scheduler-thread writes; snapshot reads)
         self.joins = 0
         self.leaves = 0
         self.preempt_count = 0
         self.resumes = 0
         self.rounds = 0
+        self.pack_aligned = 0
 
     # -- pool accounting ---------------------------------------------------
 
@@ -230,11 +237,52 @@ class StepBatcher:
 
     def cohort(self, now: float) -> List[SlotState]:
         """The slots advancing this round: occupied states in ascending
-        slack order (EDF), truncated to ``step_width`` (0 = all)."""
+        slack order (EDF), truncated to ``step_width`` (0 = all).
+
+        With ``config.pack_align`` on and a pack-signature source wired
+        (the executor's `step_signature`), a TRUNCATED cohort prefers
+        slots that share the tightest state's compiled dispatch: the EDF
+        head always runs, same-signature slots fill the width next (in
+        EDF order), and any remaining width goes to the tightest of the
+        rest — so the width the scheduler pays for packs into the fewest
+        dispatches without ever skipping the tightest request.  Relative
+        EDF order within the selection is preserved."""
         live = sorted(self.occupied(),
                       key=lambda s: self.state_slack(s, now))
         width = self.config.step_width
-        return live[:width] if width else live
+        if not width or len(live) <= width:
+            return live
+        if not self.config.pack_align or self._pack_signature is None:
+            return live[:width]
+        anchor_sig = self._sig_of(live[0])
+        if anchor_sig is None:
+            return live[:width]
+        chosen = [True] + [False] * (len(live) - 1)
+        taken = 1
+        for i, s in enumerate(live[1:], start=1):
+            if taken >= width:
+                break
+            if self._sig_of(s) == anchor_sig:
+                chosen[i] = True
+                taken += 1
+        for i in range(1, len(live)):
+            if taken >= width:
+                break
+            if not chosen[i]:
+                chosen[i] = True
+                taken += 1
+        selection = [s for s, c in zip(live, chosen) if c]
+        if selection != live[:width]:
+            self.pack_aligned += 1
+        return selection
+
+    def _sig_of(self, state: SlotState) -> Any:
+        """The state's pack signature, or None when unavailable (fakes
+        without the hook, sequential-only configs, errors)."""
+        try:
+            return self._pack_signature(state)
+        except Exception:  # noqa: BLE001 — alignment is best-effort
+            return None
 
     def pick_victim(self, newcomer_slack: float,
                     now: float) -> Optional[SlotState]:
@@ -293,4 +341,5 @@ class StepBatcher:
             "preempts": self.preempt_count,
             "resumes": self.resumes,
             "rounds": self.rounds,
+            "pack_aligned": self.pack_aligned,
         }

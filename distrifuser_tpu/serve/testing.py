@@ -147,16 +147,19 @@ class StepFakeExecutor(FakeExecutor):
     coalescing premise `FakeExecutor.__call__` models for whole batches.
     That is what makes continuous mode measurably request-shaped on the
     fakes: a joiner rides the next cohort step instead of waiting out a
-    whole batch.  NOTE this models the TARGET cohort cost: the real
-    `PipelineExecutor.step_run` currently dispatches per slot (cohort
-    row-packing is ROADMAP item 2's named follow-up), so fake-measured
-    ratios are scheduler-shape numbers, not real-mesh throughput.
-    Outputs are `fake_image` either way, so solo, joined,
-    preempted-and-resumed, and monolithic runs are byte-identical by
-    construction — the scheduler behavior is what the tests interrogate.
+    whole batch.  The real `PipelineExecutor.step_run` now matches this
+    cost model: same-signature cohort members pack into ONE compiled
+    dispatch (parallel/rowpack.py), so fake-measured ratios track the
+    real executor's dispatch shape.  Outputs are `fake_image` either
+    way, so solo, joined, preempted-and-resumed, and monolithic runs are
+    byte-identical by construction — the scheduler behavior is what the
+    tests interrogate.
 
     ``step_calls`` records every cohort step's size; ``park_calls`` /
-    ``resume_calls`` count the preemption hand-offs.
+    ``resume_calls`` count the preemption hand-offs; ``step_pack_stats``
+    mirrors the real executor's pack-efficiency tallies (the whole fake
+    cohort is one "dispatch"), so the server's stepbatch_* counters and
+    fill gauge exercise on fakes.
     """
 
     def __init__(self, key: ExecKey, batch_size: int = 8,
@@ -166,6 +169,14 @@ class StepFakeExecutor(FakeExecutor):
         self.step_calls: List[int] = []
         self.park_calls = 0
         self.resume_calls = 0
+        self.step_pack_stats = {"dispatches": 0, "packed_rows": 0,
+                                "rows_capacity": 0}
+
+    def step_signature(self, work: dict):
+        """Every fake work at the same step count packs together — the
+        fake's cohort step IS one dispatch (`StepBatcher.cohort`'s
+        pack_align source)."""
+        return (id(self), self.key.steps)
 
     def step_time_per_step_s(self) -> float:
         return (self.effective_service_s() / self.key.steps
@@ -177,6 +188,10 @@ class StepFakeExecutor(FakeExecutor):
 
     def step_run(self, works: List[dict]) -> None:
         self.step_calls.append(len(works))
+        self.step_pack_stats = {"dispatches": 1,
+                                "packed_rows": len(works),
+                                "rows_capacity": max(self.batch_size,
+                                                     len(works))}
         if self.step_time_s:
             time.sleep(self.step_time_per_step_s())
         for w in works:

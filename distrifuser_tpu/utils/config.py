@@ -617,6 +617,13 @@ class StepBatchConfig:
         and resume at the SAME step instead of re-running from step 0.
         Off, stop falls back to the plain `ServerClosedError` path
         (every completed step is wasted and re-executed on retry).
+      * ``pack_align`` — when ``step_width`` truncates the cohort, fill
+        it with slots that share the EDF head's compiled step signature
+        (same phase / patch-state stage / shallow flag — the grouping
+        the executor packs into ONE dispatch) before the rest, so the
+        width the round pays for lands in the fewest compiled calls.
+        The tightest-slack request always runs first regardless; off,
+        the cohort is the plain ``step_width`` tightest slots.
     """
 
     enabled: bool = False
@@ -628,6 +635,7 @@ class StepBatchConfig:
     preempt_margin_s: float = 0.0
     step_service_prior_s: float = 0.01
     export_carries: bool = True
+    pack_align: bool = True
 
     def __post_init__(self) -> None:
         if self.slots < 1:

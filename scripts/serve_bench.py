@@ -40,6 +40,12 @@ continuous queue-wait p99 ratio (tier1.yml runs it at 1.4x);
 ``--gate_ttfp_mult`` gates TYPICAL (p50) join-relative
 time-to-first-preview at ``mult x preview_interval x calibrated
 per-step service`` (p99 is reported alongside, not gated).
+``--continuous`` WITHOUT ``--dry-run`` instead runs the real-pipeline
+step-rate phase: one tiny random-weight SD pipeline, request-steps/sec
+of the fused-cohort step path vs the whole-batch compiled denoise loop
+on the same batch content, with the pack accounting in the summary line
+(proof the packed dispatch carried the rate); ``--gate_steps_ratio``
+gates step-mode at a fraction of whole-batch (tier1.yml runs 0.9x).
 
 ``--gateway`` drives a 2-tenant burst-vs-steady load through the REAL
 HTTP/SSE gateway (distrigate, serve/gateway.py): every request POSTs
@@ -288,6 +294,156 @@ def run_load(server: InferenceServer, args) -> dict:
         "first_preview_s": _percentiles(ttfp_enqueue),
         "first_preview_from_join_s": _percentiles(ttfp_join),
     }
+
+
+def run_step_rate_phase(args, bench_block) -> int:
+    """``--continuous`` without ``--dry-run``: the REAL-pipeline fused
+    cohort dispatch rate.  Builds one tiny random-weight SD pipeline and
+    measures request-steps/sec two ways on the SAME batch content:
+
+    * **whole-batch** — the fused compiled denoise loop (the production
+      monolithic path), timing repeated ``stages.denoise`` calls;
+    * **step-mode** — the step-granular slot path, timing ``steps``
+      cohort rounds over ``batch_size`` resident works.  With fused
+      cohort dispatch the round is ONE packed compiled call, so the only
+      structural overheads left are the host loop and per-row
+      index/guidance vectors.
+
+    One schema-1 line: steps/sec both ways, the ratio, and the pack
+    accounting of the timed rounds (dispatches vs rows — proof the rate
+    was measured on the packed path, not a sequential fallback).
+    ``--gate_steps_ratio`` fails the run (exit 1) when step-mode falls
+    below ratio x whole-batch (tier1.yml runs it at 0.9x)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu import DistriConfig
+    from distrifuser_tpu.models.clip import init_clip_params, tiny_clip_config
+    from distrifuser_tpu.models.unet import init_unet_params, tiny_config
+    from distrifuser_tpu.models.vae import init_vae_params, tiny_vae_config
+    from distrifuser_tpu.pipelines import DistriSDPipeline
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    bs = 2
+    steps = args.steps
+    reps = max(1, args.step_rate_reps)
+    # one device: the rate under test is the HOST-LOOP overhead of the
+    # step path vs the fused loop, not collective latency — and CI runs
+    # on a single CPU device anyway
+    def build_pipe():
+        # two identical pipelines (same init keys -> same weights): the
+        # stepwise flag changes which denoise program prepare_stages
+        # routes to, so the whole-batch pipeline must never see it
+        dcfg = DistriConfig(devices=jax.devices()[:1], height=128,
+                            width=128, batch_size=bs, warmup_steps=1)
+        tc = tiny_clip_config(hidden=32)
+        ucfg = tiny_config(cross_attention_dim=32, sdxl=False)
+        vcfg = tiny_vae_config()
+        return DistriSDPipeline.from_params(
+            dcfg, ucfg, init_unet_params(jax.random.PRNGKey(0), ucfg),
+            vcfg, init_vae_params(jax.random.PRNGKey(1), vcfg),
+            [tc], [init_clip_params(jax.random.PRNGKey(2), tc)],
+            scheduler=args.scheduler,
+        )
+
+    prompts = [PROMPTS[i % len(PROMPTS)] for i in range(bs)]
+    seeds = list(range(bs))
+    gs = 5.0
+
+    # whole-batch: the fused denoise program on the same batch content.
+    # The compiled program may donate its latent input, so each timed
+    # rep denoises a fresh copy (copy cost is noise next to the loop).
+    exw = PipelineExecutor(build_pipe(), steps=steps)
+    stages = exw.prepare_stages()
+    work = exw.encode_stage(prompts, [""] * bs, seeds)
+    enc, lats = work["encoded"][0], work["latents"]
+    jax.block_until_ready(stages.denoise(jax.tree.map(jnp.copy, enc),
+                                         jnp.copy(lats), gs))  # compile
+
+    # step-mode setup: bs resident works advanced one fused cohort round
+    # at a time.  Warm one full drive first (compiles every per-step
+    # signature + the packed trace).
+    pipe = build_pipe()
+    pipe.set_stepwise(True)
+    exs = PipelineExecutor(pipe, steps=steps)
+
+    def begin():
+        return [exs.step_begin(p, "", s, gs)
+                for p, s in zip(prompts, seeds)]
+
+    ws = begin()
+    for _ in range(steps):
+        exs.step_run(ws)
+    for w in ws:
+        exs.step_abort(w)
+
+    # interleaved back-to-back reps: each rep times BOTH paths on the
+    # same slice of wall clock, so box noise (a shared CI runner) hits
+    # them together; the gate takes the best paired ratio — robust to
+    # noise, still a hard floor on the structural host-loop overhead
+    whole_walls, step_walls, ratios = [], [], []
+    dispatches = packed_rows = 0
+    for _ in range(reps):
+        enc_i = jax.block_until_ready(jax.tree.map(jnp.copy, enc))
+        lats_i = jax.block_until_ready(jnp.copy(lats))
+        t0 = _time.perf_counter()
+        jax.block_until_ready(stages.denoise(enc_i, lats_i, gs))
+        whole_walls.append(_time.perf_counter() - t0)
+        ws = begin()
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            exs.step_run(ws)
+            dispatches += exs.step_pack_stats["dispatches"]
+            packed_rows += exs.step_pack_stats["packed_rows"]
+        step_walls.append(_time.perf_counter() - t0)
+        for w in ws:
+            exs.step_abort(w)
+        ratios.append(whole_walls[-1] / step_walls[-1])
+    whole_dt, step_dt = min(whole_walls), min(step_walls)
+    whole_sps = bs * steps / whole_dt
+    step_sps = bs * steps / step_dt
+    ratio = max(ratios)
+
+    artifact = {
+        "bench": {**bench_block, "continuous_step_rate": True,
+                  "batch_size": bs, "reps": reps,
+                  "gate_steps_ratio": args.gate_steps_ratio},
+        "whole_batch": {"steps_per_s": whole_sps, "wall_s": whole_dt},
+        "step_mode": {"steps_per_s": step_sps, "wall_s": step_dt,
+                      "dispatches": dispatches,
+                      "packed_rows": packed_rows},
+        "steps_ratio": ratio,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    emit_bench_line({
+        "metric": "serve_step_mode_steps_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "whole_batch_steps_per_s": round(whole_sps, 3),
+        "step_mode_steps_per_s": round(step_sps, 3),
+        "steps": steps,
+        "batch_size": bs,
+        "reps": reps,
+        "dispatches": dispatches,
+        "packed_rows": packed_rows,
+        # 1.0 when every timed round was ONE fused dispatch
+        "rounds_packed_share": (reps * steps / dispatches
+                                if dispatches else 0.0),
+    })
+    if args.gate_steps_ratio > 0 and ratio < args.gate_steps_ratio:
+        print(
+            f"GATE FAILED: step-mode {step_sps:.3f} steps/s is "
+            f"{ratio:.3f}x whole-batch {whole_sps:.3f} steps/s "
+            f"< {args.gate_steps_ratio}x",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def run_gateway_bench(args, bench_block) -> int:
@@ -652,6 +808,15 @@ def main(argv=None) -> int:
                     help="continuous: slot-pool size (0 = max_batch_size)")
     ap.add_argument("--preview_interval", type=int, default=2,
                     help="continuous: emit a preview every K steps")
+    ap.add_argument("--step_rate_reps", type=int, default=3,
+                    help="continuous without --dry-run: timed "
+                         "repetitions per path in the real-pipeline "
+                         "step-rate phase (best rep counts)")
+    ap.add_argument("--gate_steps_ratio", type=float, default=0.0,
+                    help="continuous without --dry-run: fail (exit 1) "
+                         "unless step-mode steps/sec >= ratio x "
+                         "whole-batch steps/sec on the real tiny "
+                         "pipeline (0 disables; tier-1 runs 0.9)")
     ap.add_argument("--gate_p99_ratio", type=float, default=0.0,
                     help="continuous: fail (exit 1) unless whole-batch "
                          "queue-wait p99 / continuous queue-wait p99 >= "
@@ -818,6 +983,11 @@ def main(argv=None) -> int:
                 )
                 return 1
         return 0
+
+    if args.continuous and not args.dry_run:
+        # real tiny pipeline: the fused-cohort step rate vs the
+        # whole-batch fused loop (gate: step-mode >= 0.9x in tier-1)
+        return run_step_rate_phase(args, bench_block)
 
     if args.continuous:
         # same open-loop mixed load twice — whole-batch baseline, then

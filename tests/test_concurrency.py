@@ -263,6 +263,8 @@ def test_scenario_suite_covers_the_issue_catalog():
         "gateway_stop_midstream", "gateway_cancel_final_race",
         # ISSUE 18: cross-replica carry migration
         "stepbatch_kill_during_carry_export", "stepbatch_migrate_vs_cancel",
+        # ISSUE 19: fused cohort step dispatch
+        "stepbatch_preempt_vs_pack_race",
     }
 
 

@@ -669,3 +669,343 @@ def test_real_pipeline_step_bit_identity_mmdit(devices8):
 
     pipe, _ = build_sd3_pipeline(devices8, 1, batch_size=2)
     _step_drive_bit_identity(pipe)
+
+
+# --------------------------------------------------------------------------
+# fused cohort dispatch: rowpack carry-layout unit behaviour
+# --------------------------------------------------------------------------
+
+
+def test_rowpack_axes_pack_extract_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    from distrifuser_tpu.parallel import rowpack
+
+    def carry(seed, w):
+        # the four leaf species a real carry mixes: a plain batch-axis
+        # leaf, a CFG-folded fold-major/batch-minor leaf (2w rows, the
+        # request row minor), a per-run scheduler scalar, and a
+        # batch-less shared placeholder.  A SOLO carry's rows are
+        # identical copies of its one real row (the _pad_batch
+        # convention) — pack members arrive already at the compiled
+        # width
+        row = np.arange(6, dtype=np.float32) + seed
+        base = np.tile(row[None], (w, 1))
+        folded = np.concatenate([base, base + 100.0], axis=0)
+        return {"x": jnp.asarray(base), "folded": jnp.asarray(folded),
+                "ctr": jnp.asarray(float(seed)),
+                "shared": jnp.ones((3,), jnp.float32)}
+
+    axes = rowpack.axes_from_shapes(carry(0, 1), carry(0, 2))
+    # tree_leaves order for a dict is sorted keys: ctr, folded, shared, x
+    assert [a.axis for a in axes] == [None, 0, None, 0]
+    assert axes[0].ndim == 0 and axes[2].ndim == 1
+
+    width = 2
+    a, b = carry(1, width), carry(2, width)
+    packed = rowpack.pack_rows([a, b], [0, 0], axes, width)
+    assert packed["x"].shape == (2, 6)
+    np.testing.assert_array_equal(np.asarray(packed["ctr"]), [1.0, 2.0])
+    # fold-major/batch-minor: a's fold blocks land at rows {0, 2}, b's
+    # at {1, 3}
+    np.testing.assert_array_equal(np.asarray(packed["folded"][0]),
+                                  np.asarray(a["folded"][0]))
+    np.testing.assert_array_equal(np.asarray(packed["folded"][1]),
+                                  np.asarray(b["folded"][0]))
+    np.testing.assert_array_equal(np.asarray(packed["folded"][2]),
+                                  np.asarray(a["folded"][2]))
+
+    # extract reproduces the solo layout byte-exactly (solo rows are
+    # identical by construction, so tile(row) == the never-packed carry)
+    for w_solo, row in ((a, 0), (b, 1)):
+        solo = rowpack.extract_row(packed, row, axes, width)
+        for k in w_solo:
+            np.testing.assert_array_equal(np.asarray(solo[k]),
+                                          np.asarray(w_solo[k]))
+
+    # padding repeats the last member; extract of the real row is intact
+    short = rowpack.pack_rows([a], [0], axes, width)
+    solo = rowpack.extract_row(short, 0, axes, width)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(solo[k]),
+                                      np.asarray(a[k]))
+
+    # a previously-packed member contributes its own row
+    repacked = rowpack.pack_rows([packed, a], [1, 0], axes, width)
+    solo_b = rowpack.extract_row(repacked, 0, axes, width)
+    for k in b:
+        np.testing.assert_array_equal(np.asarray(solo_b[k]),
+                                      np.asarray(b[k]))
+
+    # donation safety: shared leaves are COPIED, never aliased, in both
+    # directions (the per-step programs donate carry buffers)
+    assert packed["shared"] is not a["shared"]
+    assert (packed["shared"].unsafe_buffer_pointer()
+            != a["shared"].unsafe_buffer_pointer())
+    extracted = rowpack.extract_row(packed, 0, axes, width)
+    assert (extracted["shared"].unsafe_buffer_pointer()
+            != packed["shared"].unsafe_buffer_pointer())
+
+
+def test_rowpack_ambiguity_rejects():
+    import jax.numpy as jnp
+
+    from distrifuser_tpu.parallel import rowpack
+
+    # two axes double together -> no unique batch axis
+    with pytest.raises(rowpack.AmbiguousPackAxisError, match="multiple"):
+        rowpack.axes_from_shapes({"h": jnp.zeros((1, 1, 4))},
+                                 {"h": jnp.zeros((2, 2, 4))})
+    # rank change with width -> structure is width-dependent
+    with pytest.raises(rowpack.AmbiguousPackAxisError, match="rank"):
+        rowpack.axes_from_shapes({"h": jnp.zeros((1, 4))},
+                                 {"h": jnp.zeros((2, 4, 1))})
+    # mismatched member treedefs reject instead of mis-zipping leaves
+    axes = rowpack.axes_from_shapes({"h": jnp.zeros((1, 4))},
+                                    {"h": jnp.zeros((2, 4))})
+    with pytest.raises(rowpack.AmbiguousPackAxisError, match="structure"):
+        rowpack.pack_rows([{"h": jnp.zeros((2, 4))},
+                           {"g": jnp.zeros((2, 4))}], [0, 0], axes, 2)
+    # a batch axis that does not divide the width cannot be fold-indexed
+    with pytest.raises(rowpack.AmbiguousPackAxisError, match="multiple of"):
+        rowpack.extract_row({"h": jnp.zeros((3, 4))}, 0, axes, 2)
+
+
+# --------------------------------------------------------------------------
+# fused cohort dispatch: pack-aligned cohort selection (fakes)
+# --------------------------------------------------------------------------
+
+
+def _sigged_states(sb, sigs_ttls, now):
+    states = []
+    for sig, ttl in sigs_ttls:
+        st = mk_state(mk_request(ttl=ttl, now=now))
+        st.work = {"sig": sig}
+        sb.admit(st)
+        states.append(st)
+    return states
+
+
+def test_cohort_pack_align_fills_with_matching_signature():
+    sb = StepBatcher(step_config(slots=6, step_width=2, pack_align=True),
+                     clock=time.monotonic,
+                     pack_signature=lambda s: s.work.get("sig"))
+    now = time.monotonic()
+    # EDF order by ttl: A(1) B(2) A(3) B(4); width 2 -> the anchor A plus
+    # the NEXT A, skipping the tighter B (which still outranks next round)
+    states = _sigged_states(
+        sb, [("A", 1.0), ("B", 2.0), ("A", 3.0), ("B", 4.0)], now)
+    cohort = sb.cohort(now)
+    assert cohort == [states[0], states[2]]
+    assert sb.pack_aligned == 1
+    assert sb.snapshot()["pack_aligned"] == 1
+    # anchor with a signature nobody shares falls back to plain EDF width
+    for st in states:
+        sb.remove(st)
+    states = _sigged_states(
+        sb, [("C", 1.0), ("B", 2.0), ("B", 3.0)], now)
+    assert sb.cohort(now) == [states[0], states[1]]
+
+
+def test_cohort_pack_align_off_or_unsigned_is_plain_edf():
+    # pack_align=False -> plain EDF truncation even with matching sigs
+    sb = StepBatcher(step_config(slots=6, step_width=2, pack_align=False),
+                     clock=time.monotonic,
+                     pack_signature=lambda s: s.work.get("sig"))
+    now = time.monotonic()
+    states = _sigged_states(
+        sb, [("A", 1.0), ("B", 2.0), ("A", 3.0)], now)
+    assert sb.cohort(now) == [states[0], states[1]]
+    assert sb.pack_aligned == 0
+    # no signature source (executor without step_signature) -> plain EDF
+    sb2 = StepBatcher(step_config(slots=6, step_width=2, pack_align=True),
+                      clock=time.monotonic)
+    states2 = _sigged_states(
+        sb2, [("A", 1.0), ("B", 2.0), ("A", 3.0)], now)
+    assert sb2.cohort(now) == [states2[0], states2[1]]
+    # a signature source that raises is treated as unsigned, not fatal
+    def boom(_state):
+        raise RuntimeError("no signature for you")
+    sb3 = StepBatcher(step_config(slots=6, step_width=2, pack_align=True),
+                      clock=time.monotonic, pack_signature=boom)
+    states3 = _sigged_states(
+        sb3, [("A", 1.0), ("B", 2.0), ("A", 3.0)], now)
+    assert sb3.cohort(now) == [states3[0], states3[1]]
+
+
+def test_server_counts_packed_dispatches_on_fakes():
+    """The fakes report pack stats (one dispatch per cohort round), and
+    the server folds them into the stepbatch_dispatches /
+    stepbatch_packed_rows counters and the pack-fill gauge."""
+    fac = StepFakeExecutorFactory(batch_size=4, step_time_s=0.002)
+    with InferenceServer(fac, serve_config()) as server:
+        futs = [server.submit(f"p{i}", height=64, width=64, seed=i)
+                for i in range(3)]
+        for f in futs:
+            f.result(timeout=30)
+        snap = server.metrics_snapshot()
+    reqs = snap["requests"]
+    assert reqs["stepbatch_dispatches"] >= 1
+    assert reqs["stepbatch_packed_rows"] >= reqs["stepbatch_dispatches"]
+    # every fake round is one dispatch, so total packed rows equals total
+    # member-steps executed
+    assert reqs["stepbatch_packed_rows"] == reqs["steps_executed"]
+
+
+# --------------------------------------------------------------------------
+# fused cohort dispatch: real tiny pipelines pack bit-identically
+# --------------------------------------------------------------------------
+
+
+def test_real_pipeline_packed_dispatch_and_migration(devices8):
+    """The tentpole on the real tiny SD config: two same-signature works
+    advance in ONE compiled dispatch (step_pack_stats proves packing
+    engaged, not a silent sequential fallback), the repeat round takes
+    the zero-repack fast path, a packed member migrates out via
+    step_export into a fresh executor, and every image is byte-equal to
+    its solo run."""
+    from test_pipelines import build_sd_pipeline
+
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    steps = 3
+    pipe, _ = build_sd_pipeline(devices8, 1, batch_size=2)
+    pipe.set_stepwise(True)
+    ex = PipelineExecutor(pipe, steps=steps)
+
+    def solo_image(prompt, seed):
+        w = ex.step_begin(prompt, "", seed, 5.0)
+        for _ in range(steps):
+            ex.step_run([w])
+            assert ex.step_pack_stats["dispatches"] == 1
+        return np.asarray(ex.step_finish(w))
+
+    ref_cat = solo_image("a cat", 7)
+    ref_dog = solo_image("a dog", 9)
+
+    wa = ex.step_begin("a cat", "", 7, 5.0)
+    wb = ex.step_begin("a dog", "", 9, 5.0)
+    ex.step_run([wa, wb])
+    # ONE dispatch carried both members' rows
+    assert ex.step_pack_stats == {"dispatches": 1, "packed_rows": 2,
+                                  "rows_capacity": 2}
+    assert wa["carry"] is wb["carry"]
+    assert sorted([wa["row"], wb["row"]]) == [0, 1]
+
+    # steady state: same group, same carry -> the fast path re-dispatches
+    # with zero repack work (still one dispatch)
+    ex.step_run([wa, wb])
+    assert ex.step_pack_stats == {"dispatches": 1, "packed_rows": 2,
+                                  "rows_capacity": 2}
+    assert wa["carry"] is wb["carry"]
+
+    # migration across a packed round: export the packed member (the
+    # snapshot is the SOLO layout, identical to a never-packed export),
+    # graft it into a fresh executor, and finish there
+    meta, leaves = ex.step_export(wb)
+    assert meta["step"] == 2 and wb.get("pack") is None
+    ex.step_abort(wb)
+    ex2 = PipelineExecutor(pipe, steps=steps)
+    wb2 = ex2.step_import(meta, leaves, "a dog", "", 9, 5.0)
+    while not ex2.step_done(wb2):
+        ex2.step_run([wb2])
+    np.testing.assert_array_equal(ref_dog, np.asarray(ex2.step_finish(wb2)))
+
+    # the member left behind finishes solo, byte-equal
+    ex.step_run([wa])
+    np.testing.assert_array_equal(ref_cat, np.asarray(ex.step_finish(wa)))
+
+
+def test_real_pipeline_preempt_mid_packed_round(devices8):
+    """Preempt-vs-pack: park a member of an ACTIVE pack (its carry is
+    shared with the survivor), let the survivor run ahead solo, resume,
+    and re-pack at DIFFERENT step indices — the per-row step-index
+    vector is exactly what makes that one dispatch.  Both images stay
+    byte-equal to solo runs."""
+    from test_pipelines import build_sd_pipeline
+
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    steps = 4
+    pipe, _ = build_sd_pipeline(devices8, 1, batch_size=2)
+    pipe.set_stepwise(True)
+    ex = PipelineExecutor(pipe, steps=steps)
+
+    def solo_image(prompt, seed):
+        w = ex.step_begin(prompt, "", seed, 5.0)
+        for _ in range(steps):
+            ex.step_run([w])
+        return np.asarray(ex.step_finish(w))
+
+    ref_cat = solo_image("a cat", 7)
+    ref_dog = solo_image("a dog", 9)
+
+    we = ex.step_begin("a cat", "", 7, 5.0)
+    wf = ex.step_begin("a dog", "", 9, 5.0)
+    ex.step_run([we, wf])                    # packed: e:1 f:1
+    assert ex.step_pack_stats["dispatches"] == 1
+    ex.step_park(we)                         # unpacks e out of the pack
+    assert we.get("pack") is None
+    ex.step_run([wf])                        # f:2 (solo, repacked away)
+    ex.step_resume(we)
+    ex.step_run([we, wf])                    # e:1->2, f:2->3 in ONE call
+    assert ex.step_pack_stats == {"dispatches": 1, "packed_rows": 2,
+                                  "rows_capacity": 2}
+    assert we["i"] == 2 and wf["i"] == 3
+    ex.step_run([we, wf])                    # e:3, f:4 done
+    ex.step_run([we])                        # e:4 done
+    np.testing.assert_array_equal(ref_dog, np.asarray(ex.step_finish(wf)))
+    np.testing.assert_array_equal(ref_cat, np.asarray(ex.step_finish(we)))
+
+
+def test_real_pipeline_mixed_signature_groups(devices8):
+    """A cohort mixing compiled-step signatures splits into per-
+    signature groups: same-signature members share one dispatch, the
+    odd one out dispatches alone, and nothing packs ACROSS signatures.
+    The 4-device config is 2-way SP patch parallelism (CFG split takes
+    the other mesh factor), so with warmup_steps=1 step 2 runs the
+    STALE displaced-patch program while steps 0-1 run SYNC — a real
+    warmup-vs-stale signature mix, with the displaced-patch state dict
+    riding the packed carry.  Results stay byte-equal to solo runs."""
+    from test_pipelines import build_sd_pipeline
+
+    from distrifuser_tpu.serve.executors import PipelineExecutor
+
+    steps = 3
+    pipe, _ = build_sd_pipeline(devices8, 4, batch_size=2)
+    assert pipe.distri_config.is_sp  # the premise: phases really differ
+    pipe.set_stepwise(True)
+    ex = PipelineExecutor(pipe, steps=steps)
+
+    def solo_image(prompt, seed):
+        w = ex.step_begin(prompt, "", seed, 5.0)
+        for _ in range(steps):
+            ex.step_run([w])
+        return np.asarray(ex.step_finish(w))
+
+    refs = [solo_image(p, s) for p, s in
+            (("a cat", 7), ("a dog", 9), ("a fox", 11))]
+
+    wa = ex.step_begin("a cat", "", 7, 5.0)
+    ex.step_run([wa])
+    ex.step_run([wa])                        # a:2 — next step is STALE
+    wb = ex.step_begin("a dog", "", 9, 5.0)
+    wc = ex.step_begin("a fox", "", 11, 5.0)
+    siga = ex.step_signature(wa)
+    sigb = ex.step_signature(wb)
+    assert siga is not None and sigb is not None and siga != sigb
+    assert sigb == ex.step_signature(wc)
+    ex.step_run([wa, wb, wc])                # a:3 done, b:1, c:1
+    stats = ex.step_pack_stats
+    # b+c share the warmup signature (one dispatch); a dispatches alone
+    assert stats["dispatches"] == 2 and stats["packed_rows"] == 3
+    assert wb["carry"] is wc["carry"] and wa["carry"] is not wb["carry"]
+    img_a = np.asarray(ex.step_finish(wa))
+    ex.step_run([wb, wc])                    # b:2, c:2 — sync+state pack
+    assert ex.step_pack_stats["dispatches"] == 1
+    ex.step_run([wb, wc])                    # b:3, c:3 — stale pack
+    assert ex.step_pack_stats["dispatches"] == 1
+    np.testing.assert_array_equal(refs[0], img_a)
+    np.testing.assert_array_equal(refs[1], np.asarray(ex.step_finish(wb)))
+    np.testing.assert_array_equal(refs[2], np.asarray(ex.step_finish(wc)))
