@@ -76,6 +76,12 @@ SERVE_RUNTIME_ALLOWLIST: Dict[str, str] = {
     "gateway": "sub-config: HTTP/SSE transport + tenant fairness "
                "policy — pure host-side admission/scheduling, never "
                "touches what compiles or executes",
+    "aot_cache": "sub-config: WHERE compiled programs persist, never "
+                 "WHAT compiles — an entry only loads when its full "
+                 "fingerprint (ExecKey scope + jax/jaxlib/backend + "
+                 "mesh + layout) matches, and a mismatch falls back to "
+                 "the normal compile path (bit-identity pinned by "
+                 "tests/test_aotcache.py)",
 }
 
 #: ExecKey fields _exec_key_for does not thread from ServeConfig —

@@ -142,6 +142,28 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
             via="single-owner failover hand-off (Future resolution "
                 "happens-before the next dispatch)"),
     },
+    "distrifuser_tpu/serve/aotcache.py": {
+        # "file I/O runs outside _lock; the index and every counter
+        # mutate only under it" (module docstring) — the store is shared
+        # by parallel replica warmups through the thread-local
+        # activation, so a slipped counter corrupts the hit/reject
+        # accounting the warm-start bench gates
+        "AotExecutableCache": guard(
+            "_lock",
+            ["_index", "_tick", "hits", "misses", "rejects", "saves",
+             "save_skips", "evictions", "unserializable", "bytes_loaded",
+             "bytes_saved", "deserialize_seconds", "serialize_seconds"],
+        ),
+    },
+    "distrifuser_tpu/serve/autoscale.py": {
+        # policy state shared by the fleet tick thread and the scale
+        # operations' background threads (class docstring)
+        "Autoscaler": guard(
+            "_lock",
+            ["_above_since", "_below_since", "_last_action_at",
+             "_op_inflight", "_last_pressure"],
+        ),
+    },
     "distrifuser_tpu/serve/server.py": {
         # lifecycle cells mutated by concurrent stop()/start() callers
         # (stop is documented idempotent-from-any-thread); reads stay
@@ -162,7 +184,8 @@ GUARDED_REGISTRY: Dict[str, Dict[str, Guard]] = {
         "Replica": guard(
             "_lock",
             ["_state", "_history", "server", "killed", "generation",
-             "_bg_stop", "_warm_nonce"]),
+             "_bg_stop", "_warm_nonce", "last_warmup_s",
+             "last_warmup_compile_s", "last_warmup_deserialize_s"]),
     },
     "distrifuser_tpu/serve/staging.py": {
         # residency/outcome counters shared by the scheduler thread

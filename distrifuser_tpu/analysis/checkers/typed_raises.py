@@ -38,11 +38,22 @@ GENERIC_EXCEPTIONS = frozenset({
 
 SERVE_PREFIX = "distrifuser_tpu/serve/"
 
+#: modules whose ENTIRE raise surface must be one named type: every
+#: rejection path in the AOT store must raise `AotCacheRejectedError`
+#: (typed, never bare) so the load path's fallback-to-compile contract
+#: — catch the one type, count a reject, delete the entry — can never
+#: miss a rejection some other exception class would smuggle past it.
+#: Bare re-raises (``raise`` with no expression) stay legal.
+SINGLE_TYPE_MODULES: Dict[str, str] = {
+    "distrifuser_tpu/serve/aotcache.py": "AotCacheRejectedError",
+}
+
 
 def scan_module(tree: ast.Module, relpath: str) -> List[Finding]:
     findings: List[Finding] = []
     counts: Dict[Tuple[str, str], int] = {}
     stack: List[ast.AST] = []
+    required = SINGLE_TYPE_MODULES.get(relpath)
 
     def visit(node: ast.AST):
         is_scope = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -56,7 +67,21 @@ def scan_module(tree: ast.Module, relpath: str) -> List[Finding]:
                 name = exc.func.id
             elif isinstance(exc, ast.Name):
                 name = exc.id
-            if name in GENERIC_EXCEPTIONS:
+            if required is not None and name != required:
+                scope = enclosing_qualname(stack)
+                idx = counts.get((scope, name or "?"), 0)
+                counts[(scope, name or "?")] = idx + 1
+                findings.append(Finding(
+                    checker=NAME, path=relpath, line=node.lineno,
+                    message=(
+                        f"`raise {name or '<expr>'}` in {scope} — every "
+                        f"rejection path in {relpath} must raise "
+                        f"{required} so the fallback-to-compile wrapper "
+                        "(catch one type, count, delete the entry) can "
+                        "never miss it"),
+                    identity=f"single-type:{scope}:{name}:{idx}",
+                ))
+            elif name in GENERIC_EXCEPTIONS:
                 scope = enclosing_qualname(stack)
                 idx = counts.get((scope, name), 0)
                 counts[(scope, name)] = idx + 1

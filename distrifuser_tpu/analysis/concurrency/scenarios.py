@@ -49,6 +49,11 @@ end of every one:
   image is the request's own deterministic bytes, the pool drains, and
   the pack accounting stays coherent (rows >= dispatches, never
   negative fill).
+* ``autoscale_down_vs_carry_export`` — an autoscaler-initiated
+  scale-down drain racing the victim's mid-denoise carry export, a
+  concurrent late admission, and the survivor's adoption: every future
+  resolves, the shared step ledger proves zero re-executed steps, the
+  active count never falls below ``min_replicas``, and no carry leaks.
 * ``gateway_stop_midstream`` — gateway stop() while SSE consumers are
   mid-stream and requests are mid-denoise: every open stream resolves
   (readers terminate), every admitted future settles, nothing wedges.
@@ -573,6 +578,122 @@ def stepbatch_preempt_vs_pack_race(ctx: ScenarioContext) -> None:
     assert snap["step_batching"]["pack_aligned"] >= 0
 
 
+def autoscale_down_vs_carry_export(ctx: ScenarioContext) -> None:
+    """an autoscaler scale-down drain racing the victim's mid-denoise
+    carry export, a late admission, and the survivor's adoption: every
+    future settles, the step ledger proves zero re-executed steps, the
+    floor holds, and no carry leaks."""
+    import numpy as np
+
+    from ...serve.autoscale import Autoscaler
+    from ...serve.errors import ServeError
+    from ...serve.fleet import FleetRouter
+    from ...serve.replica import REPLICA_STOPPED, Replica
+    from ...serve.testing import ExecutionLedger, \
+        StepLedgerFakeExecutorFactory, fake_image
+    from ...utils.config import AutoscaleConfig, FleetConfig
+
+    ledger = ExecutionLedger()
+    cfg = _step_config(slots=4)
+    reps = [Replica(n,
+                    StepLedgerFakeExecutorFactory(ledger, replica=n,
+                                                  batch_size=4,
+                                                  step_time_s=0.01),
+                    cfg, clock=ctx.clock)
+            for n in ("r0", "r1")]
+    router = FleetRouter(reps, FleetConfig(tick_s=0.0, auto_restart=False),
+                         clock=ctx.clock)
+    router.start()
+    # attached AFTER start so BOTH replicas serve — the interleavings
+    # under exploration are drain-vs-export-vs-adoption, not the
+    # dormant-start path (tests/test_autoscale.py owns that).  The high
+    # watermark is parked out of reach: a transient adoption spike must
+    # not re-warm the victim mid-story.
+    a = Autoscaler(router, AutoscaleConfig(
+        enabled=True, min_replicas=1, pressure_high=10.0,
+        pressure_low=0.5, up_sustain_s=0.0, down_sustain_s=0.0,
+        cooldown_s=0.0, drain_deadline_s=0.02))
+    router.autoscaler = a
+    # submit SEQUENTIALLY so least-pending routing spreads the two
+    # residents across the replicas — but tolerate the schedules where
+    # both land on one replica or a request finishes early (the drain
+    # then has less to export; the invariants below hold regardless)
+    futs = {0: router.submit("prompt-0", height=64, width=64, seed=0,
+                             num_inference_steps=6)}
+    ctx.wait_until(
+        lambda: futs[0].done()
+        or any(r.server.stepbatch.occupied() for r in reps),
+        "first carry resident")
+    futs[1] = router.submit("prompt-1", height=64, width=64, seed=1,
+                            num_inference_steps=6)
+    ctx.wait_until(
+        lambda: any(f.done() for f in futs.values())
+        or all(r.server.stepbatch.occupied() for r in reps),
+        "a carry resident per replica (or an early finisher)")
+    # <= 2 occupied / 8 slots = 0.25 <= low with active 2 > min 1: the
+    # policy MUST fire; the 0.02s deadline lands mid-denoise (6 steps
+    # x 0.01s), so the victim's resident exports under most schedules
+    fired = a.tick()
+    assert fired == "down", fired
+
+    def late_client() -> None:
+        # admission racing the background drain: must route around the
+        # draining victim or reject typed — never wedge, never land work
+        # that the drain then drops
+        try:
+            futs[9] = router.submit("late", height=64, width=64, seed=9,
+                                    num_inference_steps=2)
+        except ServeError:
+            pass
+
+    late = ctx.spawn("late-client", late_client)
+
+    def pump() -> None:
+        # housekeeping runs explicitly (tick thread off): parked
+        # adoptions re-dispatch until everything resolves; the
+        # autoscaler ticks ride along and must hold the min floor
+        while not all(f.done() for f in futs.values()):
+            router.tick()
+            ctx.rt.yield_point("pump")
+
+    pumper = ctx.spawn("pumper", pump)
+    late.join()
+    ctx.wait_until(lambda: any(r.state == REPLICA_STOPPED for r in reps),
+                   "victim released")
+    victim = next(r for r in reps if r.state == REPLICA_STOPPED)
+    survivor = next(r for r in reps if r is not victim)
+    outs = {i: ctx.result(f, tolerate=(ServeError,))
+            for i, f in futs.items()}
+    pumper.join()
+    ctx.wait_until(lambda: not a.snapshot()["op_inflight"],
+                   "drain op finishes")
+    assert a.active_count() >= 1, "drained below min_replicas"
+    # the two residents were ADMITTED before the drain: a scale-down
+    # salvages them (complete in place or migrate), never drops them
+    for i in range(2):
+        out = outs[i]
+        assert not isinstance(out, Exception), (
+            f"scale-down dropped admitted request {i}: {out!r}")
+        if out.migrations:
+            assert out.replica == survivor.name, (out.replica, victim.name)
+            assert out.steps_salvaged > 0, out.steps_salvaged
+        key = survivor.server._exec_key_for(64, 64, 6, cfg=True)
+        assert np.array_equal(out.output,
+                              fake_image(f"prompt-{i}", i, key)), (
+            f"request {i} resumed to a different image after the drain")
+    router.stop(timeout=60.0)
+    assert ledger.max_step_count() <= 1, (
+        f"a denoise step executed twice: {ledger.steps_snapshot()}")
+    snap = router.metrics_snapshot()["fleet"]["requests"]
+    assert snap.get("fleet_steps_reexecuted", 0) == 0, snap
+    for r in reps:
+        server = r.server
+        if server is not None and server.stepbatch is not None:
+            sb = server.stepbatch
+            ctx.wait_until(lambda sb=sb: not sb.occupied() and not sb.parked,
+                           "pool drains (no carry leaked)")
+
+
 def gateway_stop_midstream(ctx: ScenarioContext) -> None:
     """gateway stop() while SSE consumers are mid-stream: every open
     stream resolves (no reader left waiting), every admitted future
@@ -695,6 +816,7 @@ SCENARIOS: Dict[str, object] = {
     "stepbatch_kill_during_carry_export": stepbatch_kill_during_carry_export,
     "stepbatch_migrate_vs_cancel": stepbatch_migrate_vs_cancel,
     "stepbatch_preempt_vs_pack_race": stepbatch_preempt_vs_pack_race,
+    "autoscale_down_vs_carry_export": autoscale_down_vs_carry_export,
     "gateway_stop_midstream": gateway_stop_midstream,
     "gateway_cancel_final_race": gateway_cancel_final_race,
 }

@@ -59,6 +59,84 @@ from .guidance import branch_select, combine_guidance
 from .stepcache import STEPCACHE_KEY, is_shallow_at, run_cadence
 
 
+class _AotProgramHandle:
+    """Lazily compiled-OR-deserialized wrapper around one jitted program.
+
+    `compiled_handle` returns an uncompiled `jax.jit` callable — XLA
+    compilation happens at the first dispatch, when concrete argument
+    shapes exist.  When a persistent AOT store was active for the build
+    (`utils.aot.aot_activation`, installed by the serve layer's
+    `ExecutorCache` around every executor build), this wrapper captures
+    the (store, scope) pair at build time and intercepts that first
+    dispatch: it fingerprints the program as
+    ``scope | tag | abstract-value signature`` plus mesh shape and
+    donation layout, loads a persisted executable when one matches
+    (milliseconds), and otherwise compiles via ``lower().compile()`` and
+    persists the result for the next replica.  A loaded executable IS
+    the serialized compile — same XLA program, bit-identical outputs.
+
+    Any failure in the AOT path (an executable the runtime refuses to
+    serialize, an exotic call signature) falls back PERMANENTLY to the
+    plain jitted callable — the store is an accelerator, never a
+    correctness dependency.  Attribute access (``lower`` for
+    `compiled_hlo`, etc.) delegates to the wrapped jit handle.
+    """
+
+    def __init__(self, fn, *, store, scope: str, tag: str,
+                 mesh_shape: str, layout: str):
+        self._fn = fn
+        self._store = store
+        self._scope = scope
+        self._tag = tag
+        self._mesh_shape = mesh_shape
+        self._layout = layout
+        self._executables: Dict[str, Any] = {}
+        self._fallback = False
+
+    def _signature(self, args) -> str:
+        parts = []
+        for leaf in jax.tree_util.tree_leaves(args):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                parts.append(f"py.{type(leaf).__name__}")
+            else:
+                parts.append(f"{np.dtype(dtype).name}{tuple(shape)}")
+        import hashlib
+
+        return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+    def _acquire(self, sig: str, args):
+        fp = self._store.fingerprint(
+            f"{self._scope}|{self._tag}|{sig}",
+            mesh_shape=self._mesh_shape, layout=self._layout)
+        ex = self._store.load_executable(fp)
+        if ex is None:
+            ex = self._fn.lower(*args).compile()
+            self._store.save_executable(fp, ex)
+        return ex
+
+    def __call__(self, *args):
+        if self._fallback:
+            return self._fn(*args)
+        sig = self._signature(args)
+        ex = self._executables.get(sig)
+        if ex is None:
+            try:
+                ex = self._acquire(sig, args)
+            except Exception:
+                # the jit path is always correct; the store only ever
+                # saves time.  One bad interaction disables it for this
+                # handle rather than risking a dispatch loop of retries.
+                self._fallback = True
+                return self._fn(*args)
+            self._executables[sig] = ex
+        return ex(*args)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
 def _check_geometry(cfg: DistriConfig, ucfg: UNetConfig) -> None:
     if not cfg.is_sp:
         return
@@ -473,10 +551,29 @@ class DenoiseRunner:
         return (cfg.hybrid_loop and cfg.parallelism == "patch"
                 and cfg.mode != "full_sync" and cfg.is_sp)
 
+    def _aot_wrap(self, fn, tag: str, layout: str = "donate="):
+        """Wrap a freshly built jitted program in the persistent-AOT
+        handle when a store is active for this build thread (the serve
+        layer's `ExecutorCache` activates one around executor builds
+        when `ServeConfig.aot_cache.dir` is configured).  No store, no
+        wrapper — the production default is byte-for-byte today's path."""
+        from ..utils.aot import active_aot_scope
+
+        act = active_aot_scope()
+        if act is None:
+            return fn
+        store, scope = act
+        return _AotProgramHandle(
+            fn, store=store, scope=scope, tag=tag,
+            mesh_shape=str(dict(self.cfg.mesh.shape)), layout=layout)
+
     def _ensure_stale_scan(self, num_steps: int, n_sync: int):
         skey = ("stale_scan", num_steps, n_sync)
         if skey not in self._compiled:
-            self._compiled[skey] = self._build_stale_scan(num_steps, n_sync)
+            self._compiled[skey] = self._aot_wrap(
+                self._build_stale_scan(num_steps, n_sync),
+                tag=f"stale_scan:{num_steps}:{n_sync}",
+                layout="donate=1,2")
         return self._compiled[skey]
 
     def compiled_handle(self, num_steps: int, start_step: int = 0,
@@ -505,7 +602,15 @@ class DenoiseRunner:
             if plan is not None:
                 plan.check("runner.compile")
             self._builds += 1
-            self._compiled[key] = self._build(num_steps, start_step, end_step)
+            # AOT store hook (utils/aot.py, store in serve/aotcache.py):
+            # same layering as the chaos hook above — when the serve
+            # layer activated a persistent executable store around this
+            # build, the handle's first dispatch deserializes a persisted
+            # compile instead of paying XLA, and persists fresh compiles
+            # for the next replica.  No activation = plain jit handle.
+            self._compiled[key] = self._aot_wrap(
+                self._build(num_steps, start_step, end_step),
+                tag=f"fused:{key}")
         return self._compiled[key]
 
     def cache_info(self) -> Dict[str, Any]:

@@ -216,6 +216,13 @@ class ExecutorCache:
         # spans land on the "cache" track, so a Perfetto view shows
         # exactly which dispatch paid a compile.  None = zero overhead.
         self.tracer = None
+        # optional serve.aotcache.AotExecutableCache (set by the owning
+        # server when ServeConfig.aot_cache.dir is configured): every
+        # build runs inside an `aot_activation(store, key.short())`
+        # scope, so the runner's program builds deep inside build_fn can
+        # load persisted executables instead of compiling — and persist
+        # fresh compiles for the next replica.  None = compile-always.
+        self.aot_store = None
         self._entries: "OrderedDict[ExecKey, Any]" = OrderedDict()
         self._lock = sync.Lock()
         # refcounts by executor identity (not key: a key may rebuild while
@@ -303,7 +310,14 @@ class ExecutorCache:
         tt0 = tracer.clock() if tracer is not None else 0.0
         t0 = time.monotonic()
         try:
-            ex = self.build_fn(key)
+            store = self.aot_store
+            if store is not None:
+                from ..utils.aot import aot_activation
+
+                with aot_activation(store, key.short()):
+                    ex = self.build_fn(key)
+            else:
+                ex = self.build_fn(key)
         except BaseException:
             # failed builds still leave a trace mark: the retry loop's
             # next attempt shows up as a fresh build span after it
@@ -388,7 +402,7 @@ class ExecutorCache:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             total = self.hits + self.misses
-            return {
+            out = {
                 "entries": [k.short() for k in self._entries],
                 "capacity": self.capacity,
                 "hits": self.hits,
@@ -399,3 +413,9 @@ class ExecutorCache:
                 "pinned": sum(1 for n in self._pins.values() if n > 0),
                 "build_seconds": round(self.build_seconds, 6),
             }
+        # outside _lock: the store has its own lock, and nesting it
+        # inside this one would order them against the build path
+        store = self.aot_store
+        if store is not None:
+            out["aot"] = store.stats()
+        return out

@@ -130,6 +130,18 @@ class MigrationRejectedError(RetryableError):
     from bytes it cannot prove intact."""
 
 
+class AotCacheRejectedError(RetryableError):
+    """A persisted AOT executable entry (serve/aotcache.py) failed
+    validation at load: truncated or checksum-corrupt envelope, format-
+    version skew, jax/jaxlib/XLA version skew, mesh-shape or
+    donation/layout fingerprint mismatch, or an executable payload the
+    runtime refuses to deserialize.  Retryable because the REQUEST (and
+    the key) are fine — only the warm-start attempt failed: the store
+    deletes the bad entry and the caller falls back to a fresh compile,
+    never loading a program it cannot prove is the one that would have
+    been compiled here."""
+
+
 class ResourceExhaustedError(ExecuteFailedError):
     """OOM-shaped failure (jax RESOURCE_EXHAUSTED or injected): the
     trigger for the graceful-degradation ladder."""

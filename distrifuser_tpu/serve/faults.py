@@ -32,7 +32,14 @@ Injection sites (the convention — sites are plain strings):
   (serve/migration.py): MUTATION sites consulted through
   `FaultPlan.mutate` on the encoded snapshot bytes as they leave the
   dying replica / arrive at the adopting one.  Only the
-  ``snapshot_truncate`` / ``snapshot_corrupt`` kinds apply here.
+  ``snapshot_truncate`` / ``snapshot_corrupt`` kinds apply here;
+* ``"aotcache.save"`` / ``"aotcache.load"`` — the persistent AOT
+  executable store's disk wire (serve/aotcache.py): MUTATION sites on
+  the encoded envelope bytes on their way to disk / read back, keyed by
+  the entry's scope.  Same two mutation kinds; every mangling must be
+  caught by the store's checksum/envelope validation
+  (`AotCacheRejectedError`) and fall back to a fresh compile — never a
+  wrong program.
 
 Fault kinds:
 

@@ -198,6 +198,13 @@ class FleetRouter:
         for gname, fn in fleet_gauges.items():
             self.registry.unregister(gname)
             self.registry.gauge(gname, fn)
+        # elastic pool (serve/autoscale.py): attached only when enabled
+        # so the fixed-fleet path pays nothing
+        self.autoscaler = None
+        if self.config.autoscale.enabled:
+            from .autoscale import Autoscaler
+
+            self.autoscaler = Autoscaler(self, self.config.autoscale)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -207,7 +214,13 @@ class FleetRouter:
         one warmup, not N) and the housekeeping tick thread
         (``FleetConfig.tick_s > 0``).  If any replica fails to start,
         the already-started ones are stopped before the error
-        propagates — a failed fleet start leaks no scheduler threads."""
+        propagates — a failed fleet start leaks no scheduler threads.
+
+        With the autoscaler attached (``FleetConfig.autoscale.enabled``)
+        only the first ``min_replicas`` slots start; the surplus stays
+        DORMANT — operator-drained and never started, costing no warmup
+        — until sustained queue pressure scales it in (warm-from-store,
+        seconds not minutes)."""
         if self._started:
             # a typed raise, not an assert: under ``python -O`` an assert
             # vanishes and a double start would "clean up" (stop) the
@@ -217,6 +230,14 @@ class FleetRouter:
             raise ServerClosedError(
                 "this fleet was stopped; build a new FleetRouter")
         slots = list(self._slots.values())
+        if self.autoscaler is not None:
+            n0 = self.autoscaler.min_replicas
+            dormant = slots[n0:]
+            slots = slots[:n0]
+            with self._lock:
+                for slot in dormant:
+                    slot.manual = True  # dormant: routing-invisible,
+                    # un-started (STARTING), scale-up's candidate pool
         errors: List[Tuple[str, BaseException]] = []
 
         def run(slot: _ReplicaSlot) -> None:
@@ -748,7 +769,8 @@ class FleetRouter:
         """One housekeeping pass (the tick thread's body; tests call it
         directly on an injected clock): floor-score auto-drain, fault
         adoption of externally-stopped (killed) replicas, background
-        auto-restart, and parked-request re-dispatch/expiry."""
+        auto-restart, parked-request re-dispatch/expiry, and — with the
+        autoscaler attached — one elastic-pool policy evaluation."""
         cfg = self.config
         now = self.clock()
         with self._lock:
@@ -805,6 +827,8 @@ class FleetRouter:
             for fr in drain_now:
                 self._resolve(fr.future,
                               exc=ServerClosedError("fleet stopped"))
+        if self.autoscaler is not None:
+            self.autoscaler.tick(now)
 
     def _restart_async(self, slot: _ReplicaSlot) -> None:
         with self._lock:
@@ -910,6 +934,8 @@ class FleetRouter:
                 "parked": len(self._parked),
                 "failover_budget_remaining": self.budget.remaining,
                 "replicas": per_replica,
+                "autoscale": (self.autoscaler.snapshot()
+                              if self.autoscaler is not None else None),
             },
             "replicas": servers,
         }

@@ -176,6 +176,12 @@ class Replica:
         self._bg_stop: Optional[threading.Thread] = None
         self._state = REPLICA_STARTING
         self._warm_nonce = 0  # which start() owns the current WARMING
+        # last completed warm-up's wall time + compile/deserialize split
+        # (0.0 until the first start() finishes) — the scale-up latency
+        # numbers the autoscaler bench gates
+        self.last_warmup_s = 0.0
+        self.last_warmup_compile_s = 0.0
+        self.last_warmup_deserialize_s = 0.0
         self._history: List[Tuple[float, str, str]] = []
         # RLock: lifecycle methods nest (restart = stop + start), and the
         # kill path transitions from a watchdog worker thread
@@ -209,11 +215,17 @@ class Replica:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def start(self) -> "Replica":
+    def start(self, warmup: bool = True) -> "Replica":
         """starting/stopped -> warming -> serving.  Warming builds a
-        fresh server and compiles the configured warmup buckets BEFORE
-        the scheduler admits traffic; from STOPPED this is a restart
-        (new server generation, same handle).
+        fresh server and (with ``warmup``, the default) compiles the
+        configured warmup buckets BEFORE the scheduler admits traffic;
+        from STOPPED this is a restart (new server generation, same
+        handle).  The warm-up wall time and its compile-vs-deserialize
+        split land in the generation-scoped registry
+        (``replica_warmup_s`` / ``replica_warmup_compile_s`` /
+        ``replica_warmup_deserialize_s`` — the AOT-store payoff number,
+        docs/OBSERVABILITY.md) and as a "warmup" span on the fleet
+        trace track.
 
         The build + warmup runs OUTSIDE the lifecycle lock — real warmup
         compiles take minutes, and `stop()`/`drain()` must stay
@@ -264,6 +276,8 @@ class Replica:
                 # gauges/rings; distinct labels keep the shared registry
                 # from rejecting them as conflicting registrations
                 reg = reg.scoped({"generation": str(self.generation)})
+        tt0 = self.tracer.clock() if self.tracer is not None else 0.0
+        t0 = time.monotonic()
         try:
             server = InferenceServer(
                 self._build_executor,
@@ -276,13 +290,22 @@ class Replica:
                 registry=reg,
                 replica_name=self.name,
             )
-            server.start(warmup=True)
+            server.start(warmup=warmup)
         except Exception:
             with self._lock:
                 if (self._state == REPLICA_WARMING
                         and self._warm_nonce == nonce):
                     self._transition(REPLICA_STOPPED)
             raise
+        # warm-up accounting: wall time from "start decided to warm" to
+        # "server warmed", split into compile seconds (the executor
+        # cache's build clock) and deserialize seconds (the AOT store's
+        # clock) — together they answer "what did this replica's start
+        # cost, and how much did the persisted store save?"
+        warmup_s = time.monotonic() - t0
+        aot = server.aot_store
+        compile_s = float(server.cache.stats()["build_seconds"])
+        deser_s = float(aot.stats()["deserialize_seconds"]) if aot else 0.0
         with self._lock:
             if self._state != REPLICA_WARMING or self._warm_nonce != nonce:
                 # stop() (or a full stop+restart cycle) raced the warmup
@@ -291,7 +314,26 @@ class Replica:
                 server.stop(timeout=5.0)
                 return self
             self.server = server
+            self.last_warmup_s = warmup_s
+            self.last_warmup_compile_s = compile_s
+            self.last_warmup_deserialize_s = deser_s
             self._transition(REPLICA_SERVING)
+        # without a fleet-shared registry the gauges land on the server's
+        # own (fresh every generation, so no re-registration conflict);
+        # on the shared one they need the replica label the server adds
+        # to its own metrics, or sibling replicas' gauges would collide
+        target = (reg.scoped({"replica": self.name})
+                  if reg is not None else server.registry)
+        target.gauge("replica_warmup_s", lambda v=warmup_s: v)
+        target.gauge("replica_warmup_compile_s", lambda v=compile_s: v)
+        target.gauge("replica_warmup_deserialize_s",
+                     lambda v=deser_s: v)
+        if self.tracer is not None:
+            self.tracer.complete(
+                "warmup", tt0, self.tracer.clock(), track="fleet",
+                args={"replica": self.name, "warmup_s": round(warmup_s, 6),
+                      "compile_s": round(compile_s, 6),
+                      "deserialize_s": round(deser_s, 6)})
         return self
 
     def drain(self, release: bool = False,

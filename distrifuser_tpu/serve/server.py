@@ -164,6 +164,20 @@ class InferenceServer:
         self.tracer = (Tracer(clock=clock, capacity=obs.trace_capacity)
                        if obs.trace else None)
         self.cache.tracer = self.tracer
+        # Persistent AOT executable store (serve/aotcache.py): None when
+        # unconfigured — the store-off build path runs zero AOT code, the
+        # tracer/controller convention.  When on, every executor build
+        # runs inside the store's activation (see ExecutorCache.get), so
+        # warmup and ladder rebuilds consult the store first and populate
+        # it on miss; replicas sharing the configured dir warm from each
+        # other's compiles.
+        self.aot_store = None
+        if self.config.aot_cache.dir:
+            from .aotcache import AotExecutableCache
+
+            self.aot_store = AotExecutableCache(
+                self.config.aot_cache, fault_plan=fault_plan)
+            self.cache.aot_store = self.aot_store
         # Unified metrics plane (utils/metrics.py MetricsRegistry): every
         # Counter/LatencyHistogram/GapTracker/RingLog the server and its
         # sub-pieces mutate is OWNED here under hierarchical names, so
@@ -200,6 +214,21 @@ class InferenceServer:
                             lambda: float(self.cache.hits))
         self.registry.gauge("serve_cache_misses",
                             lambda: float(self.cache.misses))
+        if self.aot_store is not None:
+            # warm-start observability (docs/OBSERVABILITY.md): how much
+            # of this replica's warmup deserialized vs compiled, how
+            # many persisted entries were rejected (corrupt/version-skew
+            # entries that fell back to a fresh compile), and the bytes
+            # resident in the shared on-disk store
+            self.registry.gauge("aot_cache_hits",
+                                lambda: float(self.aot_store.hits))
+            self.registry.gauge("aot_cache_misses",
+                                lambda: float(self.aot_store.misses))
+            self.registry.gauge("aot_cache_rejects",
+                                lambda: float(self.aot_store.rejects))
+            self.registry.gauge(
+                "aot_cache_bytes",
+                lambda: float(self.aot_store.stats()["total_bytes"]))
         self.registry.gauge(
             "serve_retry_budget_remaining",
             lambda: float(self.resilience.budget.remaining))

@@ -35,6 +35,53 @@ _REP_KW = (
 SUPPORTS_FUSED_CALLBACK = _REP_KW == "check_vma"
 
 
+# Ahead-of-time executable serialization (the serve/aotcache.py store).
+# jax 0.4.x ships it as `jax.experimental.serialize_executable`:
+# ``serialize(compiled) -> (payload, in_tree, out_tree)`` and
+# ``deserialize_and_load(payload, in_tree, out_tree) -> Compiled``.
+# Newer lines fold the same capability into `jax.export`; probe for the
+# 0.4.x surface and flag it, so the store degrades to compile-always
+# (never a crash) on runtimes without it.
+try:
+    from jax.experimental import serialize_executable as _serialize_executable
+    SUPPORTS_EXECUTABLE_SERIALIZATION = (
+        hasattr(_serialize_executable, "serialize")
+        and hasattr(_serialize_executable, "deserialize_and_load")
+    )
+except Exception:  # pragma: no cover - absent on exotic jax lines
+    _serialize_executable = None
+    SUPPORTS_EXECUTABLE_SERIALIZATION = False
+
+
+def serialize_compiled(compiled) -> bytes:
+    """Compiled jax executable -> opaque bytes.
+
+    The 0.4.x serializer returns (payload, in_tree, out_tree); all three
+    are needed to reload, so the byte form is a pickle of the triple.
+    Raises whatever the runtime raises on unserializable programs
+    (callbacks, host-pinned buffers) — callers treat any failure as
+    "this program is not cacheable", never fatal.
+    """
+    import pickle
+
+    payload, in_tree, out_tree = _serialize_executable.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree), protocol=4)
+
+
+def deserialize_compiled(data: bytes):
+    """Inverse of `serialize_compiled`: bytes -> loaded executable.
+
+    Raises on malformed bytes or version-incompatible payloads; the AOT
+    store wraps every failure in its typed rejection and falls back to a
+    fresh compile.
+    """
+    import pickle
+
+    payload, in_tree, out_tree = pickle.loads(data)
+    return _serialize_executable.deserialize_and_load(
+        payload, in_tree, out_tree)
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """`jax.shard_map` with the repo's calling convention on any jax line.
 

@@ -908,6 +908,104 @@ class ControllerConfig:
 
 
 @dataclasses.dataclass
+class AotCacheConfig:
+    """Persistent AOT executable store (serve/aotcache.py): compiled
+    denoise programs serialized to a content-addressed on-disk cache so
+    a fresh replica warms from deserialized executables in seconds
+    instead of paying the full XLA compile campaign (the elastic-
+    autoscale gate, ROADMAP item 2).
+
+    * ``dir`` — store directory; None (default) disables the store
+      entirely.  Replicas sharing a config share the directory, which
+      is the point: a scale-up replica warms from an earlier replica's
+      compiles.
+    * ``max_bytes`` — on-disk byte budget; least-recently-LOADED
+      entries evict first once a save pushes the total over.
+    * ``readonly`` — CI/canary mode: loads serve, saves count a skip
+      and write nothing (a test run never grows or reorders the shared
+      store).
+    """
+
+    dir: Optional[str] = None
+    max_bytes: int = 2 * 1024**3
+    readonly: bool = False
+
+    def __post_init__(self) -> None:
+        if self.max_bytes < 1:
+            raise ValueError(
+                f"aot_cache.max_bytes must be >= 1, got {self.max_bytes}"
+            )
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Elastic replica-pool autoscaling (serve/autoscale.py
+    `Autoscaler`, driven from the fleet housekeeping tick).
+
+    Pressure is the fleet's step-granular utilization: (occupied step
+    slots + queued/parked work, weighted by remaining steps) over the
+    SERVING replicas' slot capacity — the PR-15 occupancy model the SLO
+    controller already trusts.  Sustained pressure above
+    ``pressure_high`` for ``up_sustain_s`` starts one stopped replica
+    (warm-from-cache when an `aot_cache` store is configured);
+    sustained pressure below ``pressure_low`` for ``down_sustain_s``
+    drains one (bounded by ``drain_deadline_s`` — the drain rides the
+    PR-17 carry-migration path, so scale-down discards no steps).
+    ``cooldown_s`` separates consecutive scale actions so one load
+    swing never slams the pool between bounds; ``min_replicas`` /
+    ``max_replicas`` (0 = every configured slot) bound the pool.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 0
+    pressure_high: float = 0.8
+    pressure_low: float = 0.25
+    up_sustain_s: float = 0.5
+    down_sustain_s: float = 5.0
+    cooldown_s: float = 5.0
+    drain_deadline_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscale.min_replicas must be >= 1, got "
+                f"{self.min_replicas}"
+            )
+        if self.max_replicas < 0:
+            raise ValueError(
+                "autoscale.max_replicas must be >= 0 (0 = all configured "
+                f"replicas), got {self.max_replicas}"
+            )
+        if self.max_replicas and self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscale.max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.pressure_high <= 0:
+            raise ValueError(
+                f"autoscale.pressure_high must be > 0, got "
+                f"{self.pressure_high}"
+            )
+        if not (0.0 <= self.pressure_low < self.pressure_high):
+            raise ValueError(
+                "autoscale.pressure_low must be in [0, pressure_high), "
+                f"got {self.pressure_low} (high={self.pressure_high})"
+            )
+        for name in ("up_sustain_s", "down_sustain_s", "cooldown_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 0, got "
+                    f"{getattr(self, name)}"
+                )
+        if self.drain_deadline_s <= 0:
+            raise ValueError(
+                "autoscale.drain_deadline_s must be > 0, got "
+                f"{self.drain_deadline_s}"
+            )
+
+
+@dataclasses.dataclass
 class FleetConfig:
     """Multi-replica fleet policy (serve/fleet.py `FleetRouter`); lives
     beside ServeConfig so one module owns every run-shaping knob.
@@ -967,8 +1065,20 @@ class FleetConfig:
     p99_ref_s: Optional[float] = None
     auto_restart: bool = False
     restart_cooldown_s: float = 10.0
+    # Elastic pool sizing between min/max bounds from the step-granular
+    # occupancy model, riding drain/warm-up + carry migration so scale
+    # events drop no steps — see AutoscaleConfig above and
+    # docs/SERVING.md "AOT cache & elastic autoscale".  Off by default.
+    autoscale: "AutoscaleConfig" = dataclasses.field(
+        default_factory=AutoscaleConfig
+    )
 
     def __post_init__(self) -> None:
+        if not isinstance(self.autoscale, AutoscaleConfig):
+            raise ValueError(
+                "autoscale must be an AutoscaleConfig, got "
+                f"{type(self.autoscale).__name__}"
+            )
         if not (0.0 <= self.health_floor < 1.0):
             raise ValueError(
                 f"health_floor must be in [0, 1), got {self.health_floor}"
@@ -1279,6 +1389,15 @@ class ServeConfig:
     # weighted-DRR fair queuing — see GatewayConfig above and
     # docs/SERVING.md "Gateway & multi-tenancy".
     gateway: GatewayConfig = dataclasses.field(default_factory=GatewayConfig)
+    # Persistent AOT executable store (serve/aotcache.py): warmup and
+    # ladder rebuilds consult it before compiling and populate it on
+    # miss, so a fresh replica warms from serialized executables instead
+    # of a compile campaign — see AotCacheConfig above and
+    # docs/SERVING.md "AOT cache & elastic autoscale".  Disabled unless
+    # ``aot_cache.dir`` is set.
+    aot_cache: "AotCacheConfig" = dataclasses.field(
+        default_factory=AotCacheConfig
+    )
 
     def __post_init__(self) -> None:
         if self.max_queue_depth < 1:
@@ -1404,4 +1523,9 @@ class ServeConfig:
             raise ValueError(
                 "gateway must be a GatewayConfig, got "
                 f"{type(self.gateway).__name__}"
+            )
+        if not isinstance(self.aot_cache, AotCacheConfig):
+            raise ValueError(
+                "aot_cache must be an AotCacheConfig, got "
+                f"{type(self.aot_cache).__name__}"
             )

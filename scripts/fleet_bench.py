@@ -45,6 +45,28 @@ completed denoise step, and the gates are step-scoped:
     the victim's pre-kill completed steps on migrated requests
     (acceptance: 0.8 — migration must actually carry the work over).
 
+**``--autoscale``** switches to the elastic-pool variant, two phases
+sharing one persistent AOT executable store (serve/aotcache.py):
+
+1. **cold vs warm start** — a replica warms against an EMPTY store
+   (every executor pays ``--fake_build_s`` of simulated compile and the
+   programs persist), then a second replica warms against the now-full
+   store (validated hits skip the build).  The gate is the tentpole
+   claim: ``cold_warmup_s / warm_warmup_s >= --min_warm_speedup``
+   (acceptance: 3.0), and the warm path must have actually loaded from
+   the store (``aot_warmed >= 1`` on its factory).  A third replica
+   warms under an injected ``aotcache.load`` corruption fault
+   (serve/faults.py): every read rejects typed and falls back to a
+   fresh compile — the replica still serves (gated:
+   ``recover_aot_rejects >= 1``).
+2. **load doubling** — a step-batching fleet starts with only
+   ``min_replicas`` of its 3 slots warm (the rest dormant), the
+   open-loop arrival rate DOUBLES halfway through the run, and the
+   autoscaler (serve/autoscale.py) must absorb it: ``scale_ups >= 1``,
+   ZERO dropped requests (failed + rejected == 0), and zero
+   re-executed steps (``max_step_count() == 1`` on the shared ledger —
+   any scale-down drain rides carry migration, never re-runs work).
+
 Usage:
     JAX_PLATFORMS=cpu python scripts/fleet_bench.py \
         [--requests 120] [--rate 40] [--min_availability 0.99] \
@@ -52,6 +74,8 @@ Usage:
     JAX_PLATFORMS=cpu python scripts/fleet_bench.py --migrate \
         [--steps 8] [--kill_after_steps 24] [--min_salvage 0.8] \
         [--out FILE]
+    JAX_PLATFORMS=cpu python scripts/fleet_bench.py --autoscale \
+        [--fake_build_s 0.2] [--min_warm_speedup 3.0] [--out FILE]
 """
 
 from __future__ import annotations
@@ -330,6 +354,284 @@ def run_migrate(args) -> dict:
     }
 
 
+def run_warm_start(args, store_dir: str) -> dict:
+    """Cold-vs-warm replica start through the shared AOT store: the
+    first replica compiles (simulated by ``--fake_build_s`` per
+    executor) and persists; the second deserializes; a third loads
+    under an injected ``aotcache.load`` corruption fault and must fall
+    back to a fresh compile (typed reject, still serves).  Returns the
+    warmup times and the store's hit/reject accounting."""
+    from distrifuser_tpu.serve import FaultPlan, FaultRule, Replica, \
+        ServeConfig
+    from distrifuser_tpu.serve.testing import FakeExecutorFactory
+    from distrifuser_tpu.utils.config import AotCacheConfig
+
+    def one(name: str, plan=None) -> tuple:
+        factory = FakeExecutorFactory(
+            batch_size=args.max_batch_size, build_delay_s=args.fake_build_s)
+        config = ServeConfig(
+            max_queue_depth=args.max_queue_depth,
+            max_batch_size=args.max_batch_size,
+            buckets=((512, 512),),
+            warmup_buckets=((512, 512, args.steps),),
+            default_steps=args.steps,
+            default_ttl_s=args.ttl_s,
+            aot_cache=AotCacheConfig(dir=store_dir),
+        )
+        rep = Replica(name, factory, config, model_id="fleet-bench",
+                      fault_plan=plan)
+        rep.start()
+        stats = rep.server.aot_store.stats()
+        rep.stop(timeout=30.0)
+        return rep, factory, stats
+
+    cold, cold_factory, cold_stats = one("cold")
+    warm, warm_factory, warm_stats = one("warm")
+    # the fallback proof: every store read is corrupted in flight, so
+    # the warm path MUST reject typed and recompile — a bad cache entry
+    # costs a compile, never a wrong program (and never a dead replica)
+    plan = FaultPlan([FaultRule(site="aotcache.load",
+                                kind="snapshot_corrupt", p=1.0)],
+                     seed=args.seed)
+    recover, _, recover_stats = one("recover", plan=plan)
+    return {
+        "cold_warmup_s": cold.last_warmup_s,
+        "cold_compile_s": cold.last_warmup_compile_s,
+        "warm_warmup_s": warm.last_warmup_s,
+        "warm_deserialize_s": warm.last_warmup_deserialize_s,
+        "cold_aot_saves": cold_stats["saves"],
+        "warm_aot_hits": warm_stats["hits"],
+        "warm_aot_rejects": warm_stats["rejects"],
+        "warm_builds_skipped": warm_factory.aot_warmed,
+        "cold_builds_skipped": cold_factory.aot_warmed,
+        "recover_warmup_s": recover.last_warmup_s,
+        "recover_aot_rejects": recover_stats["rejects"],
+        "recover_faults_fired": plan.fired(),
+    }
+
+
+def run_autoscale_load(args, store_dir: str) -> dict:
+    """Open-loop load that DOUBLES its arrival rate halfway through,
+    over a 3-slot elastic fleet starting with one warm replica; the
+    autoscaler must absorb the doubling by warming dormant slots from
+    the shared store, with nothing dropped and no step re-executed."""
+    from distrifuser_tpu.serve import (
+        FleetConfig,
+        FleetRouter,
+        Replica,
+        ResilienceConfig,
+        RetryableError,
+        ServeConfig,
+        StepBatchConfig,
+    )
+    from distrifuser_tpu.serve.testing import (
+        ExecutionLedger,
+        StepLedgerFakeExecutorFactory,
+    )
+    from distrifuser_tpu.utils.config import AotCacheConfig, AutoscaleConfig
+    from distrifuser_tpu.utils.metrics import MetricsRegistry
+
+    config = ServeConfig(
+        max_queue_depth=args.max_queue_depth,
+        max_batch_size=args.max_batch_size,
+        batch_window_s=args.batch_window_s,
+        buckets=((512, 512),),
+        warmup_buckets=((512, 512, args.steps),),
+        default_steps=args.steps,
+        default_ttl_s=args.ttl_s,
+        resilience=ResilienceConfig(
+            max_retries=1, backoff_base_s=0.005, backoff_max_s=0.05,
+            seed=args.seed,
+        ),
+        step_batching=StepBatchConfig(
+            enabled=True, slots=args.max_batch_size,
+            step_service_prior_s=args.fake_step_s,
+        ),
+        aot_cache=AotCacheConfig(dir=store_dir),
+    )
+    registry = MetricsRegistry()
+    ledger = ExecutionLedger()
+    factories = {}
+    replicas = []
+    for name in ("r0", "r1", "r2"):
+        factories[name] = StepLedgerFakeExecutorFactory(
+            ledger, replica=name, batch_size=args.max_batch_size,
+            build_delay_s=args.fake_build_s, step_time_s=args.fake_step_s)
+        replicas.append(Replica(
+            name, factories[name], config, capacity_weight=1.0,
+            model_id="fleet-bench", registry=registry))
+    fleet = FleetRouter(
+        replicas,
+        FleetConfig(tick_s=0.02, probe_cooldown_s=1.0,
+                    autoscale=AutoscaleConfig(
+                        enabled=True, min_replicas=1, max_replicas=3,
+                        pressure_high=0.8, pressure_low=0.05,
+                        up_sustain_s=0.05, down_sustain_s=10.0,
+                        cooldown_s=0.1,
+                        drain_deadline_s=args.drain_deadline_s)),
+        registry=registry,
+    )
+    n = args.requests
+    futures = []
+    rejected = 0
+    t0 = time.monotonic()
+    with fleet:
+        warm_at_start = sum(
+            1 for entry in fleet.metrics_snapshot()["fleet"][
+                "replicas"].values() if entry["state"] == "serving")
+        for i in range(n):
+            # the load-doubling edge: second half arrives twice as fast
+            rate = args.rate if i < n // 2 else 2.0 * args.rate
+            try:
+                futures.append(fleet.submit(
+                    PROMPTS[i % len(PROMPTS)] + f" #{i}",
+                    height=512, width=512, seed=i, ttl_s=args.ttl_s,
+                    num_inference_steps=args.steps,
+                ))
+            except RetryableError:
+                rejected += 1
+            time.sleep(1.0 / rate)
+        lat = []
+        failed = 0
+        for f in futures:
+            try:
+                r = f.result(timeout=args.ttl_s + 30)
+                lat.append(r.e2e_s)
+            except Exception:  # noqa: BLE001 — counted, gated below
+                failed += 1
+        wall = time.monotonic() - t0
+        snap = fleet.metrics_snapshot()
+        health = fleet.health()
+    lat.sort()
+    p99 = lat[max(0, int(0.99 * (len(lat) - 1)))] if lat else float("inf")
+    counters = snap["fleet"]["requests"]
+    auto = snap["fleet"]["autoscale"] or {}
+    return {
+        "offered": n,
+        "rejected": rejected,
+        "completed": len(lat),
+        "failed": failed,
+        "availability": len(lat) / n if n else 0.0,
+        "p99_e2e_s": p99,
+        "wall_s": wall,
+        "warm_at_start": warm_at_start,
+        "max_step_executions": ledger.max_step_count(),
+        "executed_twice": sum(
+            1 for execs in ledger.snapshot().values() if len(execs) > 1),
+        "scaled_builds_skipped": sum(
+            f.aot_warmed for f in factories.values()),
+        "autoscale": auto,
+        "steps_salvaged": counters.get("steps_salvaged", 0),
+        "steps_reexecuted": counters.get("fleet_steps_reexecuted", 0),
+        "fleet_counters": counters,
+        "health_status": health["status"],
+    }
+
+
+def main_autoscale(args) -> int:
+    import shutil
+    import tempfile
+
+    store_dir = args.aot_dir or tempfile.mkdtemp(prefix="fleet-bench-aot-")
+    try:
+        warm = run_warm_start(args, store_dir)
+        load = run_autoscale_load(args, store_dir)
+    finally:
+        if not args.aot_dir:
+            shutil.rmtree(store_dir, ignore_errors=True)
+    speedup = (warm["cold_warmup_s"] / warm["warm_warmup_s"]
+               if warm["warm_warmup_s"] > 0 else float("inf"))
+    scale_ups = load["autoscale"].get("counters", {}).get("scale_ups", 0)
+    dropped = load["failed"] + load["rejected"]
+    artifact = {
+        "bench": {
+            "mode": "autoscale",
+            "requests": args.requests,
+            "rate_rps": args.rate,
+            "steps": args.steps,
+            "fake_step_s": args.fake_step_s,
+            "fake_build_s": args.fake_build_s,
+            "min_warm_speedup": args.min_warm_speedup,
+            "drain_deadline_s": args.drain_deadline_s,
+            "seed": args.seed,
+        },
+        "warm_start": warm,
+        "load_doubling": load,
+        "warm_speedup": speedup,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+    emit_bench_line({
+        "metric": "fleet_autoscale_warm_start_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "cold_warmup_s": round(warm["cold_warmup_s"], 4),
+        "warm_warmup_s": round(warm["warm_warmup_s"], 4),
+        "warm_deserialize_s": round(warm["warm_deserialize_s"], 4),
+        "warm_aot_hits": warm["warm_aot_hits"],
+        "recover_aot_rejects": warm["recover_aot_rejects"],
+        "scale_ups": scale_ups,
+        "warm_at_start": load["warm_at_start"],
+        "availability": round(load["availability"], 4),
+        "dropped": dropped,
+        "max_step_executions": load["max_step_executions"],
+        "steps_reexecuted": load["steps_reexecuted"],
+        "scaled_builds_skipped": load["scaled_builds_skipped"],
+    })
+    fail = []
+    if args.min_warm_speedup > 0 and speedup < args.min_warm_speedup:
+        fail.append(
+            f"warm start {speedup:.2f}x faster than cold < gate "
+            f"{args.min_warm_speedup}x — the AOT store is not paying "
+            "for itself")
+    if warm["warm_aot_hits"] < 1 or warm["warm_builds_skipped"] < 1:
+        fail.append(
+            "the warm replica never loaded from the store "
+            f"(hits={warm['warm_aot_hits']}, "
+            f"skipped={warm['warm_builds_skipped']}) — the speedup "
+            "would be measuring noise")
+    if warm["warm_aot_rejects"]:
+        fail.append(
+            f"{warm['warm_aot_rejects']} store entr(ies) rejected on the "
+            "warm start — the cold run's programs did not round-trip")
+    if warm["recover_aot_rejects"] < 1:
+        fail.append(
+            "the injected aotcache.load corruption never rejected "
+            f"(fired={warm['recover_faults_fired']}) — the "
+            "fallback-to-compile path was not exercised")
+    if load["warm_at_start"] != 1:
+        fail.append(
+            f"{load['warm_at_start']} replicas serving at fleet start "
+            "(want exactly min_replicas=1) — the dormant-start path "
+            "was not exercised")
+    if scale_ups < 1:
+        fail.append("the load doubling never triggered a scale-up — "
+                    "the elastic pool was not exercised")
+    if dropped:
+        fail.append(
+            f"{dropped} request(s) dropped (failed={load['failed']}, "
+            f"rejected={load['rejected']}) through the load doubling")
+    if load["max_step_executions"] > 1:
+        fail.append(
+            f"a (request, step) pair executed "
+            f"{load['max_step_executions']} times — scale-down must "
+            "ride carry migration, never re-run salvaged steps")
+    if load["steps_reexecuted"]:
+        fail.append(
+            f"fleet_steps_reexecuted={load['steps_reexecuted']} — "
+            "migrated work re-ran on the survivor")
+    if load["executed_twice"]:
+        fail.append(
+            f"{load['executed_twice']} request(s) completed twice — the "
+            "failover invariant is broken")
+    if fail:
+        print("GATE FAILED: " + "; ".join(fail), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main_migrate(args) -> int:
     run = run_migrate(args)
     salvage_ratio = (run["steps_salvaged"] / run["pre_kill_steps"]
@@ -435,6 +737,25 @@ def main(argv=None) -> int:
     ap.add_argument("--kill_after_steps", type=int, default=40,
                     help="with --migrate: fleet-wide cohort-step "
                          "dispatches before the kill rule arms")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic-pool variant: cold-vs-warm replica "
+                         "start through the persistent AOT store, then "
+                         "an open-loop load that doubles mid-run over a "
+                         "fleet starting at min_replicas (gates: warm "
+                         "speedup, scale_ups >= 1, zero dropped, zero "
+                         "re-executed steps)")
+    ap.add_argument("--fake_build_s", type=float, default=0.2,
+                    help="with --autoscale: simulated per-executor "
+                         "compile time a validated store hit skips")
+    ap.add_argument("--min_warm_speedup", type=float, default=3.0,
+                    help="with --autoscale: cold_warmup_s / "
+                         "warm_warmup_s gate (0 disables)")
+    ap.add_argument("--drain_deadline_s", type=float, default=2.0,
+                    help="with --autoscale: scale-down drain bound "
+                         "before carries export and migrate")
+    ap.add_argument("--aot_dir", type=str, default=None,
+                    help="with --autoscale: persistent store directory "
+                         "(default: a private tempdir, removed after)")
     ap.add_argument("--min_salvage", type=float, default=0.8,
                     help="with --migrate: steps_salvaged must be >= this "
                          "fraction of the victim's pre-kill completed "
@@ -456,12 +777,17 @@ def main(argv=None) -> int:
 
     # per-mode defaults: the failover run wants headroom (the p99 gate
     # compares against an uncongested baseline); the migrate run wants
-    # PRESSURE, so every replica holds mid-denoise carries at kill time
+    # PRESSURE, so every replica holds mid-denoise carries at kill time;
+    # the autoscale run wants a base rate one replica absorbs and a
+    # doubled rate it cannot (4 slots / 0.08s-per-request ~ 50 rps)
     if args.rate is None:
-        args.rate = 150.0 if args.migrate else 40.0
+        args.rate = (150.0 if args.migrate
+                     else 30.0 if args.autoscale else 40.0)
     if args.steps is None:
-        args.steps = 8 if args.migrate else 4
+        args.steps = 8 if args.migrate or args.autoscale else 4
 
+    if args.autoscale:
+        return main_autoscale(args)
     if args.migrate:
         return main_migrate(args)
 

@@ -1,0 +1,291 @@
+"""Elastic fleet autoscaling (distrifuser_tpu/serve/autoscale.py): the
+pressure math, dormant-start integration (only ``min_replicas`` warm at
+``FleetRouter.start``), scale-up under sustained queue pressure,
+drain-based scale-down that salvages mid-denoise work through carry
+migration (zero re-executed steps), min/max bounds, hysteresis
+(sustain windows + cooldown) on an injected clock, and the
+fixed-fleet default staying untouched."""
+
+import time
+
+import pytest
+
+from distrifuser_tpu.serve.autoscale import Autoscaler, fleet_pressure
+from distrifuser_tpu.serve.fleet import FleetRouter, build_fleet
+from distrifuser_tpu.serve.replica import (
+    REPLICA_SERVING,
+    REPLICA_STARTING,
+    REPLICA_STOPPED,
+    Replica,
+)
+from distrifuser_tpu.serve.testing import (
+    ExecutionLedger,
+    FakeExecutorFactory,
+    StepLedgerFakeExecutorFactory,
+)
+from distrifuser_tpu.utils.config import (
+    AutoscaleConfig,
+    FleetConfig,
+    ServeConfig,
+    StepBatchConfig,
+)
+from distrifuser_tpu.utils.metrics import MetricsRegistry
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        time.sleep(0.002)
+
+
+def autoscale_cfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("pressure_high", 0.8)
+    kw.setdefault("pressure_low", 0.1)
+    kw.setdefault("up_sustain_s", 0.0)
+    kw.setdefault("down_sustain_s", 0.0)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("drain_deadline_s", 5.0)
+    return AutoscaleConfig(**kw)
+
+
+def serve_cfg(**kw):
+    kw.setdefault("warmup_buckets", ((64, 64, 2),))
+    kw.setdefault("default_steps", 2)
+    kw.setdefault("max_queue_depth", 64)
+    kw.setdefault("default_ttl_s", 60.0)
+    return ServeConfig(**kw)
+
+
+def mk_fleet(n=3, *, factory=None, autoscale=None, serve=None, **fleet_kw):
+    factory = factory or FakeExecutorFactory()
+    fleet_kw.setdefault("tick_s", 0.0)
+    fc = FleetConfig(autoscale=autoscale or autoscale_cfg(), **fleet_kw)
+    return build_fleet(lambda name: factory, serve or serve_cfg(), fc,
+                       replicas=[(f"r{i}", 1.0) for i in range(n)])
+
+
+# --------------------------------------------------------------------------
+# pressure math + defaults
+# --------------------------------------------------------------------------
+
+
+def test_fleet_pressure_math():
+    assert fleet_pressure(0.0, 4.0) == 0.0
+    assert fleet_pressure(2.0, 4.0) == 0.5
+    assert fleet_pressure(8.0, 4.0) == 2.0
+    assert fleet_pressure(0.0, 0.0) == 0.0
+    assert fleet_pressure(1.0, 0.0) == float("inf")  # demand, no capacity
+
+
+def test_autoscaler_absent_by_default():
+    """The fixed-fleet default: no autoscaler, every replica starts."""
+    router = mk_fleet(2, autoscale=AutoscaleConfig())  # enabled=False
+    assert router.autoscaler is None
+    with router:
+        assert all(router.replica(n).state == REPLICA_SERVING
+                   for n in router.replica_names())
+
+
+# --------------------------------------------------------------------------
+# dormant start: only min_replicas warm
+# --------------------------------------------------------------------------
+
+
+def test_start_warms_only_min_replicas():
+    factory = FakeExecutorFactory()
+    router = mk_fleet(3, factory=factory,
+                      autoscale=autoscale_cfg(min_replicas=1))
+    with router:
+        assert router.replica("r0").state == REPLICA_SERVING
+        assert router.replica("r1").state == REPLICA_STARTING
+        assert router.replica("r2").state == REPLICA_STARTING
+        assert router.autoscaler.active_count() == 1
+        # dormant slots are routing-invisible but requests still serve
+        out = router.submit("p", height=64, width=64,
+                            num_inference_steps=2).result(timeout=30)
+        assert out.replica == "r0"
+    # only r0 ever built executors: the dormant slots cost no warmup
+    assert all(router.replica(n).generation == (1 if n == "r0" else 0)
+               for n in router.replica_names())
+
+
+# --------------------------------------------------------------------------
+# scale-up under sustained pressure
+# --------------------------------------------------------------------------
+
+
+def test_scale_up_on_sustained_queue_pressure(tmp_path):
+    factory = FakeExecutorFactory(build_delay_s=0.05, step_time_s=0.05)
+    serve = serve_cfg(max_batch_size=1)
+    serve.aot_cache.dir = str(tmp_path)
+    router = mk_fleet(3, factory=factory, serve=serve,
+                      autoscale=autoscale_cfg(max_replicas=2))
+    with router:
+        a = router.autoscaler
+        futs = [router.submit(f"p{i}", height=64, width=64,
+                              num_inference_steps=2, seed=i)
+                for i in range(8)]
+        assert a.pressure() > a.config.pressure_high
+        wait_for(lambda: (router.tick() or
+                          router.replica("r1").state == REPLICA_SERVING),
+                 msg="scale-up to r1")
+        assert a.counters.snapshot()["scale_ups"] == 1
+        assert a.active_count() == 2
+        # the scaled-up replica warmed from the shared store: its build
+        # skipped the delay (aot_warmed counts the instant builds)
+        assert factory.aot_warmed >= 1
+        for f in futs:
+            assert f.result(timeout=30) is not None
+    snap = router.metrics_snapshot()["fleet"]
+    assert snap["autoscale"]["counters"]["scale_ups"] == 1
+
+
+def test_scale_up_respects_max_replicas():
+    factory = FakeExecutorFactory(step_time_s=0.05)
+    serve = serve_cfg(max_batch_size=1)
+    router = mk_fleet(3, factory=factory, serve=serve,
+                      autoscale=autoscale_cfg(max_replicas=1))
+    with router:
+        a = router.autoscaler
+        futs = [router.submit(f"p{i}", height=64, width=64,
+                              num_inference_steps=2, seed=i)
+                for i in range(6)]
+        router.tick()
+        assert a.counters.snapshot().get("up_blocked_max", 0) >= 1
+        assert a.counters.snapshot().get("scale_ups", 0) == 0
+        assert a.active_count() == 1
+        for f in futs:
+            f.result(timeout=30)
+
+
+# --------------------------------------------------------------------------
+# scale-down: drain rides carry migration, zero re-executed steps
+# --------------------------------------------------------------------------
+
+
+def _step_serve_cfg():
+    return ServeConfig(
+        max_queue_depth=32, max_batch_size=4, batch_window_s=0.001,
+        buckets=((64, 64),), warmup_buckets=(), default_steps=4,
+        default_ttl_s=60.0,
+        step_batching=StepBatchConfig(enabled=True, slots=4))
+
+
+def test_scale_down_salvages_in_flight_steps():
+    """Idle pressure with a straggler mid-denoise: the victim drains at
+    the deadline, its carry exports, and the request finishes on the
+    survivor with every completed step executed exactly once."""
+    registry = MetricsRegistry()
+    ledger = ExecutionLedger()
+    cfg = _step_serve_cfg()
+    reps = [Replica(n, StepLedgerFakeExecutorFactory(
+                ledger, replica=n, batch_size=4, step_time_s=0.02),
+                cfg, registry=registry)
+            for n in ("r0", "r1")]
+    router = FleetRouter(reps, FleetConfig(tick_s=0.0), registry=registry)
+    with router:
+        # attached AFTER start so both replicas serve (the policy under
+        # test is the drain decision, not the dormant-start path)
+        a = Autoscaler(router, autoscale_cfg(
+            min_replicas=1, pressure_low=0.5, drain_deadline_s=0.2))
+        router.autoscaler = a
+        steps = 60
+        f0 = router.submit("keep", height=64, width=64, seed=1,
+                           num_inference_steps=steps)
+        f1 = router.submit("move", height=64, width=64, seed=2,
+                           num_inference_steps=steps)
+        wait_for(lambda: all(
+            len(r.server.stepbatch.occupied()) == 1
+            and all(s.steps_done >= 2
+                    for s in r.server.stepbatch.occupied())
+            for r in reps), msg="one request resident per replica")
+        # 2 occupied / 8 slots = 0.25 <= pressure_low -> scale down;
+        # equal pending, so the highest index (r1) is the victim
+        assert a.pressure() <= 0.5
+        assert a.tick() == "down"
+        wait_for(lambda: router.replica("r1").state == REPLICA_STOPPED,
+                 msg="victim released")
+        outs = [f0.result(timeout=30), f1.result(timeout=30)]
+    moved = outs[1]
+    assert moved.replica == "r0" and moved.migrations == 1
+    assert moved.steps_salvaged >= 2
+    assert ledger.max_step_count() == 1  # ZERO re-executed steps
+    snap = router.metrics_snapshot()["fleet"]["requests"]
+    assert snap.get("fleet_steps_reexecuted", 0) == 0
+    assert snap["steps_salvaged"] >= 2
+    assert a.counters.snapshot()["scale_downs"] == 1
+
+
+def test_scale_down_respects_min_replicas():
+    router = mk_fleet(2, autoscale=autoscale_cfg(min_replicas=1,
+                                                 down_sustain_s=0.0))
+    with router:
+        a = router.autoscaler
+        # active == min: the idle fleet must never drain below the floor
+        for _ in range(3):
+            router.tick()
+        assert a.active_count() == 1
+        assert a.counters.snapshot().get("scale_downs", 0) == 0
+        assert a.counters.snapshot().get("down_blocked_min", 0) >= 1
+
+
+# --------------------------------------------------------------------------
+# hysteresis on an injected clock: sustain windows + cooldown
+# --------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_sustain_and_cooldown_injected_clock():
+    clock = _Clock()
+    factory = FakeExecutorFactory()
+    fc = FleetConfig(tick_s=0.0, autoscale=autoscale_cfg(
+        min_replicas=1, max_replicas=3,
+        up_sustain_s=1.0, down_sustain_s=2.0, cooldown_s=5.0))
+    router = build_fleet(lambda name: factory, serve_cfg(), fc,
+                         replicas=[("r0", 1.0), ("r1", 1.0), ("r2", 1.0)],
+                         clock=clock)
+    with router:
+        a = router.autoscaler
+        demand = {"v": 10.0}
+        a.pressure = lambda: demand["v"]  # policy-only determinism
+        # sustained high pressure: no action until the window elapses
+        assert a.tick(now=0.0) is None
+        assert a.tick(now=0.5) is None
+        assert a.tick(now=1.0) == "up"
+        wait_for(lambda: not a.snapshot()["op_inflight"],
+                 msg="scale-up op finished")
+        assert router.replica("r1").state == REPLICA_SERVING
+        # cooldown: pressure still high, but 5s must pass first
+        assert a.tick(now=1.1) is None
+        assert a.tick(now=5.9) is None
+        assert a.tick(now=6.5) == "up"
+        wait_for(lambda: not a.snapshot()["op_inflight"],
+                 msg="second scale-up finished")
+        assert a.active_count() == 3
+        # a dip below low resets the HIGH mark; the low mark must also
+        # sustain (2s) before a drain fires, cooldown permitting
+        demand["v"] = 0.0
+        assert a.tick(now=11.6) is None  # below_since = 11.6
+        assert a.tick(now=12.6) is None  # 1.0s < down_sustain_s
+        assert a.tick(now=13.7) == "down"
+        wait_for(lambda: not a.snapshot()["op_inflight"],
+                 msg="scale-down finished")
+        assert a.active_count() == 2
+        # a blip back above high wipes the low mark: no immediate drain
+        demand["v"] = 10.0
+        assert a.tick(now=18.8) is None  # above_since restarts
+        demand["v"] = 0.0
+        assert a.tick(now=18.9) is None  # below_since restarts at 18.9
+        assert a.tick(now=19.9) is None  # not sustained yet
+        cnt = a.counters.snapshot()
+        assert cnt["scale_ups"] == 2 and cnt["scale_downs"] == 1

@@ -265,6 +265,8 @@ def test_scenario_suite_covers_the_issue_catalog():
         "stepbatch_kill_during_carry_export", "stepbatch_migrate_vs_cancel",
         # ISSUE 19: fused cohort step dispatch
         "stepbatch_preempt_vs_pack_race",
+        # ISSUE 20: AOT cache + elastic autoscale
+        "autoscale_down_vs_carry_export",
     }
 
 
